@@ -1,0 +1,117 @@
+"""deepspeed_tpu: a TPU-native training-acceleration framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capability surface of early
+DeepSpeed (reference: deepspeed/__init__.py:33-110): one ``initialize()``
+call wraps a model into a training engine providing data parallelism over a
+device mesh, bf16/fp16 mixed precision with dynamic loss scaling, ZeRO
+stages 1-3 as sharding layouts, fused Adam/LAMB optimizers, a fused
+transformer layer (Pallas flash attention), activation checkpointing,
+Megatron-style model parallelism over mesh axes, JSON config, a multi-host
+launcher, and elastic checkpoint save/resume.
+"""
+
+import argparse
+
+from .config import DeepSpeedConfig
+from .config import constants as _constants
+from .ops.optimizers import Adam, Lamb, Lion, Optimizer, SGD
+from .runtime.engine import DeepSpeedEngine
+from .version import __version__
+
+
+def initialize(
+    args=None,
+    model=None,
+    optimizer=None,
+    model_parameters=None,
+    training_data=None,
+    lr_scheduler=None,
+    mpu=None,
+    dist_init_required=None,
+    collate_fn=None,
+    config_params=None,
+    mesh=None,
+    rng_seed=0,
+):
+    """Build a training engine; returns the reference's 4-tuple
+    ``(engine, optimizer, training_dataloader, lr_scheduler)``
+    (reference deepspeed/__init__.py:33-110).
+
+    ``model`` is a flax Module whose ``__call__(*batch)`` returns the scalar
+    loss (or a bare ``loss_fn(params, batch, rng)``); ``model_parameters`` is
+    the initialized parameter pytree.
+    """
+    from .runtime.engine import EngineOptimizerFacade
+
+    engine = DeepSpeedEngine(
+        args=args,
+        model=model,
+        optimizer=optimizer,
+        model_parameters=model_parameters,
+        training_data=training_data,
+        lr_scheduler=lr_scheduler,
+        mpu=mpu,
+        dist_init_required=dist_init_required,
+        collate_fn=collate_fn,
+        config_params=config_params,
+        mesh=mesh,
+        rng_seed=rng_seed,
+    )
+    return (
+        engine,
+        EngineOptimizerFacade(engine),
+        engine.training_dataloader,
+        engine.lr_scheduler,
+    )
+
+
+def _add_core_arguments(parser):
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument(
+        "--deepspeed",
+        default=False,
+        action="store_true",
+        help="Enable DeepSpeed (helper flag for user scripts)",
+    )
+    group.add_argument(
+        "--deepspeed_config", default=None, type=str, help="DeepSpeed json config file"
+    )
+    group.add_argument(
+        "--deepscale",
+        default=False,
+        action="store_true",
+        help="Deprecated alias for --deepspeed",
+    )
+    group.add_argument(
+        "--deepscale_config",
+        default=None,
+        type=str,
+        help="Deprecated alias for --deepspeed_config",
+    )
+    group.add_argument(
+        "--deepspeed_mpi",
+        default=False,
+        action="store_true",
+        help="Run via MPI-style multi-host discovery",
+    )
+    return parser
+
+
+def add_config_arguments(parser):
+    """Inject DeepSpeed CLI args into an argparse parser
+    (reference deepspeed/__init__.py:164-177)."""
+    return _add_core_arguments(parser)
+
+
+__all__ = [
+    "initialize",
+    "add_config_arguments",
+    "DeepSpeedConfig",
+    "DeepSpeedEngine",
+    "Optimizer",
+    "Adam",
+    "Lamb",
+    "Lion",
+    "SGD",
+    "__version__",
+]

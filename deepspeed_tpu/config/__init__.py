@@ -1,0 +1,15 @@
+from . import constants
+from .activation_checkpointing_config import DeepSpeedActivationCheckpointingConfig
+from .config import DeepSpeedConfig, DeepSpeedConfigError
+from .config_utils import load_config_json, loads_config_json
+from .zero_config import DeepSpeedZeroConfig
+
+__all__ = [
+    "constants",
+    "DeepSpeedConfig",
+    "DeepSpeedConfigError",
+    "DeepSpeedZeroConfig",
+    "DeepSpeedActivationCheckpointingConfig",
+    "load_config_json",
+    "loads_config_json",
+]

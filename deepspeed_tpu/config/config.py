@@ -1,0 +1,308 @@
+"""Top-level config system.
+
+Behavior parity with the reference's DeepSpeedConfig (reference:
+deepspeed/pt/deepspeed_config.py:284-488):
+
+- JSON file path or in-memory dict (``param_dict``).
+- Batch-size triangle: any two of {train_batch_size,
+  train_micro_batch_size_per_gpu, gradient_accumulation_steps} determine the
+  third, with the invariant ``train == micro * accum * dp_world_size``
+  (reference :361-431).
+- Hard error checks + soft warnings (reference :456-488).
+- Duplicate JSON keys rejected (via config_utils).
+
+TPU-first divergences (documented, intentional):
+- ``bf16`` block added; bf16 is the recommended precision on TPU and does not
+  require a loss scaler. fp16-with-dynamic-scaler is kept for strict parity.
+- ZeRO no longer *requires* fp16 (the reference asserted this, :458); sharded
+  fp32 training is natural in JAX, so this is a warning instead.
+- ZeRO stage 3 (parameter sharding) is accepted — the reference defined the
+  constant but raised NotImplementedError (deepspeed_constants.py:167,
+  deepspeed_light.py:619-620). On a TPU mesh it is one more sharding spec.
+- A ``mesh`` block configures dp/mp/sp/pp sizes (the reference delegated model
+  parallelism to an external Megatron ``mpu`` object).
+"""
+
+import logging
+
+from . import constants as C
+from .activation_checkpointing_config import DeepSpeedActivationCheckpointingConfig
+from .config_utils import get_dict_param, get_scalar_param, load_config_json
+from .zero_config import DeepSpeedZeroConfig
+
+logger = logging.getLogger("DeepSpeedTPU")
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class DeepSpeedConfig:
+    def __init__(self, config, mpu=None, param_dict=None, world_size=None):
+        """``config`` is a JSON path, or None when ``param_dict`` is given.
+
+        ``world_size`` is the *data-parallel* world size used to resolve the
+        batch triangle. It may be passed directly (tests, offline tools) or
+        derived from ``mpu``/the global device count.
+        """
+        if param_dict is not None:
+            self._param_dict = dict(param_dict)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        elif config is None:
+            self._param_dict = {}
+        else:
+            self._param_dict = load_config_json(config)
+
+        if world_size is not None:
+            self.world_size = world_size
+        elif mpu is not None:
+            self.world_size = mpu.get_data_parallel_world_size()
+        else:
+            self.world_size = _default_world_size()
+
+        self._initialize(self._param_dict)
+        self._configure_batch_parameters(self._param_dict)
+        self._do_error_check()
+        self._do_warning_check()
+
+    # ------------------------------------------------------------------
+    def _initialize(self, pd):
+        self.train_batch_size = get_scalar_param(
+            pd, C.TRAIN_BATCH_SIZE, C.TRAIN_BATCH_SIZE_DEFAULT
+        )
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            pd,
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT,
+        )
+        self.gradient_accumulation_steps = get_scalar_param(
+            pd, C.GRADIENT_ACCUMULATION_STEPS, C.GRADIENT_ACCUMULATION_STEPS_DEFAULT
+        )
+        self.steps_per_print = get_scalar_param(
+            pd, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT
+        )
+        self.dump_state = get_scalar_param(pd, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+
+        self.disable_allgather = get_scalar_param(
+            pd, C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT
+        )
+        self.allreduce_always_fp32 = get_scalar_param(
+            pd, C.ALLREDUCE_ALWAYS_FP32, C.ALLREDUCE_ALWAYS_FP32_DEFAULT
+        )
+        self.prescale_gradients = get_scalar_param(
+            pd, C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT
+        )
+        self.gradient_predivide_factor = get_scalar_param(
+            pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT
+        )
+        self.sparse_gradients_enabled = get_scalar_param(
+            pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT
+        )
+
+        self.zero_config = DeepSpeedZeroConfig(pd)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(pd)
+
+        self.gradient_clipping = get_scalar_param(
+            pd, C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT
+        )
+
+        # fp16 block
+        fp16_dict = get_dict_param(pd, C.FP16)
+        self.fp16_enabled = get_scalar_param(
+            fp16_dict, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT
+        )
+        self.loss_scale = get_scalar_param(
+            fp16_dict, C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT
+        )
+        self.initial_scale_power = get_scalar_param(
+            fp16_dict, C.FP16_INITIAL_SCALE_POWER, C.FP16_INITIAL_SCALE_POWER_DEFAULT
+        )
+        self.loss_scale_window = get_scalar_param(
+            fp16_dict, C.FP16_LOSS_SCALE_WINDOW, C.FP16_LOSS_SCALE_WINDOW_DEFAULT
+        )
+        self.hysteresis = get_scalar_param(
+            fp16_dict, C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT
+        )
+        self.min_loss_scale = get_scalar_param(
+            fp16_dict, C.FP16_MIN_LOSS_SCALE, C.FP16_MIN_LOSS_SCALE_DEFAULT
+        )
+        self.dynamic_loss_scale = self.loss_scale == 0
+
+        # bf16 block (TPU default precision)
+        bf16_dict = get_dict_param(pd, C.BF16)
+        self.bf16_enabled = get_scalar_param(
+            bf16_dict, C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT
+        )
+
+        # optimizer / scheduler
+        optimizer_dict = get_dict_param(pd, C.OPTIMIZER)
+        self.optimizer_name = optimizer_dict.get(C.TYPE)
+        if isinstance(self.optimizer_name, str):
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = get_dict_param(optimizer_dict, C.OPTIMIZER_PARAMS)
+        self.optimizer_legacy_fusion = get_scalar_param(
+            optimizer_dict, C.LEGACY_FUSION, C.LEGACY_FUSION_DEFAULT
+        )
+
+        scheduler_dict = get_dict_param(pd, C.SCHEDULER)
+        self.scheduler_name = scheduler_dict.get(C.TYPE)
+        self.scheduler_params = get_dict_param(scheduler_dict, C.SCHEDULER_PARAMS)
+
+        # observability
+        self.wall_clock_breakdown = get_scalar_param(
+            pd, C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT
+        )
+        self.memory_breakdown = get_scalar_param(
+            pd, C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT
+        )
+        tb_dict = get_dict_param(pd, C.TENSORBOARD)
+        self.tensorboard_enabled = get_scalar_param(
+            tb_dict, C.TENSORBOARD_ENABLED, C.TENSORBOARD_ENABLED_DEFAULT
+        )
+        self.tensorboard_output_path = get_scalar_param(
+            tb_dict, C.TENSORBOARD_OUTPUT_PATH, C.TENSORBOARD_OUTPUT_PATH_DEFAULT
+        )
+        self.tensorboard_job_name = get_scalar_param(
+            tb_dict, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT
+        )
+
+        # mesh block (TPU-native)
+        mesh_dict = get_dict_param(pd, C.MESH)
+        self.data_parallel_size = get_scalar_param(
+            mesh_dict, C.MESH_DATA_PARALLEL_SIZE, C.MESH_DATA_PARALLEL_SIZE_DEFAULT
+        )
+        self.model_parallel_size = get_scalar_param(
+            mesh_dict, C.MESH_MODEL_PARALLEL_SIZE, C.MESH_MODEL_PARALLEL_SIZE_DEFAULT
+        )
+        self.sequence_parallel_size = get_scalar_param(
+            mesh_dict, C.MESH_SEQUENCE_PARALLEL_SIZE, C.MESH_SEQUENCE_PARALLEL_SIZE_DEFAULT
+        )
+        self.pipeline_parallel_size = get_scalar_param(
+            mesh_dict, C.MESH_PIPELINE_PARALLEL_SIZE, C.MESH_PIPELINE_PARALLEL_SIZE_DEFAULT
+        )
+
+    # ------------------------------------------------------------------
+    # Batch-size triangle (reference: deepspeed_config.py:381-431)
+    # ------------------------------------------------------------------
+    def _configure_batch_parameters(self, pd):
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        accum = self.gradient_accumulation_steps
+        world = self.world_size
+
+        if all(v is not None for v in (train, micro, accum)):
+            pass  # verified below
+        elif train is not None and micro is not None:
+            accum, rem = divmod(train, micro * world)
+            if rem != 0:
+                raise DeepSpeedConfigError(
+                    f"{C.TRAIN_BATCH_SIZE}={train} is not divisible by "
+                    f"{C.TRAIN_MICRO_BATCH_SIZE_PER_GPU}={micro} * world_size={world}"
+                )
+        elif train is not None and accum is not None:
+            micro, rem = divmod(train, accum * world)
+            if rem != 0:
+                raise DeepSpeedConfigError(
+                    f"{C.TRAIN_BATCH_SIZE}={train} is not divisible by "
+                    f"{C.GRADIENT_ACCUMULATION_STEPS}={accum} * world_size={world}"
+                )
+        elif micro is not None and accum is not None:
+            train = micro * accum * world
+        elif train is not None:
+            accum = 1
+            micro, rem = divmod(train, world)
+            if rem != 0:
+                raise DeepSpeedConfigError(
+                    f"{C.TRAIN_BATCH_SIZE}={train} is not divisible by world_size={world}"
+                )
+        elif micro is not None:
+            accum = 1
+            train = micro * world
+        else:
+            raise DeepSpeedConfigError(
+                f"At least one of {C.TRAIN_BATCH_SIZE} and "
+                f"{C.TRAIN_MICRO_BATCH_SIZE_PER_GPU} must be set in the config"
+            )
+
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = accum
+
+        self._batch_assertion()
+
+    def _batch_assertion(self):
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        accum = self.gradient_accumulation_steps
+        world = self.world_size
+        if train <= 0:
+            raise DeepSpeedConfigError(f"Train batch size {train} must be positive")
+        if micro <= 0:
+            raise DeepSpeedConfigError(f"Micro batch size {micro} must be positive")
+        if accum <= 0:
+            raise DeepSpeedConfigError(f"Gradient accumulation steps {accum} must be positive")
+        if train != micro * accum * world:
+            raise DeepSpeedConfigError(
+                f"Check batch-related parameters: {C.TRAIN_BATCH_SIZE}={train} must equal "
+                f"{C.TRAIN_MICRO_BATCH_SIZE_PER_GPU}={micro} * "
+                f"{C.GRADIENT_ACCUMULATION_STEPS}={accum} * world_size={world}"
+            )
+
+    # ------------------------------------------------------------------
+    def _do_error_check(self):
+        if self.zero_enabled:
+            if self.zero_optimization_stage > C.MAX_STAGE_ZERO_OPTIMIZATION:
+                raise DeepSpeedConfigError(
+                    f"ZeRO stage {self.zero_optimization_stage} not supported; "
+                    f"max stage is {C.MAX_STAGE_ZERO_OPTIMIZATION}"
+                )
+        if self.fp16_enabled and self.bf16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.loss_scale < 0:
+            raise DeepSpeedConfigError(f"loss_scale must be >= 0, got {self.loss_scale}")
+
+    def _do_warning_check(self):
+        if self.zero_enabled and not (self.fp16_enabled or self.bf16_enabled):
+            # The reference hard-errored here (ZeRO required fp16,
+            # deepspeed_config.py:458); sharded fp32 is fine on TPU.
+            logger.warning(
+                "ZeRO is enabled without fp16/bf16; proceeding with fp32 "
+                "(the reference implementation required fp16 here)."
+            )
+        if self.fp16_enabled:
+            logger.warning(
+                "fp16 mode on TPU is kept for parity; bf16 is the recommended "
+                "precision (no loss scaler needed, same MXU throughput)."
+            )
+        vocab_size = self._param_dict.get("vocabulary_size")
+        if vocab_size is not None and vocab_size % 8 != 0:
+            logger.warning(
+                "vocabulary_size %d is not divisible by 8; pad for MXU-friendly "
+                "matmul tiling",
+                vocab_size,
+            )
+        if C.MAX_GRAD_NORM in self._param_dict:
+            logger.warning(
+                "max_grad_norm is deprecated; use gradient_clipping instead"
+            )
+
+    # ------------------------------------------------------------------
+    def print(self, name="DeepSpeedConfig"):
+        logger.info("%s:", name)
+        for key in sorted(self.__dict__):
+            if key.startswith("_"):
+                continue
+            logger.info("  %s %s", f"{key} ".ljust(32, "."), self.__dict__[key])
+
+
+def _default_world_size():
+    try:
+        import jax
+
+        return jax.device_count()
+    except Exception:  # pragma: no cover - jax is always present in practice
+        return 1

@@ -1,0 +1,244 @@
+"""Canonical config keys and defaults.
+
+Every JSON config key the framework understands is declared here as a named
+constant with a ``*_DEFAULT`` companion, mirroring the key surface of the
+reference config system (reference: deepspeed/pt/deepspeed_constants.py:1-287)
+so that configs written for the reference library parse unchanged.
+
+TPU-specific additions (``bf16``, mesh shape knobs) are grouped at the bottom.
+"""
+
+#############################################
+# Routes
+#############################################
+ROUTE_TRAIN = "train"
+ROUTE_EVAL = "eval"
+ROUTE_PREDICT = "predict"
+ROUTE_ENCODE = "encode"
+
+#############################################
+# Batch size
+#############################################
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_BATCH_SIZE_DEFAULT = None
+
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = None
+
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
+
+#############################################
+# Optimizer and lr scheduler
+#############################################
+OPTIMIZER = "optimizer"
+OPTIMIZER_TYPE_DEFAULT = None
+OPTIMIZER_PARAMS = "params"
+TYPE = "type"
+LEGACY_FUSION = "legacy_fusion"
+LEGACY_FUSION_DEFAULT = False
+
+SCHEDULER = "scheduler"
+SCHEDULER_TYPE_DEFAULT = None
+SCHEDULER_PARAMS = "params"
+
+MAX_GRAD_NORM = "max_grad_norm"
+
+# Optimizer names recognized by the engine (reference:
+# deepspeed/pt/deepspeed_light.py:529-543 recognizes Adam and LAMB).
+ADAM_OPTIMIZER = "adam"
+LAMB_OPTIMIZER = "lamb"
+ADAMW_OPTIMIZER = "adamw"
+SGD_OPTIMIZER = "sgd"
+LION_OPTIMIZER = "lion"
+DEEPSPEED_OPTIMIZERS = [
+    ADAM_OPTIMIZER,
+    ADAMW_OPTIMIZER,
+    LAMB_OPTIMIZER,
+    SGD_OPTIMIZER,
+    LION_OPTIMIZER,
+]
+
+#############################################
+# Steps
+#############################################
+STEPS_PER_PRINT = "steps_per_print"
+STEPS_PER_PRINT_DEFAULT = 10
+
+#############################################
+# Training options
+#############################################
+DISABLE_ALLGATHER = "disable_allgather"
+DISABLE_ALLGATHER_DEFAULT = False
+
+PRESCALE_GRADIENTS = "prescale_gradients"
+PRESCALE_GRADIENTS_DEFAULT = False
+
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
+
+SPARSE_GRADIENTS = "sparse_gradients"
+SPARSE_GRADIENTS_DEFAULT = False
+
+ALLREDUCE_ALWAYS_FP32 = "allreduce_always_fp32"
+ALLREDUCE_ALWAYS_FP32_DEFAULT = False
+
+#############################################
+# FP16 support (on TPU: fp16 semantics with loss scaling kept for parity;
+# bf16 is the recommended path and needs no scaler)
+#############################################
+FP16 = "fp16"
+FP16_ENABLED = "enabled"
+FP16_ENABLED_DEFAULT = False
+
+# Loss scale: 0 means dynamic, positive value means static.
+FP16_LOSS_SCALE = "loss_scale"
+FP16_LOSS_SCALE_DEFAULT = 0
+
+FP16_INITIAL_SCALE_POWER = "initial_scale_power"
+FP16_INITIAL_SCALE_POWER_DEFAULT = 32
+
+FP16_LOSS_SCALE_WINDOW = "loss_scale_window"
+FP16_LOSS_SCALE_WINDOW_DEFAULT = 1000
+
+FP16_HYSTERESIS = "hysteresis"
+FP16_HYSTERESIS_DEFAULT = 2
+
+FP16_MIN_LOSS_SCALE = "min_loss_scale"
+FP16_MIN_LOSS_SCALE_DEFAULT = 1
+
+#############################################
+# BF16 (TPU-native precision; no loss scaling required)
+#############################################
+BF16 = "bf16"
+BF16_ENABLED = "enabled"
+BF16_ENABLED_DEFAULT = False
+
+#############################################
+# Gradient clipping
+#############################################
+GRADIENT_CLIPPING = "gradient_clipping"
+GRADIENT_CLIPPING_DEFAULT = 0.0
+
+#############################################
+# Communication options
+#############################################
+ALLGATHER_SIZE = "allgather_size"
+ALLGATHER_SIZE_DEFAULT = 500000000
+
+#############################################
+# ZeRO optimization
+#############################################
+ZERO_OPTIMIZATION = "zero_optimization"
+
+ZERO_STAGE = "stage"
+ZERO_STAGE_DEFAULT = 0
+
+ZERO_OPTIMIZATION_DISABLED = 0
+ZERO_OPTIMIZATION_OPTIMIZER_STATES = 1
+ZERO_OPTIMIZATION_GRADIENTS = 2
+ZERO_OPTIMIZATION_WEIGHTS = 3
+MAX_STAGE_ZERO_OPTIMIZATION = ZERO_OPTIMIZATION_WEIGHTS
+
+ZERO_ALLGATHER_PARTITIONS = "allgather_partitions"
+ZERO_ALLGATHER_PARTITIONS_DEFAULT = True
+
+ZERO_ALLGATHER_BUCKET_SIZE = "allgather_bucket_size"
+ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT = 500000000
+ZERO_ALLGATHER_BUCKET_SIZE_DEPRECATED = "allgather_size"
+
+ZERO_REDUCE_SCATTER = "reduce_scatter"
+ZERO_REDUCE_SCATTER_DEFAULT = True
+
+ZERO_REDUCE_BUCKET_SIZE = "reduce_bucket_size"
+ZERO_REDUCE_BUCKET_SIZE_DEFAULT = 500000000
+
+ZERO_OVERLAP_COMM = "overlap_comm"
+ZERO_OVERLAP_COMM_DEFAULT = False
+
+ZERO_CONTIGUOUS_GRADIENTS = "contiguous_gradients"
+ZERO_CONTIGUOUS_GRADIENTS_DEFAULT = False
+
+ZERO_LOAD_FROM_FP32_WEIGHTS = "load_from_fp32_weights"
+ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT = True
+
+ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"
+ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT = 500000000
+
+#############################################
+# Activation checkpointing
+#############################################
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+
+ACT_CKPT_PARTITION_ACTIVATIONS = "partition_activations"
+ACT_CKPT_PARTITION_ACTIVATIONS_DEFAULT = False
+
+ACT_CKPT_NUMBER_CHECKPOINTS = "number_checkpoints"
+ACT_CKPT_NUMBER_CHECKPOINTS_DEFAULT = None
+
+ACT_CKPT_CONTIGUOUS_MEMORY_OPTIMIZATION = "contiguous_memory_optimization"
+ACT_CKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT = False
+
+ACT_CKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY = "synchronize_checkpoint_boundary"
+ACT_CKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT = False
+
+ACT_CKPT_CPU_CHECKPOINTING = "cpu_checkpointing"
+ACT_CKPT_CPU_CHECKPOINTING_DEFAULT = False
+
+ACT_CKPT_PROFILE = "profile"
+ACT_CKPT_PROFILE_DEFAULT = False
+
+#############################################
+# Logging / observability
+#############################################
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+WALL_CLOCK_BREAKDOWN_DEFAULT = False
+
+MEMORY_BREAKDOWN = "memory_breakdown"
+MEMORY_BREAKDOWN_DEFAULT = False
+
+DUMP_STATE = "dump_state"
+DUMP_STATE_DEFAULT = False
+
+TENSORBOARD = "tensorboard"
+TENSORBOARD_ENABLED = "enabled"
+TENSORBOARD_ENABLED_DEFAULT = False
+TENSORBOARD_OUTPUT_PATH = "output_path"
+TENSORBOARD_OUTPUT_PATH_DEFAULT = ""
+TENSORBOARD_JOB_NAME = "job_name"
+TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
+
+#############################################
+# TPU mesh / parallelism (TPU-native additions; absent from the reference,
+# which delegated model parallelism to an external mpu object)
+#############################################
+MESH = "mesh"
+MESH_DATA_PARALLEL_SIZE = "data_parallel_size"
+MESH_DATA_PARALLEL_SIZE_DEFAULT = None  # None => all remaining devices
+MESH_MODEL_PARALLEL_SIZE = "model_parallel_size"
+MESH_MODEL_PARALLEL_SIZE_DEFAULT = 1
+MESH_SEQUENCE_PARALLEL_SIZE = "sequence_parallel_size"
+MESH_SEQUENCE_PARALLEL_SIZE_DEFAULT = 1
+MESH_PIPELINE_PARALLEL_SIZE = "pipeline_parallel_size"
+MESH_PIPELINE_PARALLEL_SIZE_DEFAULT = 1
+
+# Mesh axis names used throughout the framework.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQUENCE_AXIS = "sequence"
+PIPELINE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+#############################################
+# Checkpoint layout
+#############################################
+MODEL_FILE_PREFIX = "mp_rank_"
+ZERO_FILE_PREFIX = "zero_pp_rank_"
+MODEL_FILE_SUFFIX = "_model_states.msgpack"
+OPTIM_FILE_SUFFIX = "optim_states.msgpack"
+
+#############################################
+# Routine aliases kept for config compatibility
+#############################################
+DEEPSPEED_CONFIG_ARG = "deepspeed_config"
+DEEPSCALE_CONFIG_ARG = "deepscale_config"  # deprecated alias

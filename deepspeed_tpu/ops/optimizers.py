@@ -1,0 +1,283 @@
+"""Core optimizers with a uniform functional interface.
+
+Replaces the reference's optimizer zoo — apex FusedAdam (consumed at
+deepspeed/pt/deepspeed_light.py:536), FusedLamb
+(deepspeed/pt/deepspeed_fused_lamb.py:13-201 + csrc/lamb CUDA kernels) — with
+pure-JAX updates. "Fusion" needs no hand-written kernel here: each leaf's
+update is a handful of elementwise ops that XLA fuses into one or two HBM
+passes; the Pallas variants in ``deepspeed_tpu.ops.pallas`` exist for the
+multi-tensor single-pass flavor on very fragmented pytrees.
+
+LAMB reproduces the reference's trust-ratio semantics (csrc/lamb/
+fused_lamb_cuda_kernel.cu part1-3: Adam update, L2 norms of weight & update,
+``clamp(||w||/||u||, min_coeff, max_coeff)``) including the ``lamb_coeffs``
+introspection surface (deepspeed_fused_lamb.py:183-201).
+
+Interface: ``opt.init(params) -> state``;
+``opt.apply(params, grads, state, lr) -> (new_params, new_state, aux)``.
+``lr`` is a traced scalar so LR schedules don't retrigger compilation.
+All state is fp32 ("master" precision) regardless of param dtype, matching
+the fp32-master-weights design of the reference's FP16 optimizers.
+"""
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def _tree_f32(tree):
+    return jax.tree_util.tree_map(_f32, tree)
+
+
+class Optimizer:
+    """Base class; subclasses implement leaf-wise update math."""
+
+    def init(self, params) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def apply(self, params, grads, state, lr) -> Tuple[Any, Dict[str, Any], Dict]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class Adam(Optimizer):
+    """Adam / AdamW. ``adam_w_mode=True`` decouples weight decay (AdamW);
+    False applies L2-style decay added to the gradient (classic Adam+wd),
+    matching apex FusedAdam's two modes."""
+
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    bias_correction: bool = True
+    adam_w_mode: bool = True
+
+    def init(self, params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "mu": zeros,
+            "nu": jax.tree_util.tree_map(jnp.copy, zeros),
+        }
+
+    def apply(self, params, grads, state, lr):
+        step = state["step"] + 1
+        b1, b2 = self.b1, self.b2
+        if self.bias_correction:
+            c1 = 1.0 - b1 ** step.astype(jnp.float32)
+            c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.float32(1.0)
+
+        def leaf(p, g, m, v):
+            g32 = _f32(g)
+            p32 = _f32(p)
+            if self.weight_decay and not self.adam_w_mode:
+                g32 = g32 + self.weight_decay * p32
+            m_new = b1 * m + (1.0 - b1) * g32
+            v_new = b2 * v + (1.0 - b2) * g32 * g32
+            update = (m_new / c1) / (jnp.sqrt(v_new / c2) + self.eps)
+            if self.weight_decay and self.adam_w_mode:
+                update = update + self.weight_decay * p32
+            p_new = p32 - lr * update
+            return p_new.astype(p.dtype), m_new, v_new
+
+        out = jax.tree_util.tree_map(leaf, params, grads, state["mu"], state["nu"])
+        new_params = jax.tree_util.tree_map(
+            lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        new_mu = jax.tree_util.tree_map(
+            lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        new_nu = jax.tree_util.tree_map(
+            lambda t: t[2], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        return new_params, {"step": step, "mu": new_mu, "nu": new_nu}, {}
+
+
+@dataclasses.dataclass
+class Lamb(Optimizer):
+    """LAMB with the reference's clamped trust ratio.
+
+    Per-leaf (≙ per-layer, LAMB's granularity in the reference's unfused
+    fp32-master path, fp16_unfused_optimizer.py:17):
+      u = adam_update(g) (+ wd * p)
+      ratio = clamp(||p|| / ||u||, min_coeff, max_coeff)   if both norms > 0
+      p <- p - lr * ratio * u
+    ``aux['lamb_coeffs']`` carries the ratios (deepspeed_fused_lamb.py:183-201).
+    """
+
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    bias_correction: bool = True
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+    eps_inside_sqrt: bool = False
+
+    def init(self, params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "mu": zeros,
+            "nu": jax.tree_util.tree_map(jnp.copy, zeros),
+        }
+
+    def apply(self, params, grads, state, lr):
+        step = state["step"] + 1
+        b1, b2 = self.b1, self.b2
+        if self.bias_correction:
+            c1 = 1.0 - b1 ** step.astype(jnp.float32)
+            c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.float32(1.0)
+
+        coeffs = []
+
+        def leaf(p, g, m, v):
+            g32, p32 = _f32(g), _f32(p)
+            m_new = b1 * m + (1.0 - b1) * g32
+            v_new = b2 * v + (1.0 - b2) * g32 * g32
+            if self.eps_inside_sqrt:
+                denom = jnp.sqrt(v_new / c2 + self.eps)
+            else:
+                denom = jnp.sqrt(v_new / c2) + self.eps
+            update = (m_new / c1) / denom
+            if self.weight_decay:
+                update = update + self.weight_decay * p32
+            w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(update * update))
+            ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                jnp.float32(1.0),
+            )
+            coeffs.append(ratio)
+            p_new = p32 - lr * ratio * update
+            return p_new.astype(p.dtype), m_new, v_new
+
+        out = jax.tree_util.tree_map(leaf, params, grads, state["mu"], state["nu"])
+        is_tup = lambda x: isinstance(x, tuple)
+        new_params = jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=is_tup)
+        new_mu = jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=is_tup)
+        new_nu = jax.tree_util.tree_map(lambda t: t[2], out, is_leaf=is_tup)
+        aux = {"lamb_coeffs": coeffs}
+        return new_params, {"step": step, "mu": new_mu, "nu": new_nu}, aux
+
+
+@dataclasses.dataclass
+class SGD(Optimizer):
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+    nesterov: bool = False
+
+    def init(self, params):
+        if self.momentum:
+            return {
+                "step": jnp.zeros((), jnp.int32),
+                "mom": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params
+                ),
+            }
+        return {"step": jnp.zeros((), jnp.int32), "mom": None}
+
+    def apply(self, params, grads, state, lr):
+        step = state["step"] + 1
+
+        if self.momentum:
+
+            def leaf(p, g, m):
+                g32, p32 = _f32(g), _f32(p)
+                if self.weight_decay:
+                    g32 = g32 + self.weight_decay * p32
+                m_new = self.momentum * m + g32
+                d = g32 + self.momentum * m_new if self.nesterov else m_new
+                return (p32 - lr * d).astype(p.dtype), m_new
+
+            out = jax.tree_util.tree_map(leaf, params, grads, state["mom"])
+            is_tup = lambda x: isinstance(x, tuple)
+            new_params = jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=is_tup)
+            new_mom = jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=is_tup)
+            return new_params, {"step": step, "mom": new_mom}, {}
+
+        def leaf_plain(p, g):
+            g32, p32 = _f32(g), _f32(p)
+            if self.weight_decay:
+                g32 = g32 + self.weight_decay * p32
+            return (p32 - lr * g32).astype(p.dtype)
+
+        new_params = jax.tree_util.tree_map(leaf_plain, params, grads)
+        return new_params, {"step": step, "mom": None}, {}
+
+
+@dataclasses.dataclass
+class Lion(Optimizer):
+    """Lion (sign-momentum) — cheap state (one moment), a good fit for
+    ZeRO-1 memory budgets on TPU. Not in the reference; additive."""
+
+    b1: float = 0.9
+    b2: float = 0.99
+    weight_decay: float = 0.0
+
+    def init(self, params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "mu": jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            ),
+        }
+
+    def apply(self, params, grads, state, lr):
+        step = state["step"] + 1
+
+        def leaf(p, g, m):
+            g32, p32 = _f32(g), _f32(p)
+            update = jnp.sign(self.b1 * m + (1.0 - self.b1) * g32)
+            if self.weight_decay:
+                update = update + self.weight_decay * p32
+            m_new = self.b2 * m + (1.0 - self.b2) * g32
+            return (p32 - lr * update).astype(p.dtype), m_new
+
+        out = jax.tree_util.tree_map(leaf, params, grads, state["mu"])
+        is_tup = lambda x: isinstance(x, tuple)
+        new_params = jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=is_tup)
+        new_mu = jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=is_tup)
+        return new_params, {"step": step, "mu": new_mu}, {}
+
+
+def build_optimizer(name: str, params_dict: dict) -> Optimizer:
+    """Instantiate by config name (engine path, mirroring
+    deepspeed_light.py:529-543's named-optimizer selection)."""
+    name = name.lower()
+    kw = dict(params_dict)
+    kw.pop("lr", None)  # lr is supplied per-step by the scheduler
+    betas = kw.pop("betas", None)
+    if betas is not None:
+        kw["b1"], kw["b2"] = betas
+    kw.pop("torch_adam", None)
+    kw.pop("amsgrad", None)
+    if name == "adam":
+        kw.pop("max_grad_norm", None)
+        return Adam(adam_w_mode=kw.pop("adam_w_mode", True), **kw)
+    if name == "adamw":
+        kw.pop("max_grad_norm", None)
+        return Adam(adam_w_mode=True, **kw)
+    if name == "lamb":
+        kw.pop("max_grad_norm", None)
+        return Lamb(**kw)
+    if name == "sgd":
+        return SGD(**kw)
+    if name == "lion":
+        return Lion(**kw)
+    raise ValueError(f"Unknown optimizer '{name}'")

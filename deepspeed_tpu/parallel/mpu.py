@@ -1,0 +1,99 @@
+"""Model-parallel unit (mpu) protocol over mesh axes.
+
+The reference consumed an externally supplied Megatron-style ``mpu`` object
+exposing ``get_{model,data}_parallel_{rank,group,world_size}`` (reference:
+deepspeed/pt/deepspeed_light.py:476-488, deepspeed_utils.py:121-244). Here the
+same protocol is implemented natively on top of the device mesh, so Megatron
+-style training scripts can keep calling it, while internally a "group" is
+just a mesh axis name usable with ``psum``/``all_gather`` etc. under
+``shard_map``.
+
+An external object with the same duck-type is also accepted anywhere an mpu
+is taken (``ExternalMpuAdapter`` wraps it), preserving the reference's
+hook-based TP integration point.
+"""
+
+import jax
+
+from . import mesh as mesh_lib
+
+
+class TPUMpu:
+    """Mesh-backed mpu. "Groups" are axis names, "ranks" are process-level
+    coordinates (meaningful under multi-host; 0 in single-process tests)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    # --- sizes ---------------------------------------------------------
+    def get_model_parallel_world_size(self):
+        return self.mesh.shape[mesh_lib.MODEL_AXIS]
+
+    def get_data_parallel_world_size(self):
+        return self.mesh.shape[mesh_lib.DATA_AXIS]
+
+    def get_sequence_parallel_world_size(self):
+        return self.mesh.shape[mesh_lib.SEQ_AXIS]
+
+    def get_pipeline_parallel_world_size(self):
+        return self.mesh.shape[mesh_lib.PIPE_AXIS]
+
+    # --- "groups": mesh axis names, usable inside shard_map ------------
+    def get_model_parallel_group(self):
+        return mesh_lib.MODEL_AXIS
+
+    def get_data_parallel_group(self):
+        return mesh_lib.DATA_AXIS
+
+    def get_sequence_parallel_group(self):
+        return mesh_lib.SEQ_AXIS
+
+    def get_pipeline_parallel_group(self):
+        return mesh_lib.PIPE_AXIS
+
+    # --- ranks ---------------------------------------------------------
+    # Under a single-controller JAX program every process drives the whole
+    # mesh; rank here means "this process's position", used only for
+    # checkpoint file naming and rank-filtered logging.
+    def _process_coords(self):
+        local = jax.local_devices()
+        if not local:
+            return {a: 0 for a in mesh_lib.MESH_AXES}
+        try:
+            import numpy as np
+
+            idx = {d: i for i, d in enumerate(self.mesh.devices.flat)}
+            flat_pos = idx[local[0]]
+            unr = np.unravel_index(flat_pos, self.mesh.devices.shape)
+            return dict(zip(self.mesh.axis_names, (int(u) for u in unr)))
+        except Exception:
+            return {a: 0 for a in mesh_lib.MESH_AXES}
+
+    def get_model_parallel_rank(self):
+        return self._process_coords()[mesh_lib.MODEL_AXIS]
+
+    def get_data_parallel_rank(self):
+        return self._process_coords()[mesh_lib.DATA_AXIS]
+
+    def get_pipeline_parallel_rank(self):
+        return self._process_coords()[mesh_lib.PIPE_AXIS]
+
+
+class ExternalMpuAdapter:
+    """Wrap a Megatron-style mpu object; pass-through of the reference
+    protocol so user-supplied mpus keep working (deepspeed_light.py:476-488)."""
+
+    def __init__(self, mpu):
+        self._mpu = mpu
+
+    def __getattr__(self, name):
+        return getattr(self._mpu, name)
+
+
+def as_mpu(obj, mesh=None):
+    if obj is None:
+        assert mesh is not None
+        return TPUMpu(mesh)
+    if isinstance(obj, (TPUMpu, ExternalMpuAdapter)):
+        return obj
+    return ExternalMpuAdapter(obj)

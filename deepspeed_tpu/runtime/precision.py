@@ -1,0 +1,232 @@
+"""Loss scaling for fp16 training, as a pure jit-safe state machine.
+
+Semantics parity with the reference's loss_scaler.py (reference:
+deepspeed/pt/loss_scaler.py:56-166): static scale, and dynamic scaling with
+init 2**32, x2 growth after ``scale_window`` consecutive overflow-free steps,
+/2 shrink on overflow floored at ``min_scale``, and hysteresis
+(``delayed_shift`` / ``consecutive_hysteresis``) that absorbs the first
+overflows before shrinking.
+
+TPU-first divergence: the scaler is a pytree (``LossScaleState``) updated by a
+pure function so the whole train step — including the data-dependent
+overflow branch — stays inside one ``jit`` using ``jnp.where`` arithmetic
+(SURVEY.md §7 hard part (b)). The reference's mutable ``DynamicLossScaler``
+class API is preserved as a thin host-side wrapper for users who poke at
+``optimizer.loss_scale`` / ``optimizer.overflow`` directly.
+
+bf16 needs none of this; `no_loss_scale_state()` provides the identity scaler
+so the engine has one code path.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LossScaleState:
+    """Dynamic loss-scale state carried through the jitted train step.
+
+    The three array fields are pytree data; the config fields are static
+    metadata baked into the jit trace (they never change mid-run).
+    """
+
+    loss_scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 scalar: overflow-free steps since last change
+    hysteresis: jnp.ndarray  # i32 scalar: remaining overflow tolerance
+    scale_window: int = dataclasses.field(default=1000, metadata=dict(static=True))
+    scale_factor: float = dataclasses.field(default=2.0, metadata=dict(static=True))
+    min_scale: float = dataclasses.field(default=1.0, metadata=dict(static=True))
+    delayed_shift: int = dataclasses.field(default=1, metadata=dict(static=True))
+    consecutive_hysteresis: bool = dataclasses.field(
+        default=False, metadata=dict(static=True)
+    )
+    dynamic: bool = dataclasses.field(default=True, metadata=dict(static=True))
+
+    def _replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+def dynamic_loss_scale_state(
+    init_scale=2.0**32,
+    scale_window=1000,
+    scale_factor=2.0,
+    min_scale=1.0,
+    delayed_shift=1,
+    consecutive_hysteresis=False,
+):
+    return LossScaleState(
+        loss_scale=jnp.float32(init_scale),
+        good_steps=jnp.int32(0),
+        hysteresis=jnp.int32(delayed_shift),
+        scale_window=scale_window,
+        scale_factor=scale_factor,
+        min_scale=min_scale,
+        delayed_shift=delayed_shift,
+        consecutive_hysteresis=consecutive_hysteresis,
+        dynamic=True,
+    )
+
+
+def static_loss_scale_state(scale):
+    return LossScaleState(
+        loss_scale=jnp.float32(scale),
+        good_steps=jnp.int32(0),
+        hysteresis=jnp.int32(1),
+        dynamic=False,
+    )
+
+
+def no_loss_scale_state():
+    """Identity scaler for bf16/fp32 paths."""
+    return static_loss_scale_state(1.0)
+
+
+def scale_loss(loss, state: LossScaleState):
+    return loss * state.loss_scale.astype(loss.dtype)
+
+
+def unscale(tree, state: LossScaleState):
+    import jax
+
+    inv = 1.0 / state.loss_scale
+    return jax.tree_util.tree_map(lambda g: g * inv.astype(g.dtype), tree)
+
+
+def update_scale(state: LossScaleState, overflow) -> LossScaleState:
+    """Pure jit-safe transition function; `overflow` is a bool scalar array.
+
+    Mirrors DynamicLossScaler.update_scale (reference loss_scaler.py:151-166):
+      overflow & hysteresis exhausted -> scale = max(scale/factor, min_scale)
+      overflow & hysteresis remaining -> decrement hysteresis, keep scale
+      scale_window clean steps        -> scale *= factor
+                                         (+ refill hysteresis if consecutive)
+    """
+    if not state.dynamic:
+        return state
+
+    overflow = jnp.asarray(overflow)
+    hyst_exhausted = state.hysteresis <= 1
+
+    shrunk = jnp.maximum(state.loss_scale / state.scale_factor, state.min_scale)
+    scale_after_overflow = jnp.where(hyst_exhausted, shrunk, state.loss_scale)
+    hyst_after_overflow = jnp.where(
+        hyst_exhausted, state.hysteresis, state.hysteresis - 1
+    )
+
+    window_done = (state.good_steps + 1) % state.scale_window == 0
+    grown = state.loss_scale * state.scale_factor
+    scale_after_good = jnp.where(window_done, grown, state.loss_scale)
+    if state.consecutive_hysteresis:
+        # refilled on every clean step
+        hyst_after_good = jnp.int32(state.delayed_shift)
+    else:
+        # refilled when a full clean window completes (matches the mutable
+        # DynamicLossScaler below and the reference's update_scale)
+        hyst_after_good = jnp.where(
+            window_done, jnp.int32(state.delayed_shift), state.hysteresis
+        )
+
+    return state._replace(
+        loss_scale=jnp.where(overflow, scale_after_overflow, scale_after_good),
+        good_steps=jnp.where(overflow, 0, state.good_steps + 1).astype(jnp.int32),
+        hysteresis=jnp.where(overflow, hyst_after_overflow, hyst_after_good).astype(
+            jnp.int32
+        ),
+    )
+
+
+def loss_scale_state_from_config(config):
+    """Build the right scaler from a DeepSpeedConfig."""
+    if config.fp16_enabled:
+        if config.dynamic_loss_scale:
+            return dynamic_loss_scale_state(
+                init_scale=2.0**config.initial_scale_power,
+                scale_window=config.loss_scale_window,
+                min_scale=config.min_loss_scale,
+                delayed_shift=config.hysteresis,
+                consecutive_hysteresis=False,
+            )
+        return static_loss_scale_state(config.loss_scale)
+    return no_loss_scale_state()
+
+
+# ---------------------------------------------------------------------------
+# Reference-shaped mutable wrappers (host-side convenience only)
+# ---------------------------------------------------------------------------
+class LossScalerBase:
+    def __init__(self, scale):
+        self.cur_scale = float(scale)
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        import jax
+
+        return jax.tree_util.tree_map(lambda g: g * self.cur_scale, grads)
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss):
+        """Return the scaled loss (JAX has no .backward(); the engine applies
+        the scale inside its jitted value_and_grad)."""
+        return loss * self.cur_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scaler (reference loss_scaler.py:56-76)."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Mutable dynamic scaler with reference semantics (loss_scaler.py:79-166)."""
+
+    def __init__(
+        self,
+        init_scale=2.0**32,
+        scale_factor=2.0,
+        scale_window=1000,
+        min_scale=1.0,
+        delayed_shift=1,
+        consecutive_hysteresis=False,
+    ):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def update_scale(self, overflow):
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                self.cur_scale = max(self.cur_scale / self.scale_factor, self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1

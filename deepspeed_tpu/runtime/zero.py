@@ -1,0 +1,152 @@
+"""ZeRO on a TPU mesh: partitioning as sharding specs.
+
+The reference implements ZeRO with imperative machinery — flattened fp16
+buffers split into rank ranges, backward hooks feeding bucketed reductions,
+explicit reduce/reduce_scatter/all_gather calls (reference:
+deepspeed/pt/deepspeed_zero_optimizer.py:102-1552 for stage 2,
+zero_optimizer_stage1.py:112-996 for stage 1). On TPU the same *capability*
+collapses into sharding declarations and XLA-inserted collectives:
+
+  stage 0  — grads + optimizer state replicated; XLA all-reduces grads.
+  stage 1  — optimizer state (fp32 master moments) sharded over the ``data``
+             axis; XLA turns the grad all-reduce feeding the sharded update
+             into reduce-scatter + all-gather of the param update
+             (the reference's "partition-aware" comm,
+             docs/_posts/2020-03-17-reduce-scatter.md).
+  stage 2  — gradients ALSO carry the sharded layout (the accumulation
+             buffer between micro-steps is stored sharded), so grad memory
+             per chip drops by 1/dp and the reduce is a psum_scatter.
+  stage 3  — parameters sharded too (the reference only defined the constant
+             and raised NotImplementedError, deepspeed_constants.py:167,
+             deepspeed_light.py:619-620; on a mesh it is one more spec).
+
+Per-leaf partitioning rule: shard the largest dimension divisible by the
+data-axis size; leaves with no such dimension stay replicated (the
+reference's analogous edge case is `zero_empty_partition` — more ranks than
+elements — tested in tests/unit/test_fp16.py). This keeps every array's
+layout tile-friendly (no flatten-and-split of individual tensors, which
+would fight XLA's tiled memory format).
+"""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import constants as C
+from ..parallel import mesh as mesh_lib
+
+
+def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=None):
+    """Choose the PartitionSpec sharding one dim of ``shape`` over the data axis.
+
+    Respects ``existing_spec`` (e.g. a model-parallel sharding) by only
+    placing the data axis on a currently-unsharded dimension.
+    """
+    existing = tuple(existing_spec) if existing_spec is not None else ()
+    existing = existing + (None,) * (len(shape) - len(existing))
+    if dp_size <= 1:
+        return PartitionSpec(*existing) if existing_spec is not None else PartitionSpec()
+    best_dim, best_size = None, 0
+    for i, d in enumerate(shape):
+        if existing[i] is not None:
+            continue
+        if d % dp_size == 0 and d > best_size:
+            best_dim, best_size = i, d
+    if best_dim is None:
+        return PartitionSpec(*existing) if existing_spec is not None else PartitionSpec()
+    new = list(existing)
+    new[best_dim] = axis_name
+    return PartitionSpec(*new)
+
+
+def zero_param_specs(params, dp_size, stage, model_specs=None):
+    """Partition specs for *parameters* (sharded only at stage 3)."""
+
+    def spec(path, leaf):
+        ms = _lookup(model_specs, path)
+        if stage >= C.ZERO_OPTIMIZATION_WEIGHTS:
+            return leaf_partition_spec(leaf.shape, dp_size, existing_spec=ms)
+        return ms if ms is not None else PartitionSpec()
+
+    return _tree_map_with_path(spec, params)
+
+
+def zero_grad_specs(params, dp_size, stage, model_specs=None):
+    """Partition specs for the gradient-accumulation buffer (stage >= 2 shards)."""
+
+    def spec(path, leaf):
+        ms = _lookup(model_specs, path)
+        if stage >= C.ZERO_OPTIMIZATION_GRADIENTS:
+            return leaf_partition_spec(leaf.shape, dp_size, existing_spec=ms)
+        return ms if ms is not None else PartitionSpec()
+
+    return _tree_map_with_path(spec, params)
+
+
+def zero_optstate_specs(params, dp_size, stage, model_specs=None):
+    """Partition specs for per-param optimizer state (moments, master copy);
+    sharded from stage >= 1."""
+
+    def spec(path, leaf):
+        ms = _lookup(model_specs, path)
+        if stage >= C.ZERO_OPTIMIZATION_OPTIMIZER_STATES:
+            return leaf_partition_spec(leaf.shape, dp_size, existing_spec=ms)
+        return ms if ms is not None else PartitionSpec()
+
+    return _tree_map_with_path(spec, params)
+
+
+def specs_to_shardings(specs, mesh: Mesh):
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def constrain(tree, specs):
+    """with_sharding_constraint over a pytree of PartitionSpecs (jit-safe)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, s),
+        tree,
+        specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def optstate_specs_like(opt_state, param_specs, params):
+    """Map param specs onto an optax-style optimizer state pytree.
+
+    Any optimizer-state leaf whose shape matches its corresponding param
+    gets the param's spec; scalar leaves (step counts etc.) are replicated.
+    """
+    flat_params, _ = jax.tree_util.tree_flatten(params)
+    flat_specs = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    shape_to_spec = {}
+    for p, s in zip(flat_params, flat_specs):
+        shape_to_spec.setdefault(tuple(p.shape), s)
+
+    def spec_for(leaf):
+        s = shape_to_spec.get(tuple(getattr(leaf, "shape", ())))
+        return s if s is not None else PartitionSpec()
+
+    return jax.tree_util.tree_map(spec_for, opt_state)
+
+
+# ---------------------------------------------------------------------------
+def _tree_map_with_path(fn, tree):
+    return jax.tree_util.tree_map_with_path(fn, tree)
+
+
+def _lookup(model_specs, path):
+    if model_specs is None:
+        return None
+    try:
+        node = model_specs
+        for k in path:
+            key = getattr(k, "key", getattr(k, "idx", None))
+            node = node[key]
+        return node if isinstance(node, PartitionSpec) else None
+    except Exception:
+        return None

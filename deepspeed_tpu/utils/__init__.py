@@ -1,0 +1,10 @@
+from .logging import LoggerFactory, log_dist, logger
+from .timers import SynchronizedWallClockTimer, ThroughputTimer
+
+__all__ = [
+    "LoggerFactory",
+    "log_dist",
+    "logger",
+    "SynchronizedWallClockTimer",
+    "ThroughputTimer",
+]

@@ -1,0 +1,48 @@
+"""Logger factory and rank-filtered logging.
+
+Parity with the reference's log_utils (reference: deepspeed/pt/log_utils.py:7-60):
+a single shared logger plus ``log_dist(msg, ranks=[...])`` which only emits on
+the listed process ranks (-1 meaning "all ranks").
+"""
+
+import logging
+import sys
+
+_LOGGER_NAME = "DeepSpeedTPU"
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name=_LOGGER_NAME, level=logging.INFO):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+        )
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setFormatter(formatter)
+            logger_.addHandler(handler)
+        return logger_
+
+
+logger = LoggerFactory.create_logger()
+
+
+def _current_rank():
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the given process ranks (None / [-1] => all)."""
+    my_rank = _current_rank()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, "[Rank %s] %s", my_rank, message)

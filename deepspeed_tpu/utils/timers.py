@@ -1,0 +1,174 @@
+"""Wall-clock and throughput timers.
+
+Parity with the reference's deepspeed_timer.py:
+- ``SynchronizedWallClockTimer`` (reference: deepspeed/pt/deepspeed_timer.py:20-94):
+  named start/stop timers; on TPU the device fence is
+  ``jax.block_until_ready`` / ``jax.effects_barrier`` instead of
+  ``torch.cuda.synchronize``.
+- ``ThroughputTimer`` (reference :97-171): samples/sec with a warmup
+  ``start_step``, periodic reporting, host memory monitoring via psutil when
+  available.
+"""
+
+import time
+
+from .logging import log_dist, logger
+
+
+def _device_sync():
+    """Block until all dispatched device work is done (timing fence)."""
+    try:
+        import jax
+
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    class Timer:
+        def __init__(self, name, synchronize=True):
+            self.name_ = name
+            self.synchronize = synchronize
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = 0.0
+
+        def start(self):
+            assert not self.started_, f"timer {self.name_} has already been started"
+            if self.synchronize:
+                _device_sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self):
+            assert self.started_, f"timer {self.name_} is not started"
+            if self.synchronize:
+                _device_sync()
+            self.elapsed_ += time.time() - self.start_time
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def elapsed(self, reset=True):
+            started = self.started_
+            if started:
+                self.stop()
+            elapsed = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return elapsed
+
+    def __init__(self, synchronize=True):
+        self.timers = {}
+        self.synchronize = synchronize
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name, synchronize=self.synchronize)
+        return self.timers[name]
+
+    def has_timer(self, name):
+        return name in self.timers
+
+    def log(self, names, normalizer=1.0, reset=True, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+    @staticmethod
+    def memory_usage():
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"device mem: {in_use:.2f} GB in use | {peak:.2f} GB peak"
+        except Exception:
+            return "device mem: n/a"
+
+
+class ThroughputTimer:
+    def __init__(
+        self,
+        batch_size,
+        num_workers,
+        start_step=2,
+        steps_per_output=50,
+        monitor_memory=True,
+        logging_fn=None,
+    ):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size or 1)
+        self.num_workers = num_workers
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.local_step_count = 0
+        self.total_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or logger.info
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.local_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.total_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.total_step_count += 1
+        self.local_step_count += 1
+        if self.total_step_count > self.start_step:
+            _device_sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            if report_speed and self.local_step_count % self.steps_per_output == 0:
+                self.logging(
+                    "{}/{}, SamplesPerSec={:.3f}".format(
+                        self.epoch_count,
+                        self.local_step_count,
+                        self.avg_samples_per_sec(),
+                    )
+                )
+                if self.monitor_memory:
+                    try:
+                        import psutil
+
+                        vm = psutil.virtual_memory()
+                        self.logging(
+                            f"{self.epoch_count}/{self.local_step_count}, "
+                            f"vm percent: {vm.percent}"
+                        )
+                    except ImportError:
+                        pass
+
+    def avg_samples_per_sec(self):
+        if self.total_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.total_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return float("-inf")

@@ -1,0 +1,89 @@
+"""Tiny model fixtures (the analog of the reference's
+tests/unit/simple_model.py: SimpleModel + random dataloaders)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """Linear stack + cross-entropy loss; __call__(x, y) -> scalar loss,
+    matching the reference fixture's contract (simple_model.py:7-23)."""
+
+    hidden_dim: int
+    num_classes: int = 10
+    empty_grad: bool = False  # second layer that never sees gradients
+
+    @nn.compact
+    def __call__(self, x, y):
+        h = nn.Dense(self.hidden_dim, name="linear")(x)
+        if self.empty_grad:
+            # Parameters exist but are unused in the loss — the analog of
+            # the reference's rank-asymmetric missing-grad layer.
+            nn.Dense(self.hidden_dim, name="unused")
+        logits = nn.Dense(self.num_classes, name="head")(h)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
+        return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+class SimpleMLPWithDropout(nn.Module):
+    hidden_dim: int
+    num_classes: int = 10
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, y, train: bool = True):
+        h = nn.Dense(self.hidden_dim)(x)
+        h = nn.relu(h)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        logits = nn.Dense(self.num_classes)(h)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
+        return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def init_model(model, input_dim, seed=0):
+    rng = jax.random.PRNGKey(seed)
+    x = jnp.ones((2, input_dim), jnp.float32)
+    y = jnp.zeros((2,), jnp.int32)
+    variables = model.init({"params": rng, "dropout": rng}, x, y)
+    return variables["params"]
+
+
+def random_dataset(num_samples, input_dim, num_classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(num_samples, input_dim)).astype(np.float32)
+    w = rng.normal(size=(input_dim, num_classes)).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.normal(size=(num_samples, num_classes)), axis=-1)
+    return x, y.astype(np.int32)
+
+
+def config_dict(
+    batch_size=16,
+    micro_batch=None,
+    accum=1,
+    fp16=False,
+    bf16=False,
+    zero_stage=0,
+    optimizer="Adam",
+    lr=1e-2,
+    **extra,
+):
+    cfg = {
+        "train_batch_size": batch_size,
+        "gradient_accumulation_steps": accum,
+        "steps_per_print": 1000,
+        "optimizer": {"type": optimizer, "params": {"lr": lr}},
+    }
+    if micro_batch:
+        cfg["train_micro_batch_size_per_gpu"] = micro_batch
+    if fp16:
+        cfg["fp16"] = {"enabled": True, **extra.pop("fp16_opts", {})}
+    if bf16:
+        cfg["bf16"] = {"enabled": True}
+    if zero_stage:
+        cfg["zero_optimization"] = {"stage": zero_stage}
+    cfg.update(extra)
+    return cfg

@@ -1,0 +1,268 @@
+"""Config-system tests.
+
+Coverage mirrors the reference's tests/unit/test_config.py +
+test_ds_config.py: batch-size triangle resolution in every combination,
+consistency assertion, duplicate-key rejection, zero/fp16/scheduler blocks,
+deprecated forms.
+"""
+
+import pytest
+
+from deepspeed_tpu.config import (
+    DeepSpeedConfig,
+    DeepSpeedConfigError,
+    loads_config_json,
+)
+
+
+def make(config_dict, world_size=1):
+    return DeepSpeedConfig(None, param_dict=config_dict, world_size=world_size)
+
+
+# ---------------------------------------------------------------- batch triangle
+def test_batch_all_three_consistent():
+    cfg = make(
+        {
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+        },
+        world_size=4,
+    )
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_all_three_inconsistent():
+    with pytest.raises(DeepSpeedConfigError):
+        make(
+            {
+                "train_batch_size": 32,
+                "train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 4,
+            },
+            world_size=4,
+        )
+
+
+def test_batch_train_and_micro():
+    cfg = make(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, world_size=4
+    )
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_train_and_accum():
+    cfg = make(
+        {"train_batch_size": 64, "gradient_accumulation_steps": 4}, world_size=4
+    )
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_micro_and_accum():
+    cfg = make(
+        {"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 4},
+        world_size=4,
+    )
+    assert cfg.train_batch_size == 64
+
+
+def test_batch_train_only():
+    cfg = make({"train_batch_size": 64}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 16
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_micro_only():
+    cfg = make({"train_micro_batch_size_per_gpu": 16}, world_size=4)
+    assert cfg.train_batch_size == 64
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_none_given():
+    with pytest.raises(DeepSpeedConfigError):
+        make({}, world_size=4)
+
+
+def test_batch_not_divisible():
+    with pytest.raises(DeepSpeedConfigError):
+        make({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4}, world_size=4)
+
+
+def test_batch_zero_invalid():
+    with pytest.raises(DeepSpeedConfigError):
+        make({"train_batch_size": 0}, world_size=1)
+
+
+# ---------------------------------------------------------------- json handling
+def test_duplicate_keys_rejected():
+    with pytest.raises(ValueError):
+        loads_config_json('{"train_batch_size": 4, "train_batch_size": 8}')
+
+
+def test_nested_duplicate_keys_rejected():
+    with pytest.raises(ValueError):
+        loads_config_json(
+            '{"fp16": {"enabled": true, "enabled": false}, "train_batch_size": 4}'
+        )
+
+
+def test_config_from_file(tmp_config_file):
+    path = tmp_config_file({"train_batch_size": 16, "fp16": {"enabled": True}})
+    cfg = DeepSpeedConfig(path, world_size=2)
+    assert cfg.train_batch_size == 16
+    assert cfg.fp16_enabled
+
+
+# ---------------------------------------------------------------- sub-configs
+def test_zero_dict_form():
+    cfg = make(
+        {
+            "train_batch_size": 4,
+            "fp16": {"enabled": True},
+            "zero_optimization": {
+                "stage": 2,
+                "allgather_bucket_size": 1234,
+                "overlap_comm": True,
+            },
+        }
+    )
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 2
+    assert cfg.zero_config.allgather_bucket_size == 1234
+    assert cfg.zero_config.overlap_comm is True
+    assert cfg.zero_config.reduce_scatter is True  # default
+
+
+def test_zero_deprecated_bool_form():
+    cfg = make(
+        {"train_batch_size": 4, "fp16": {"enabled": True}, "zero_optimization": True}
+    )
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_zero_disabled_by_default():
+    cfg = make({"train_batch_size": 4})
+    assert not cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 0
+
+
+def test_zero_stage_too_high():
+    with pytest.raises(DeepSpeedConfigError):
+        make(
+            {
+                "train_batch_size": 4,
+                "fp16": {"enabled": True},
+                "zero_optimization": {"stage": 4},
+            }
+        )
+
+
+def test_fp16_block():
+    cfg = make(
+        {
+            "train_batch_size": 4,
+            "fp16": {
+                "enabled": True,
+                "loss_scale": 0,
+                "initial_scale_power": 16,
+                "loss_scale_window": 500,
+                "hysteresis": 3,
+                "min_loss_scale": 2,
+            },
+        }
+    )
+    assert cfg.fp16_enabled
+    assert cfg.dynamic_loss_scale
+    assert cfg.initial_scale_power == 16
+    assert cfg.loss_scale_window == 500
+    assert cfg.hysteresis == 3
+    assert cfg.min_loss_scale == 2
+
+
+def test_static_loss_scale():
+    cfg = make({"train_batch_size": 4, "fp16": {"enabled": True, "loss_scale": 128}})
+    assert not cfg.dynamic_loss_scale
+    assert cfg.loss_scale == 128
+
+
+def test_fp16_and_bf16_conflict():
+    with pytest.raises(DeepSpeedConfigError):
+        make(
+            {
+                "train_batch_size": 4,
+                "fp16": {"enabled": True},
+                "bf16": {"enabled": True},
+            }
+        )
+
+
+def test_bf16_block():
+    cfg = make({"train_batch_size": 4, "bf16": {"enabled": True}})
+    assert cfg.bf16_enabled and not cfg.fp16_enabled
+
+
+def test_optimizer_and_scheduler_blocks():
+    cfg = make(
+        {
+            "train_batch_size": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.0015}},
+            "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        }
+    )
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 0.0015
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params["warmup_num_steps"] == 10
+
+
+def test_activation_checkpointing_block():
+    cfg = make(
+        {
+            "train_batch_size": 4,
+            "activation_checkpointing": {
+                "partition_activations": True,
+                "number_checkpoints": 4,
+                "cpu_checkpointing": True,
+            },
+        }
+    )
+    acfg = cfg.activation_checkpointing_config
+    assert acfg.partition_activations
+    assert acfg.number_checkpoints == 4
+    assert acfg.cpu_checkpointing
+
+
+def test_gradient_clipping_and_misc():
+    cfg = make(
+        {
+            "train_batch_size": 4,
+            "gradient_clipping": 1.0,
+            "prescale_gradients": True,
+            "gradient_predivide_factor": 2.0,
+            "sparse_gradients": True,
+            "steps_per_print": 7,
+            "wall_clock_breakdown": True,
+        }
+    )
+    assert cfg.gradient_clipping == 1.0
+    assert cfg.prescale_gradients
+    assert cfg.gradient_predivide_factor == 2.0
+    assert cfg.sparse_gradients_enabled
+    assert cfg.steps_per_print == 7
+    assert cfg.wall_clock_breakdown
+
+
+def test_mesh_block():
+    cfg = make(
+        {
+            "train_batch_size": 8,
+            "mesh": {"model_parallel_size": 2, "sequence_parallel_size": 2},
+        },
+        world_size=2,
+    )
+    assert cfg.model_parallel_size == 2
+    assert cfg.sequence_parallel_size == 2
+    assert cfg.pipeline_parallel_size == 1

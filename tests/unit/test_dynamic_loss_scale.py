@@ -1,0 +1,148 @@
+"""Dynamic loss-scale semantics.
+
+Mirrors the reference's tests/unit/test_dynamic_loss_scale.py: exact scale
+values through overflow/halve and raise schedules, skipped-step behavior,
+hysteresis. Exercises BOTH the pure jit-safe state machine (the one the
+engine uses inside jit) and the reference-shaped mutable class.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.precision import (
+    DynamicLossScaler,
+    dynamic_loss_scale_state,
+    static_loss_scale_state,
+    update_scale,
+)
+from deepspeed_tpu.utils.numerics import global_norm, has_overflow
+
+
+def test_pure_scaler_halves_on_overflow():
+    state = dynamic_loss_scale_state(init_scale=2.0**8, scale_window=1000)
+    state = update_scale(state, jnp.asarray(True))
+    assert float(state.loss_scale) == 2.0**7
+    state = update_scale(state, jnp.asarray(True))
+    assert float(state.loss_scale) == 2.0**6
+    assert int(state.good_steps) == 0
+
+
+def test_pure_scaler_doubles_after_window():
+    window = 4
+    state = dynamic_loss_scale_state(init_scale=2.0**4, scale_window=window)
+    for _ in range(window):
+        state = update_scale(state, jnp.asarray(False))
+    assert float(state.loss_scale) == 2.0**5
+    # window resets: not doubled again until another full window
+    for _ in range(window - 1):
+        state = update_scale(state, jnp.asarray(False))
+    assert float(state.loss_scale) == 2.0**5
+    state = update_scale(state, jnp.asarray(False))
+    assert float(state.loss_scale) == 2.0**6
+
+
+def test_pure_scaler_min_scale_floor():
+    state = dynamic_loss_scale_state(init_scale=4.0, scale_window=100, min_scale=1.0)
+    for _ in range(10):
+        state = update_scale(state, jnp.asarray(True))
+    assert float(state.loss_scale) == 1.0
+
+
+def test_pure_scaler_hysteresis():
+    # delayed_shift=2: the first overflow only burns hysteresis.
+    state = dynamic_loss_scale_state(
+        init_scale=2.0**8, scale_window=1000, delayed_shift=2
+    )
+    state = update_scale(state, jnp.asarray(True))
+    assert float(state.loss_scale) == 2.0**8
+    assert int(state.hysteresis) == 1
+    state = update_scale(state, jnp.asarray(True))
+    assert float(state.loss_scale) == 2.0**7
+
+
+def test_pure_scaler_under_jit():
+    state = dynamic_loss_scale_state(init_scale=2.0**8, scale_window=2)
+
+    @jax.jit
+    def step(s, overflow):
+        return update_scale(s, overflow)
+
+    state = step(state, jnp.asarray(True))
+    assert float(state.loss_scale) == 2.0**7
+    state = step(state, jnp.asarray(False))
+    state = step(state, jnp.asarray(False))
+    assert float(state.loss_scale) == 2.0**8
+
+
+def test_static_scaler_never_changes():
+    state = static_loss_scale_state(128.0)
+    for ov in (True, False, True):
+        state = update_scale(state, jnp.asarray(ov))
+    assert float(state.loss_scale) == 128.0
+
+
+def test_overflow_every_two_steps_schedule():
+    # Mirrors reference test: overflow every N steps keeps halving.
+    state = dynamic_loss_scale_state(init_scale=2.0**16, scale_window=1000)
+    expected = 2.0**16
+    for i in range(6):
+        overflow = i % 2 == 1
+        state = update_scale(state, jnp.asarray(overflow))
+        if overflow:
+            expected /= 2
+        assert float(state.loss_scale) == expected
+
+
+# ------------------------------------------------------------ mutable wrapper
+def test_class_scaler_matches_pure():
+    cls = DynamicLossScaler(init_scale=2.0**10, scale_window=3, min_scale=1.0)
+    pure = dynamic_loss_scale_state(init_scale=2.0**10, scale_window=3, min_scale=1.0)
+    pattern = [False, False, True, False, False, False, True, True, False]
+    for ov in pattern:
+        cls.update_scale(ov)
+        pure = update_scale(pure, jnp.asarray(ov))
+        assert float(pure.loss_scale) == cls.cur_scale
+
+
+# ------------------------------------------------------------ overflow/norms
+def test_has_overflow():
+    good = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    assert not bool(has_overflow(good))
+    bad = {"a": jnp.array([1.0, jnp.inf]), "b": jnp.zeros((2,))}
+    assert bool(has_overflow(bad))
+    nan = {"a": jnp.array([jnp.nan])}
+    assert bool(has_overflow(nan))
+
+
+def test_global_norm():
+    tree = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}
+    np.testing.assert_allclose(float(global_norm(tree)), 5.0, rtol=1e-6)
+    inf_tree = {"a": jnp.array([jnp.inf])}
+    assert float(global_norm(inf_tree)) == -1.0
+
+
+def test_pure_scaler_hysteresis_refill_after_clean_window():
+    # non-consecutive hysteresis refills when a full clean window passes
+    state = dynamic_loss_scale_state(
+        init_scale=2.0**8, scale_window=3, delayed_shift=2
+    )
+    state = update_scale(state, jnp.asarray(True))  # burns hysteresis -> 1
+    assert int(state.hysteresis) == 1
+    for _ in range(3):  # clean window
+        state = update_scale(state, jnp.asarray(False))
+    assert int(state.hysteresis) == 2  # refilled
+    state = update_scale(state, jnp.asarray(True))
+    assert float(state.loss_scale) == 2.0**9  # absorbed again (scale was doubled)
+
+
+def test_class_scaler_matches_pure_with_hysteresis():
+    cls = DynamicLossScaler(init_scale=2.0**10, scale_window=3, delayed_shift=3)
+    pure = dynamic_loss_scale_state(
+        init_scale=2.0**10, scale_window=3, delayed_shift=3
+    )
+    pattern = [True, False, False, False, True, True, True, False, True]
+    for ov in pattern:
+        cls.update_scale(ov)
+        pure = update_scale(pure, jnp.asarray(ov))
+        assert float(pure.loss_scale) == cls.cur_scale, pattern

@@ -1,0 +1,122 @@
+"""LR schedule tests (coverage analog of the reference's schedule params in
+tests + CLI plumbing behavior)."""
+
+import argparse
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupLR,
+    WarmupDecayLR,
+    add_tuning_arguments,
+    build_lr_scheduler,
+    get_config_from_args,
+)
+
+
+def test_warmup_lr_ramp():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+    lrs = [s.step() for _ in range(15)]
+    assert lrs[0] < lrs[5] < lrs[9]
+    assert all(lr == pytest.approx(0.1) for lr in lrs[10:])
+
+
+def test_warmup_decay_lr():
+    s = WarmupDecayLR(
+        warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=5, total_num_steps=15
+    )
+    lrs = [s.step() for _ in range(16)]
+    assert max(lrs) == pytest.approx(0.1)
+    assert lrs[-1] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_lr_range_test_continuous_and_staircase():
+    cont = LRRangeTest(
+        lr_range_test_min_lr=0.01, lr_range_test_step_size=5, lr_range_test_step_rate=1.0
+    )
+    vals = [cont.step() for _ in range(10)]
+    assert vals[-1] > vals[0]
+    stair = LRRangeTest(
+        lr_range_test_min_lr=0.01,
+        lr_range_test_step_size=5,
+        lr_range_test_step_rate=1.0,
+        lr_range_test_staircase=True,
+    )
+    svals = [stair.step() for _ in range(10)]
+    assert svals[0] == svals[4]  # flat within an interval
+    assert svals[5] > svals[4]  # jumps at the boundary
+
+
+def test_one_cycle_shape():
+    s = OneCycle(
+        cycle_min_lr=0.1, cycle_max_lr=1.0, cycle_first_step_size=10,
+        decay_lr_rate=0.5, decay_step_size=1,
+    )
+    lrs = [s.step() for _ in range(30)]
+    peak = max(lrs)
+    assert peak == pytest.approx(1.0, rel=0.15)
+    assert lrs[20] == pytest.approx(0.1, rel=0.15)  # back to min after cycle
+    assert lrs[-1] < 0.1  # decay tail below min
+
+
+def test_one_cycle_staircase():
+    s = OneCycle(
+        cycle_min_lr=0.0, cycle_max_lr=1.0, cycle_first_step_size=10,
+        cycle_first_stair_count=2,
+    )
+    lrs = [s.step() for _ in range(10)]
+    # only the stair values 0 and 0.5 appear during the up phase
+    assert set(round(v, 6) for v in lrs[:10]) == {0.0, 0.5}
+
+
+def test_one_cycle_momentum():
+    s = OneCycle(
+        cycle_min_lr=0.0, cycle_max_lr=1.0, cycle_first_step_size=10,
+        cycle_min_mom=0.8, cycle_max_mom=0.9,
+    )
+    s.step()
+    assert s.get_mom() == pytest.approx(0.9, rel=0.05)
+    for _ in range(9):
+        s.step()
+    assert s.get_mom() == pytest.approx(0.8, rel=0.05)
+
+
+def test_state_dict_roundtrip():
+    s = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(5):
+        s.step()
+    sd = s.state_dict()
+    s2 = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    s2.load_state_dict(sd)
+    assert s2.get_lr() == s.get_lr()
+
+
+def test_build_by_name():
+    s = build_lr_scheduler("WarmupLR", {"warmup_max_lr": 0.5})
+    assert isinstance(s, WarmupLR)
+    with pytest.raises(ValueError):
+        build_lr_scheduler("Nope", {})
+
+
+def test_cli_args_roundtrip():
+    parser = add_tuning_arguments(argparse.ArgumentParser())
+    args = parser.parse_args(
+        ["--lr_schedule", "LRRangeTest", "--lr_range_test_min_lr", "0.007",
+         "--lr_range_test_step_size", "42"]
+    )
+    cfg, err = get_config_from_args(args)
+    assert err is None
+    assert cfg["type"] == "LRRangeTest"
+    assert cfg["params"]["lr_range_test_min_lr"] == 0.007
+    assert cfg["params"]["lr_range_test_step_size"] == 42
+    sched = build_lr_scheduler(cfg["type"], cfg["params"])
+    assert sched.min_lr == 0.007
+
+
+def test_cli_args_invalid_schedule():
+    parser = add_tuning_arguments(argparse.ArgumentParser())
+    args = parser.parse_args(["--lr_schedule", "Bogus"])
+    cfg, err = get_config_from_args(args)
+    assert cfg is None and "not a valid" in err
