@@ -1,0 +1,120 @@
+"""Throughput benchmark: BERT-large pretraining micro-step on one TPU chip.
+
+Headline metric matching BASELINE.md row 1: BERT-large (24L/1024h/16heads),
+seq 128, masked-LM pretraining samples/sec on a single chip. Reference
+baseline: 272 samples/s on 1x V100 32GB
+(docs/_posts/2020-05-28-fastest-bert-training.md:38-39).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Extra diagnostics go to stderr.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import BertConfig, BertForPreTraining
+
+    BASELINE_SAMPLES_PER_SEC = 272.0  # 1x V100 32GB, seq 128
+    SEQ = 128
+    BATCH = int(__import__("os").environ.get("BENCH_BATCH", "256"))
+    MEASURE_STEPS = 8
+    WARMUP_STEPS = 3
+
+    platform = jax.devices()[0].platform
+    log(f"devices: {jax.devices()} (platform={platform})")
+
+    cfg = BertConfig.bert_large(
+        max_position_embeddings=SEQ,
+        hidden_dropout_prob=0.1,
+        attention_probs_dropout_prob=0.1,
+    )
+    model = BertForPreTraining(cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (BATCH, SEQ)).astype(np.int32)
+    mask = np.ones((BATCH, SEQ), np.int32)
+    mlm = np.where(rng.random((BATCH, SEQ)) < 0.15, ids, -1).astype(np.int32)
+    nsp = rng.integers(0, 2, (BATCH,)).astype(np.int32)
+
+    t0 = time.time()
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jnp.asarray(ids[:2]), jnp.asarray(mask[:2]), None,
+        jnp.asarray(mlm[:2]), jnp.asarray(nsp[:2]),
+    )["params"]
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    log(f"init done in {time.time()-t0:.1f}s; params={n_params/1e6:.1f}M")
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": BATCH,
+            "optimizer": {
+                "type": "Lamb",
+                "params": {"lr": 1e-3, "weight_decay": 0.01},
+            },
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    del params
+
+    batch = (ids, mask, np.zeros_like(ids), mlm, nsp)
+
+    def step():
+        loss = engine(*batch)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    t0 = time.time()
+    loss = step()
+    jax.block_until_ready(loss)
+    log(f"first step (compile) {time.time()-t0:.1f}s, loss={float(loss):.4f}")
+    for _ in range(WARMUP_STEPS - 1):
+        step()
+    jax.effects_barrier()
+
+    t0 = time.time()
+    for _ in range(MEASURE_STEPS):
+        loss = step()
+    jax.block_until_ready(loss)
+    elapsed = time.time() - t0
+
+    samples_per_sec = BATCH * MEASURE_STEPS / elapsed
+    log(
+        f"{MEASURE_STEPS} steps in {elapsed:.2f}s -> "
+        f"{samples_per_sec:.1f} samples/s (loss {float(loss):.4f})"
+    )
+    # rough MLM-model FLOPs: 6 * params * tokens (fwd+bwd)
+    tflops = 6 * n_params * BATCH * SEQ * MEASURE_STEPS / elapsed / 1e12
+    log(f"approx {tflops:.1f} TFLOPS")
+
+    print(
+        json.dumps(
+            {
+                "metric": "bert_large_pretrain_seq128_samples_per_sec_per_chip",
+                "value": round(samples_per_sec, 2),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

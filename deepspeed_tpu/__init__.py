@@ -32,6 +32,7 @@ def initialize(
     config_params=None,
     mesh=None,
     rng_seed=0,
+    param_specs=None,
 ):
     """Build a training engine; returns the reference's 4-tuple
     ``(engine, optimizer, training_dataloader, lr_scheduler)``
@@ -56,6 +57,7 @@ def initialize(
         config_params=config_params,
         mesh=mesh,
         rng_seed=rng_seed,
+        param_specs=param_specs,
     )
     return (
         engine,

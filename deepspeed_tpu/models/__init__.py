@@ -1,0 +1,20 @@
+from .bert import (
+    BertConfig,
+    BertEncoder,
+    BertForPreTraining,
+    BertModel,
+    cross_entropy_ignore_index,
+)
+from .gpt2 import GPT2Config, GPT2LMHeadModel, GPT2Model, partition_specs
+
+__all__ = [
+    "BertConfig",
+    "BertEncoder",
+    "BertForPreTraining",
+    "BertModel",
+    "GPT2Config",
+    "GPT2LMHeadModel",
+    "GPT2Model",
+    "partition_specs",
+    "cross_entropy_ignore_index",
+]

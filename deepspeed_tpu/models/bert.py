@@ -1,0 +1,193 @@
+"""BERT model family built on DeepSpeedTransformerLayer.
+
+The analog of the reference's vendored BERT modeling used for kernel parity
+tests and the BingBert workloads (reference: tests/unit/modeling.py /
+modelingpreln.py, ~1.6k LoC each): embeddings + encoder stack + pretraining
+heads (masked LM + next-sentence), pre- or post-LayerNorm.
+
+TPU-first details:
+- the encoder stack is rolled with ``nn.scan`` over layer params: one traced
+  layer compiles once regardless of depth (24-layer BERT-large compiles in
+  the time the reference spends on one layer's autotuning sweep);
+- the vocab is padded up to a multiple of 128 for MXU-friendly tiling of
+  the logits matmul (the reference only warns about %8 alignment,
+  deepspeed_config.py:466-488);
+- masked-LM loss uses the label value -1 (and -100) as ignore-index,
+  matching the reference models' convention.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class BertConfig:
+    vocab_size: int = 30528
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = False  # classic BERT is post-LN
+    use_flash: bool = True
+
+    @staticmethod
+    def bert_large(**kw):
+        return BertConfig(
+            hidden_size=1024, num_hidden_layers=24, num_attention_heads=16,
+            intermediate_size=4096, **kw,
+        )
+
+    @staticmethod
+    def bert_base(**kw):
+        return BertConfig(**kw)
+
+    def layer_config(self):
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.hidden_size,
+            heads=self.num_attention_heads,
+            intermediate_size=self.intermediate_size,
+            attn_dropout_ratio=self.attention_probs_dropout_prob,
+            hidden_dropout_ratio=self.hidden_dropout_prob,
+            num_hidden_layers=self.num_hidden_layers,
+            initializer_range=self.initializer_range,
+            pre_layer_norm=self.pre_layer_norm,
+            layer_norm_eps=self.layer_norm_eps,
+        )
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, train=True):
+        cfg = self.config
+        init = nn.initializers.normal(stddev=cfg.initializer_range)
+        vocab_padded = _round_up(cfg.vocab_size, 128)
+        word = self.param("word_embeddings", init, (vocab_padded, cfg.hidden_size))
+        pos = self.param(
+            "position_embeddings", init,
+            (cfg.max_position_embeddings, cfg.hidden_size),
+        )
+        tok = self.param("token_type_embeddings", init, (cfg.type_vocab_size, cfg.hidden_size))
+
+        s = input_ids.shape[1]
+        x = word[input_ids] + pos[None, :s, :]
+        if token_type_ids is not None:
+            x = x + tok[token_type_ids]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="LayerNorm")(x)
+        if train and cfg.hidden_dropout_prob > 0:
+            x = nn.Dropout(cfg.hidden_dropout_prob, deterministic=False)(
+                x, rng=self.make_rng("dropout")
+            )
+        return x, word  # word table returned for the tied MLM decoder
+
+
+class BertEncoder(nn.Module):
+    """Scanned stack of DeepSpeedTransformerLayers: one traced layer,
+    stacked params with a leading ``layers`` axis."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None, train=True):
+        cfg = self.config
+        hidden_states, _ = nn.scan(
+            lambda mdl, c, _: (mdl(c, attention_mask, train=train), None),
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.num_hidden_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(
+            DeepSpeedTransformerLayer(
+                config=cfg.layer_config(), causal=False,
+                use_flash=cfg.use_flash, name="layer",
+            ),
+            hidden_states,
+            None,
+        )
+        return hidden_states
+
+
+class BertModel(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None, train=True):
+        cfg = self.config
+        x, word_table = BertEmbeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, train=train
+        )
+        additive_mask = None
+        if attention_mask is not None:
+            additive_mask = jnp.where(
+                attention_mask[:, None, None, :] > 0, 0.0, -1e30
+            ).astype(jnp.float32)
+        x = BertEncoder(cfg, name="encoder")(x, additive_mask, train=train)
+        # pooler: tanh(dense(first token)), used by the NSP head
+        pooled = nn.tanh(
+            nn.Dense(cfg.hidden_size, name="pooler")(x[:, 0])
+        )
+        return x, pooled, word_table
+
+
+def cross_entropy_ignore_index(logits, labels, ignore_values=(-1, -100)):
+    """Mean CE over positions whose label is not an ignore value."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = jnp.ones(labels.shape, bool)
+    for iv in ignore_values:
+        valid &= labels != iv
+    safe_labels = jnp.where(valid, labels, 0)
+    picked = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    num = jnp.sum(jnp.where(valid, -picked, 0.0))
+    den = jnp.maximum(jnp.sum(valid), 1)
+    return num / den
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP pretraining objective; __call__ returns the scalar loss
+    (the engine's model contract)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(
+        self, input_ids, attention_mask=None, token_type_ids=None,
+        masked_lm_labels=None, next_sentence_label=None, train=True,
+    ):
+        cfg = self.config
+        seq_out, pooled, word_emb = BertModel(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, train=train
+        )
+        # MLM head: transform + decoder tied to word embeddings
+        h = nn.Dense(cfg.hidden_size, name="transform")(seq_out)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="transform_ln")(h)
+        vocab_padded = word_emb.shape[0]
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros, (vocab_padded,))
+        logits = h @ word_emb.T + mlm_bias
+
+        loss = jnp.float32(0.0)
+        if masked_lm_labels is not None:
+            loss = loss + cross_entropy_ignore_index(logits, masked_lm_labels)
+        if next_sentence_label is not None:
+            nsp_logits = nn.Dense(2, name="nsp")(pooled)
+            loss = loss + cross_entropy_ignore_index(
+                nsp_logits, next_sentence_label
+            )
+        return loss

@@ -1,0 +1,163 @@
+"""GPT-2 model family (the Megatron-GPT2 workload analog).
+
+The reference drove GPT-2 through the external Megatron-LM example with an
+``mpu`` hook for tensor parallelism (reference: tests/model/Megatron_GPT2/*,
+docs/_tutorials/megatron.md). Here the model is in-tree, built on the same
+DeepSpeedTransformerLayer (causal mode), with Megatron-style tensor-parallel
+partition specs published per-parameter (``partition_specs``) so the engine
+shards the qkv/mlp projections over the mesh's ``model`` axis — the
+column-/row-parallel split of Megatron expressed as PartitionSpecs instead
+of hand-written all-reduces.
+
+Sizes follow the reference's perf-test configs
+(tests/model/Megatron_GPT2/run_perf_test.py:18-60): gpt2_1_5b = 48L/1600h/
+25 heads/seq1024, gpt2_4b = 64L/2304h, gpt2_8b = 72L/3072h.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..config.constants import DATA_AXIS, MODEL_AXIS, SEQUENCE_AXIS
+from ..ops.transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
+from .bert import cross_entropy_ignore_index, _round_up
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-5
+    use_flash: bool = True
+    remat: bool = False
+
+    @property
+    def vocab_padded(self):
+        return _round_up(self.vocab_size, 128)
+
+    @staticmethod
+    def small(**kw):
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def medium(**kw):
+        return GPT2Config(n_embd=1024, n_layer=24, n_head=16, **kw)
+
+    @staticmethod
+    def large(**kw):
+        return GPT2Config(n_embd=1280, n_layer=36, n_head=20, **kw)
+
+    @staticmethod
+    def xl_1_5b(**kw):
+        # the reference perf harness's 1.5B: 48L/1600h (run_perf_test.py:18-35)
+        return GPT2Config(n_embd=1600, n_layer=48, n_head=25, **kw)
+
+    @staticmethod
+    def gpt2_4b(**kw):
+        return GPT2Config(n_embd=2304, n_layer=64, n_head=24, **kw)
+
+    @staticmethod
+    def gpt2_8b(**kw):
+        return GPT2Config(n_embd=3072, n_layer=72, n_head=24, **kw)
+
+    def layer_config(self):
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.n_embd,
+            heads=self.n_head,
+            intermediate_size=4 * self.n_embd,
+            attn_dropout_ratio=self.dropout,
+            hidden_dropout_ratio=self.dropout,
+            num_hidden_layers=self.n_layer,
+            initializer_range=self.initializer_range,
+            pre_layer_norm=True,  # GPT-2 is pre-LN
+            layer_norm_eps=self.layer_norm_eps,
+            normalize_invertible=self.remat,  # remat flag reuse
+        )
+
+
+class GPT2Model(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = True):
+        cfg = self.config
+        init = nn.initializers.normal(stddev=cfg.initializer_range)
+        wte = self.param("wte", init, (cfg.vocab_padded, cfg.n_embd))
+        wpe = self.param("wpe", init, (cfg.n_positions, cfg.n_embd))
+
+        s = input_ids.shape[1]
+        x = wte[input_ids] + wpe[None, :s, :]
+        if train and cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout, deterministic=False)(
+                x, rng=self.make_rng("dropout")
+            )
+
+        x, _ = nn.scan(
+            lambda mdl, c, _: (mdl(c, None, train=train), None),
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.n_layer,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(
+            DeepSpeedTransformerLayer(
+                config=cfg.layer_config(), causal=True,
+                use_flash=cfg.use_flash, name="h",
+            ),
+            x,
+            None,
+        )
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_f")(x)
+        return x, wte
+
+
+class GPT2LMHeadModel(nn.Module):
+    """__call__(input_ids, labels) -> scalar next-token LM loss
+    (labels typically input_ids; the shift happens inside)."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, train: bool = True):
+        x, wte = GPT2Model(self.config, name="transformer")(input_ids, train=train)
+        logits = x @ wte.T  # tied lm head
+        if labels is None:
+            return logits
+        # next-token prediction: logits[:, :-1] vs labels[:, 1:]
+        return cross_entropy_ignore_index(logits[:, :-1], labels[:, 1:])
+
+
+def partition_specs(params, mp_axis=MODEL_AXIS):
+    """Megatron-style tensor-parallel PartitionSpecs for a GPT2LMHeadModel
+    param tree (same structure, PartitionSpec leaves).
+
+    Column-parallel (shard output dim): attn qkv, mlp up (inter_w).
+    Row-parallel (shard input dim): attn out (attn_ow), mlp down (output_w).
+    Embeddings: shard the vocab dim. Scanned layer params carry a leading
+    ``layers`` axis, so dims below shift by one.
+    """
+
+    def spec_for(path, leaf):
+        names = [getattr(k, "key", None) for k in path]
+        nd = leaf.ndim
+        if "wte" in names:
+            return P(mp_axis, None)
+        if "wpe" in names:
+            return P()
+        # scanned transformer params: leading 'layers' dim
+        if "attn_qkvw" in names or "inter_w" in names:
+            return P(None, None, mp_axis) if nd == 3 else P(None, mp_axis)
+        if "attn_qkvb" in names or "inter_b" in names:
+            return P(None, mp_axis) if nd == 2 else P(mp_axis)
+        if "attn_ow" in names or "output_w" in names:
+            return P(None, mp_axis, None) if nd == 3 else P(mp_axis, None)
+        return P()  # biases of row-parallel, norms, ln_f: replicated
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -1,0 +1,17 @@
+from .attention import attention, flash_attention, mha_reference
+from .optimizers import SGD, Adam, Lamb, Lion, Optimizer, build_optimizer
+from .transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
+
+__all__ = [
+    "attention",
+    "flash_attention",
+    "mha_reference",
+    "Adam",
+    "Lamb",
+    "Lion",
+    "SGD",
+    "Optimizer",
+    "build_optimizer",
+    "DeepSpeedTransformerConfig",
+    "DeepSpeedTransformerLayer",
+]

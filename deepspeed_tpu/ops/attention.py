@@ -1,0 +1,535 @@
+"""Attention: Pallas flash kernels + XLA reference path.
+
+TPU-native replacement for the reference's attention pipeline inside the
+fused BERT layer — StridedBatchGemm(QK^T) -> scale+mask+softmax kernel ->
+dropout -> StridedBatchGemm(probs.V) (reference:
+csrc/transformer/ds_transformer_cuda.cpp:217-231 and
+csrc/transformer/softmax_kernels.cu). Instead of materializing the
+[B,H,S,S] score matrix, the Pallas kernel streams KV blocks through VMEM
+with an online softmax (flash attention), so there is **no sequence-length
+cap** (the reference hard-limits seq <= 1024,
+ds_transformer_cuda.cpp:133) and HBM traffic is O(S) instead of O(S^2).
+
+Three entry points:
+  - ``mha_reference``: plain XLA attention (always correct, differentiable
+    through arbitrary additive masks; the numerics oracle and fallback).
+  - ``flash_attention``: custom-vjp Pallas forward/backward. Masking is a
+    compact per-key validity vector [B, Sk] (non-differentiable padding
+    semantics) — NOT a full [B,H,Sq,Sk] additive bias, which would
+    reintroduce the O(S^2) footprint the kernel exists to avoid.
+  - ``attention``: dispatcher. Padding-style additive masks (broadcast over
+    the query dim) are converted to validity vectors and sent to flash;
+    learned/general additive biases (q-dependent) go to the XLA path so
+    their gradients are exact.
+
+Dropout inside the kernel uses the TPU PRNG seeded per (batch*head,
+q-block, kv-block), so the backward pass regenerates bit-identical masks
+without storing them (the reference stores an explicit byte mask,
+dropout_kernels.cu; regeneration is the bandwidth-friendly TPU design).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# XLA reference implementation
+# ---------------------------------------------------------------------------
+def mha_reference(
+    q, k, v, mask=None, causal=False, sm_scale=None, dropout_rate=0.0, dropout_rng=None
+):
+    """q,k,v: [B, H, S, D]; mask: additive, broadcastable to [B, H, Sq, Sk]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        idx_q = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        idx_k = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(idx_k <= idx_q + (sk - sq), s, NEG_INF)
+    if mask is not None:
+        s = s + mask.astype(s.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention
+# ---------------------------------------------------------------------------
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _dropout_keep(shape, rate):
+    """Regenerable keep-mask from the already-seeded per-core PRNG."""
+    bits = pltpu.prng_random_bits(shape)
+    threshold = jnp.uint32(int(rate * (2**32)))
+    return bits >= threshold
+
+
+def _masked_scores(
+    s, kvm_ref, iq, ik, *, causal, block_q, block_k, diag_offset, use_mask
+):
+    """Apply causal (with sq!=sk diagonal offset) and key-validity masking."""
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(
+            cols + ik * block_k <= rows + iq * block_q + diag_offset, s, NEG_INF
+        )
+    if use_mask:
+        valid = kvm_ref[0] > 0  # [BK]
+        s = jnp.where(valid[None, :], s, NEG_INF)
+    return s
+
+
+def _fwd_kernel(
+    seed_ref, q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q, block_k, nk,
+    diag_offset, dropout_rate, use_mask,
+):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    bh = pl.program_id(0)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = jnp.asarray(True)
+    if causal:
+        run = ik * block_k <= iq * block_q + (block_q - 1) + diag_offset
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [BQ, BK]
+        s = _masked_scores(
+            s, kvm_ref, iq, ik, causal=causal, block_q=block_q,
+            block_k=block_k, diag_offset=diag_offset, use_mask=use_mask,
+        )
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+
+        if dropout_rate > 0.0:
+            pltpu.prng_seed(seed_ref[0] + bh * 2_000_003 + iq * 4_001 + ik)
+            keep = _dropout_keep((block_q, block_k), dropout_rate)
+            p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        else:
+            p_use = p
+
+        pv = jax.lax.dot_general(
+            p_use, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
+
+
+def _bwd_dq_kernel(
+    seed_ref, q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k, nk,
+    diag_offset, dropout_rate, use_mask,
+):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    bh = pl.program_id(0)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = jnp.asarray(True)
+    if causal:
+        run = ik * block_k <= iq * block_q + (block_q - 1) + diag_offset
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        s = _masked_scores(
+            s, kvm_ref, iq, ik, causal=causal, block_q=block_q,
+            block_k=block_k, diag_offset=diag_offset, use_mask=use_mask,
+        )
+        p = jnp.exp(s - lse_ref[0][:, None])  # true softmax probs
+
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if dropout_rate > 0.0:
+            pltpu.prng_seed(seed_ref[0] + bh * 2_000_003 + iq * 4_001 + ik)
+            keep = _dropout_keep((block_q, block_k), dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta_ref[0][:, None])
+        dq_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    seed_ref, q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k, nq,
+    diag_offset, dropout_rate, use_mask,
+):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    bh = pl.program_id(0)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = jnp.asarray(True)
+    if causal:
+        run = ik * block_k <= iq * block_q + (block_q - 1) + diag_offset
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        s = _masked_scores(
+            s, kvm_ref, iq, ik, causal=causal, block_q=block_q,
+            block_k=block_k, diag_offset=diag_offset, use_mask=use_mask,
+        )
+        p = jnp.exp(s - lse_ref[0][:, None])  # [BQ, BK]
+
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if dropout_rate > 0.0:
+            pltpu.prng_seed(seed_ref[0] + bh * 2_000_003 + iq * 4_001 + ik)
+            keep = _dropout_keep((block_q, block_k), dropout_rate)
+            p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        else:
+            p_drop = p
+        # dv += P^T dO
+        dv_scr[:] += jax.lax.dot_general(
+            p_drop, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0][:, None])
+        # dk += dS^T q
+        dk_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _reshape_bh(x):
+    b, h, s, d = x.shape
+    return x.reshape(b * h, s, d)
+
+
+def _kvm_specs(use_mask, heads, block_k, order="q_inner_k"):
+    """BlockSpec for the [B, Sk] validity vector; bh -> batch via // heads."""
+    if not use_mask:
+        if order == "q_inner_k":
+            return pl.BlockSpec((1, 1), lambda bh, iq, ik: (0, 0))
+        return pl.BlockSpec((1, 1), lambda bh, ik, iq: (0, 0))
+    if order == "q_inner_k":
+        return pl.BlockSpec((1, block_k), lambda bh, iq, ik: (bh // heads, ik))
+    return pl.BlockSpec((1, block_k), lambda bh, ik, iq: (bh // heads, ik))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, kv_mask, seed, causal, sm_scale, dropout_rate, block_q, block_k):
+    out, _ = _flash_fwd_impl(
+        q, k, v, kv_mask, seed, causal, sm_scale, dropout_rate, block_q, block_k
+    )
+    return out
+
+
+def _flash_fwd_impl(q, k, v, kv_mask, seed, causal, sm_scale, dropout_rate, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nq, nk = sq // block_q, sk // block_k
+    diag_offset = sk - sq
+    interpret = not _on_tpu()
+    use_mask = kv_mask is not None
+
+    q3, k3, v3 = _reshape_bh(q), _reshape_bh(k), _reshape_bh(v)
+    kvm = (
+        kv_mask.astype(jnp.int32)
+        if use_mask
+        else jnp.zeros((1, 1), jnp.int32)
+    )
+    seed_arr = jnp.reshape(jnp.asarray(seed, jnp.int32), (1,))
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
+        nk=nk, diag_offset=diag_offset, dropout_rate=dropout_rate,
+        use_mask=use_mask,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            _kvm_specs(use_mask, h, block_k),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (bh, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed_arr, q3, k3, v3, kvm)
+    return out.reshape(b, h, sq, d), lse
+
+
+def _flash_fwd(q, k, v, kv_mask, seed, causal, sm_scale, dropout_rate, block_q, block_k):
+    out, lse = _flash_fwd_impl(
+        q, k, v, kv_mask, seed, causal, sm_scale, dropout_rate, block_q, block_k
+    )
+    return out, (q, k, v, kv_mask, seed, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, dropout_rate, block_q, block_k, residuals, g):
+    q, k, v, kv_mask, seed, out, lse = residuals
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nq, nk = sq // block_q, sk // block_k
+    diag_offset = sk - sq
+    interpret = not _on_tpu()
+    use_mask = kv_mask is not None
+
+    # delta_i = rowsum(dO * O): cheap elementwise reduction, leave to XLA
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(b * h, sq)
+
+    q3, k3, v3 = _reshape_bh(q), _reshape_bh(k), _reshape_bh(v)
+    do3 = _reshape_bh(g)
+    kvm = (
+        kv_mask.astype(jnp.int32)
+        if use_mask
+        else jnp.zeros((1, 1), jnp.int32)
+    )
+    seed_arr = jnp.reshape(jnp.asarray(seed, jnp.int32), (1,))
+    common = dict(
+        sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
+        diag_offset=diag_offset, dropout_rate=dropout_rate, use_mask=use_mask,
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, nk=nk, **common),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            _kvm_specs(use_mask, h, block_k),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (bh, iq)),
+            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (bh, iq)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(seed_arr, q3, k3, v3, kvm, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, nq=nq, **common),
+        grid=(b * h, nk, nq),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+            _kvm_specs(use_mask, h, block_k, order="k_inner_q"),
+            pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, ik, iq: (bh, iq)),
+            pl.BlockSpec((1, block_q), lambda bh, ik, iq: (bh, iq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed_arr, q3, k3, v3, kvm, do3, lse, delta)
+
+    dq = dq.reshape(b, h, sq, d)
+    dk = dk.reshape(b, h, sk, d)
+    dv = dv.reshape(b, h, sk, d)
+    # kv_mask is padding metadata (int), seed is RNG state: no gradients.
+    dkvm = None if kv_mask is None else jnp.zeros_like(kv_mask)
+    dseed = jnp.zeros_like(seed)
+    return dq, dk, dv, dkvm, dseed
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def additive_mask_to_kv_valid(mask):
+    """Convert a padding-style additive mask (broadcast over the query dim,
+    shape [B, 1, 1, Sk] or [B, Sk]-broadcastable) to a [B, Sk] validity
+    vector. Returns None if the mask depends on the query position."""
+    if mask is None:
+        return None
+    if mask.ndim == 2:
+        return (mask > NEG_INF / 2).astype(jnp.int32)
+    if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+        return (mask[:, 0, 0, :] > NEG_INF / 2).astype(jnp.int32)
+    return None
+
+
+def flash_attention(
+    q, k, v, mask=None, kv_mask=None, causal=False, sm_scale=None,
+    dropout_rate=0.0, dropout_seed=0,
+    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+):
+    """Blockwise flash attention. q,k,v: [B, H, S, D].
+
+    Masking: pass ``kv_mask`` [B, Sk] (nonzero = attend) or a padding-style
+    additive ``mask`` (converted). Query-dependent additive biases are not
+    supported here — use ``attention()`` / ``mha_reference`` for those.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    block_q = min(block_q, q.shape[2])
+    block_k = min(block_k, k.shape[2])
+    sq, sk = q.shape[2], k.shape[2]
+    if sq % block_q != 0 or sk % block_k != 0:
+        raise ValueError(
+            f"flash_attention requires seq lengths divisible by block sizes: "
+            f"sq={sq} % {block_q}, sk={sk} % {block_k}; pad the sequence or "
+            f"use attention()/mha_reference"
+        )
+    if kv_mask is None and mask is not None:
+        kv_mask = additive_mask_to_kv_valid(mask)
+        if kv_mask is None:
+            raise ValueError(
+                "flash_attention only supports padding-style masks "
+                "(broadcast over the query dim); use mha_reference for "
+                "query-dependent additive biases"
+            )
+    seed = jnp.asarray(dropout_seed, jnp.int32)
+    return _flash(
+        q, k, v, kv_mask, seed, causal, float(sm_scale), float(dropout_rate),
+        int(block_q), int(block_k),
+    )
+
+
+# Flash dispatch mode:
+#   "auto"   — flash on a single device; XLA path under a multi-device mesh
+#              (a pallas_call inside plain GSPMD jit is not partitioned — XLA
+#              would all-gather its operands; multi-device flash goes through
+#              shard_map, see parallel/sequence.py)
+#   "always" — force flash (caller guarantees per-device operands, e.g.
+#              inside shard_map)
+#   "never"  — XLA reference path
+FLASH_MODE = "auto"
+
+
+def attention(
+    q, k, v, mask=None, causal=False, sm_scale=None, dropout_rate=0.0,
+    dropout_rng=None, use_flash=True,
+):
+    """Dispatcher: flash kernel when shapes tile cleanly and the mask is a
+    padding mask; XLA reference otherwise (incl. learned additive biases,
+    which need exact mask gradients)."""
+    sq, sk = q.shape[2], k.shape[2]
+    bq = min(DEFAULT_BLOCK_Q, sq)
+    bk = min(DEFAULT_BLOCK_K, sk)
+    if dropout_rng is None:
+        dropout_rate = 0.0  # matches the XLA path's no-rng => no-dropout
+    kv_mask = additive_mask_to_kv_valid(mask)
+    can_flash = (
+        use_flash
+        and sq % bq == 0
+        and sk % bk == 0
+        and (mask is None or kv_mask is not None)
+    )
+    if FLASH_MODE == "never":
+        can_flash = False
+    elif FLASH_MODE == "auto" and jax.device_count() > 1:
+        can_flash = False
+    # interpret-mode PRNG is not available off-TPU; route dropout to XLA there
+    if dropout_rate > 0.0 and not _on_tpu():
+        can_flash = False
+    if can_flash:
+        seed = jnp.asarray(0, jnp.int32)
+        if dropout_rate > 0.0:
+            seed = jax.random.randint(dropout_rng, (), 0, 2**31 - 1)
+        return flash_attention(
+            q, k, v, kv_mask=kv_mask, causal=causal, sm_scale=sm_scale,
+            dropout_rate=dropout_rate, dropout_seed=seed,
+            block_q=bq, block_k=bk,
+        )
+    return mha_reference(
+        q, k, v, mask=mask, causal=causal, sm_scale=sm_scale,
+        dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+    )

@@ -27,16 +27,16 @@ class TPUMpu:
 
     # --- sizes ---------------------------------------------------------
     def get_model_parallel_world_size(self):
-        return self.mesh.shape[mesh_lib.MODEL_AXIS]
+        return dict(self.mesh.shape).get(mesh_lib.MODEL_AXIS, 1)
 
     def get_data_parallel_world_size(self):
-        return self.mesh.shape[mesh_lib.DATA_AXIS]
+        return dict(self.mesh.shape).get(mesh_lib.DATA_AXIS, 1)
 
     def get_sequence_parallel_world_size(self):
-        return self.mesh.shape[mesh_lib.SEQ_AXIS]
+        return dict(self.mesh.shape).get(mesh_lib.SEQ_AXIS, 1)
 
     def get_pipeline_parallel_world_size(self):
-        return self.mesh.shape[mesh_lib.PIPE_AXIS]
+        return dict(self.mesh.shape).get(mesh_lib.PIPE_AXIS, 1)
 
     # --- "groups": mesh axis names, usable inside shard_map ------------
     def get_model_parallel_group(self):

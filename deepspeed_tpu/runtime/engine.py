@@ -113,8 +113,14 @@ class DeepSpeedEngine:
         config_params=None,
         mesh=None,
         rng_seed=0,
+        param_specs=None,
     ):
         del dist_init_required  # jax.distributed is initialized by the launcher
+        # param_specs: optional pytree of PartitionSpecs (same structure as
+        # the params) carrying model-parallel shardings, e.g.
+        # models.gpt2.partition_specs — the TPU-native replacement for the
+        # reference's external Megatron mpu hook.
+        self._model_specs = param_specs
         self.client_optimizer = optimizer
         self.client_lr_scheduler = lr_scheduler
         self.collate_fn = collate_fn
@@ -148,13 +154,13 @@ class DeepSpeedEngine:
                 ),
             )
         self.mpu = TPUMpu(self._mesh) if mpu is None else mpu
-        dp_size = self._mesh.shape[mesh_lib.DATA_AXIS]
+        dp_size = dict(self._mesh.shape).get(mesh_lib.DATA_AXIS, 1)
         self.config = DeepSpeedConfig(
             config_path, param_dict=config_params, world_size=dp_size
         )
 
         self.dp_world_size = dp_size
-        self.mp_world_size = self._mesh.shape[mesh_lib.MODEL_AXIS]
+        self.mp_world_size = dict(self._mesh.shape).get(mesh_lib.MODEL_AXIS, 1)
 
         # ---- model ----------------------------------------------------
         self.module = model
@@ -191,10 +197,14 @@ class DeepSpeedEngine:
         params_f32 = jax.tree_util.tree_map(
             lambda p: jnp.array(p, dtype=jnp.float32, copy=True), model_parameters
         )
-        self._param_specs = zero_lib.zero_param_specs(params_f32, dp_size, stage)
-        self._grad_specs = zero_lib.zero_grad_specs(params_f32, dp_size, stage)
+        self._param_specs = zero_lib.zero_param_specs(
+            params_f32, dp_size, stage, model_specs=self._model_specs
+        )
+        self._grad_specs = zero_lib.zero_grad_specs(
+            params_f32, dp_size, stage, model_specs=self._model_specs
+        )
         optstate_param_specs = zero_lib.zero_optstate_specs(
-            params_f32, dp_size, stage
+            params_f32, dp_size, stage, model_specs=self._model_specs
         )
         self._param_shardings = zero_lib.specs_to_shardings(
             self._param_specs, self._mesh
@@ -617,12 +627,22 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------
     def _shard_batch(self, inputs):
-        sharding = mesh_lib.data_sharding(self._mesh)
+        # user-supplied meshes may lack the sequence axis
+        sp = dict(self._mesh.shape).get(mesh_lib.SEQ_AXIS, 1)
+        from jax.sharding import NamedSharding, PartitionSpec
 
         def place(x):
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
+            # batch dim over data; token dim over sequence when it divides
+            spec = [None] * x.ndim
+            if x.ndim >= 1 and x.shape[0] % self.dp_world_size == 0:
+                spec[0] = mesh_lib.DATA_AXIS
+            if sp > 1 and x.ndim >= 2 and x.shape[1] % sp == 0:
+                spec[1] = mesh_lib.SEQ_AXIS
             try:
-                return jax.device_put(x, sharding)
+                return jax.device_put(
+                    x, NamedSharding(self._mesh, PartitionSpec(*spec))
+                )
             except ValueError:
                 return jax.device_put(x, mesh_lib.replicated(self._mesh))
 
