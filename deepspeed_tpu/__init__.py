@@ -12,6 +12,13 @@ launcher, and elastic checkpoint save/resume.
 
 import argparse
 
+from .runtime.dist import init_distributed, maybe_auto_init as _maybe_auto_init
+
+# Under bin/deepspeed the coordinator env is present at process start; the
+# jax.distributed bootstrap must happen before any JAX computation, so it
+# rides package import (see runtime/dist.py).
+_maybe_auto_init()
+
 from .config import DeepSpeedConfig
 from .config import constants as _constants
 from .ops.optimizers import Adam, Lamb, Lion, Optimizer, SGD

@@ -242,3 +242,12 @@ OPTIM_FILE_SUFFIX = "optim_states.msgpack"
 #############################################
 DEEPSPEED_CONFIG_ARG = "deepspeed_config"
 DEEPSCALE_CONFIG_ARG = "deepscale_config"  # deprecated alias
+
+
+#############################################
+# Launcher / distributed rendezvous
+#############################################
+# reference: deepspeed/pt/deepspeed_constants.py TORCH_DISTRIBUTED_DEFAULT_PORT
+# (kept under the same name for CLI parity; it is the jax.distributed
+# coordinator port here)
+TORCH_DISTRIBUTED_DEFAULT_PORT = 29500

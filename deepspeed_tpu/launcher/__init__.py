@@ -1,0 +1,16 @@
+"""Launcher: multi-host runner + per-node launch (reference bin/deepspeed,
+deepspeed_run.py, deepspeed_launch.py — re-targeted at TPU pod VMs)."""
+
+from .runner import (
+    encode_world_info,
+    fetch_hostfile,
+    parse_inclusion_exclusion,
+    parse_resource_filter,
+)
+
+__all__ = [
+    "encode_world_info",
+    "fetch_hostfile",
+    "parse_inclusion_exclusion",
+    "parse_resource_filter",
+]

@@ -96,8 +96,8 @@ def _masked_scores(
             cols + ik * block_k <= rows + iq * block_q + diag_offset, s, NEG_INF
         )
     if use_mask:
-        valid = kvm_ref[0] > 0  # [BK]
-        s = jnp.where(valid[None, :], s, NEG_INF)
+        valid = kvm_ref[0, :1] > 0  # [1, BK]
+        s = jnp.where(valid, s, NEG_INF)
     return s
 
 
@@ -134,6 +134,9 @@ def _fwd_kernel(
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
+        # fully-masked rows: m_new == NEG_INF makes exp(s - m_new) = 1, so
+        # explicitly zero masked entries (keeps l == 0 -> output zeros)
+        p = jnp.where(s > NEG_INF / 2, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
 
@@ -157,7 +160,9 @@ def _fwd_kernel(
         l = l_scr[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
+        # lse rides a 128-lane trailing dim (TPU blocks need the last two
+        # dims (8,128)-tileable; m_scr columns are already broadcast-equal)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
 def _bwd_dq_kernel(
@@ -187,7 +192,8 @@ def _bwd_dq_kernel(
             s, kvm_ref, iq, ik, causal=causal, block_q=block_q,
             block_k=block_k, diag_offset=diag_offset, use_mask=use_mask,
         )
-        p = jnp.exp(s - lse_ref[0][:, None])  # true softmax probs
+        p = jnp.exp(s - lse_ref[0, :, :1])  # true softmax probs
+        p = jnp.where(s > NEG_INF / 2, p, 0.0)  # fully-masked rows
 
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
@@ -198,7 +204,7 @@ def _bwd_dq_kernel(
             pltpu.prng_seed(seed_ref[0] + bh * 2_000_003 + iq * 4_001 + ik)
             keep = _dropout_keep((block_q, block_k), dropout_rate)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0, :, :1])
         dq_scr[:] += sm_scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -236,7 +242,8 @@ def _bwd_dkv_kernel(
             s, kvm_ref, iq, ik, causal=causal, block_q=block_q,
             block_k=block_k, diag_offset=diag_offset, use_mask=use_mask,
         )
-        p = jnp.exp(s - lse_ref[0][:, None])  # [BQ, BK]
+        p = jnp.exp(s - lse_ref[0, :, :1])  # [BQ, BK]
+        p = jnp.where(s > NEG_INF / 2, p, 0.0)  # fully-masked rows
 
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
@@ -254,7 +261,7 @@ def _bwd_dkv_kernel(
         dv_scr[:] += jax.lax.dot_general(
             p_drop, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0, :, :1])
         # dk += dS^T q
         dk_scr[:] += sm_scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -271,15 +278,36 @@ def _reshape_bh(x):
     return x.reshape(b * h, s, d)
 
 
+NUM_LANES = 128
+NUM_SUBLANES = 8
+
+
 def _kvm_specs(use_mask, heads, block_k, order="q_inner_k"):
-    """BlockSpec for the [B, Sk] validity vector; bh -> batch via // heads."""
+    """BlockSpec for the [B, 8, Sk] validity tensor (8 broadcast sublanes so
+    the block is TPU-tileable); bh -> batch via // heads."""
     if not use_mask:
         if order == "q_inner_k":
-            return pl.BlockSpec((1, 1), lambda bh, iq, ik: (0, 0))
-        return pl.BlockSpec((1, 1), lambda bh, ik, iq: (0, 0))
+            return pl.BlockSpec((1, 1, 1), lambda bh, iq, ik: (0, 0, 0))
+        return pl.BlockSpec((1, 1, 1), lambda bh, ik, iq: (0, 0, 0))
+    shape = (1, NUM_SUBLANES, block_k)
     if order == "q_inner_k":
-        return pl.BlockSpec((1, block_k), lambda bh, iq, ik: (bh // heads, ik))
-    return pl.BlockSpec((1, block_k), lambda bh, ik, iq: (bh // heads, ik))
+        return pl.BlockSpec(shape, lambda bh, iq, ik: (bh // heads, 0, ik))
+    return pl.BlockSpec(shape, lambda bh, ik, iq: (bh // heads, 0, ik))
+
+
+def _broadcast_kvm(kv_mask):
+    """[B, Sk] validity -> [B, 8, Sk] (sublane-broadcast for TPU tiling)."""
+    b, sk = kv_mask.shape
+    return jax.lax.broadcast_in_dim(
+        kv_mask.astype(jnp.int32), (b, NUM_SUBLANES, sk), (0, 2)
+    )
+
+
+def _lse_spec(block_q, order="q_inner_k"):
+    """BlockSpec for [B*H, Sq, 128] lse/delta (lane-broadcast trailing dim)."""
+    if order == "q_inner_k":
+        return pl.BlockSpec((1, block_q, NUM_LANES), lambda bh, iq, ik: (bh, iq, 0))
+    return pl.BlockSpec((1, block_q, NUM_LANES), lambda bh, ik, iq: (bh, iq, 0))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
@@ -300,9 +328,9 @@ def _flash_fwd_impl(q, k, v, kv_mask, seed, causal, sm_scale, dropout_rate, bloc
 
     q3, k3, v3 = _reshape_bh(q), _reshape_bh(k), _reshape_bh(v)
     kvm = (
-        kv_mask.astype(jnp.int32)
+        _broadcast_kvm(kv_mask)
         if use_mask
-        else jnp.zeros((1, 1), jnp.int32)
+        else jnp.zeros((1, 1, 1), jnp.int32)
     )
     seed_arr = jnp.reshape(jnp.asarray(seed, jnp.int32), (1,))
 
@@ -324,11 +352,11 @@ def _flash_fwd_impl(q, k, v, kv_mask, seed, causal, sm_scale, dropout_rate, bloc
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (bh, iq)),
+            _lse_spec(block_q),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, NUM_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -344,29 +372,37 @@ def _flash_fwd(q, k, v, kv_mask, seed, causal, sm_scale, dropout_rate, block_q, 
     out, lse = _flash_fwd_impl(
         q, k, v, kv_mask, seed, causal, sm_scale, dropout_rate, block_q, block_k
     )
-    return out, (q, k, v, kv_mask, seed, out, lse)
+    # the 128 lse lanes are broadcast-equal: save one, re-broadcast in bwd
+    # (keeps the held-across-backward residual at [B*H, Sq], not 128x that)
+    return out, (q, k, v, kv_mask, seed, out, lse[..., 0])
 
 
 def _flash_bwd(causal, sm_scale, dropout_rate, block_q, block_k, residuals, g):
     q, k, v, kv_mask, seed, out, lse = residuals
     b, h, sq, d = q.shape
+    lse = jax.lax.broadcast_in_dim(lse, (*lse.shape, NUM_LANES), (0, 1))
     sk = k.shape[2]
     nq, nk = sq // block_q, sk // block_k
     diag_offset = sk - sq
     interpret = not _on_tpu()
     use_mask = kv_mask is not None
 
-    # delta_i = rowsum(dO * O): cheap elementwise reduction, leave to XLA
-    delta = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    ).reshape(b * h, sq)
+    # delta_i = rowsum(dO * O): cheap elementwise reduction, leave to XLA;
+    # lane-broadcast like lse so the block is TPU-tileable
+    delta = jax.lax.broadcast_in_dim(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1).reshape(
+            b * h, sq
+        ),
+        (b * h, sq, NUM_LANES),
+        (0, 1),
+    )
 
     q3, k3, v3 = _reshape_bh(q), _reshape_bh(k), _reshape_bh(v)
     do3 = _reshape_bh(g)
     kvm = (
-        kv_mask.astype(jnp.int32)
+        _broadcast_kvm(kv_mask)
         if use_mask
-        else jnp.zeros((1, 1), jnp.int32)
+        else jnp.zeros((1, 1, 1), jnp.int32)
     )
     seed_arr = jnp.reshape(jnp.asarray(seed, jnp.int32), (1,))
     common = dict(
@@ -384,8 +420,8 @@ def _flash_bwd(causal, sm_scale, dropout_rate, block_q, block_k, residuals, g):
             pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
             _kvm_specs(use_mask, h, block_k),
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (bh, iq)),
-            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (bh, iq)),
+            _lse_spec(block_q),
+            _lse_spec(block_q),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -403,8 +439,8 @@ def _flash_bwd(causal, sm_scale, dropout_rate, block_q, block_k, residuals, g):
             pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
             _kvm_specs(use_mask, h, block_k, order="k_inner_q"),
             pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, ik, iq: (bh, iq)),
-            pl.BlockSpec((1, block_q), lambda bh, ik, iq: (bh, iq)),
+            _lse_spec(block_q, order="k_inner_q"),
+            _lse_spec(block_q, order="k_inner_q"),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),

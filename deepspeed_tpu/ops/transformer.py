@@ -32,7 +32,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .attention import attention
+from .attention import additive_mask_to_kv_valid, attention
 
 
 @dataclasses.dataclass
@@ -85,6 +85,12 @@ class DeepSpeedTransformerLayer(nn.Module):
     config: DeepSpeedTransformerConfig
     causal: bool = False
     use_flash: bool = True
+    # When a mesh with a >1 ``sequence`` axis is supplied, attention runs
+    # sequence-parallel (ring / Ulysses all-to-all, parallel/sequence.py) —
+    # the long-context path the reference cannot express (its kernel caps
+    # seq at 1024, ds_transformer_cuda.cpp:133).
+    mesh: Optional[object] = None
+    seq_parallel_impl: str = "auto"
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None, train: bool = True):
@@ -149,12 +155,35 @@ class DeepSpeedTransformerLayer(nn.Module):
             def split_heads(t):
                 return t.reshape(b, s, heads, head_dim).transpose(0, 2, 1, 3)
 
-            ctx = attention(
-                split_heads(q), split_heads(k_), split_heads(v),
-                mask=attention_mask, causal=self.causal,
-                dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
-                dropout_rng=attn_rng, use_flash=self.use_flash,
+            from ..config import constants as C
+
+            seq_parallel = (
+                self.mesh is not None
+                and dict(self.mesh.shape).get(C.SEQUENCE_AXIS, 1) > 1
             )
+            if seq_parallel:
+                from ..parallel.sequence import sequence_parallel_attention
+
+                kv_valid = additive_mask_to_kv_valid(attention_mask)
+                if attention_mask is not None and kv_valid is None:
+                    raise ValueError(
+                        "sequence-parallel attention supports padding-style "
+                        "masks only (broadcast over the query dim)"
+                    )
+                ctx = sequence_parallel_attention(
+                    split_heads(q), split_heads(k_), split_heads(v),
+                    self.mesh, kv_valid, impl=self.seq_parallel_impl,
+                    use_flash=self.use_flash, causal=self.causal,
+                    dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
+                    dropout_rng=attn_rng,
+                )
+            else:
+                ctx = attention(
+                    split_heads(q), split_heads(k_), split_heads(v),
+                    mask=attention_mask, causal=self.causal,
+                    dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
+                    dropout_rng=attn_rng, use_flash=self.use_flash,
+                )
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, H)  # transform4d_0213
             attn_out = ctx @ attn_ow + attn_ob
             attn_out = hid_dropout(attn_out, h1_rng)

@@ -1,0 +1,46 @@
+"""Parallelism: device mesh topology, mpu protocol, sequence parallelism.
+
+The mesh replaces the reference's NCCL process groups (SURVEY.md §2.4);
+``sequence`` adds ring attention / Ulysses all-to-all context parallelism,
+which the reference lacks entirely.
+"""
+
+from .mesh import (
+    DATA_AXIS,
+    MESH_AXES,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    MeshTopology,
+    build_mesh,
+    mesh_from_config,
+    resolve_topology,
+)
+from .mpu import ExternalMpuAdapter, TPUMpu, as_mpu
+from .sequence import (
+    ring_attention,
+    ring_attention_local,
+    sequence_parallel_attention,
+    ulysses_attention,
+    ulysses_attention_local,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MESH_AXES",
+    "MODEL_AXIS",
+    "PIPE_AXIS",
+    "SEQ_AXIS",
+    "MeshTopology",
+    "build_mesh",
+    "mesh_from_config",
+    "resolve_topology",
+    "ExternalMpuAdapter",
+    "TPUMpu",
+    "as_mpu",
+    "ring_attention",
+    "ring_attention_local",
+    "sequence_parallel_attention",
+    "ulysses_attention",
+    "ulysses_attention_local",
+]

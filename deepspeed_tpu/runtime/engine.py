@@ -115,7 +115,9 @@ class DeepSpeedEngine:
         rng_seed=0,
         param_specs=None,
     ):
-        del dist_init_required  # jax.distributed is initialized by the launcher
+        from .dist import init_distributed
+
+        init_distributed(dist_init_required)
         # param_specs: optional pytree of PartitionSpecs (same structure as
         # the params) carrying model-parallel shardings, e.g.
         # models.gpt2.partition_specs — the TPU-native replacement for the

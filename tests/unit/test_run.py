@@ -1,0 +1,144 @@
+"""Launcher unit tests (reference analog: tests/unit/test_run.py — pure
+functions, no processes; plus a real single-node end-to-end launch)."""
+
+import base64
+import json
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher import launch as dsl
+from deepspeed_tpu.launcher import runner as dsr
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    def _write(text):
+        p = tmp_path / "hostfile"
+        p.write_text(text)
+        return str(p)
+
+    return _write
+
+
+def test_fetch_hostfile(hostfile):
+    path = hostfile("worker-0 slots=4\nworker-1 slots=2\n\n# comment\n")
+    pool = dsr.fetch_hostfile(path)
+    assert list(pool.items()) == [("worker-0", 4), ("worker-1", 2)]
+
+
+def test_fetch_hostfile_missing_returns_none(tmp_path):
+    assert dsr.fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_fetch_hostfile_bad_format(hostfile):
+    with pytest.raises(ValueError):
+        dsr.fetch_hostfile(hostfile("worker-0 4\n"))
+
+
+def test_fetch_hostfile_duplicate(hostfile):
+    with pytest.raises(ValueError, match="already defined"):
+        dsr.fetch_hostfile(hostfile("w0 slots=4\nw0 slots=4\n"))
+
+
+def _pool(**kw):
+    import collections
+
+    return collections.OrderedDict(kw)
+
+
+def test_include_filter():
+    pool = _pool(w0=4, w1=4)
+    active = dsr.parse_inclusion_exclusion(pool, "w0@w1:0,2", "")
+    assert active == {"w0": [0, 1, 2, 3], "w1": [0, 2]}
+
+
+def test_exclude_filter():
+    pool = _pool(w0=4, w1=4)
+    active = dsr.parse_inclusion_exclusion(pool, "", "w1:0")
+    assert active == {"w0": [0, 1, 2, 3], "w1": [1, 2, 3]}
+
+
+def test_exclude_whole_node_drops_host():
+    pool = _pool(w0=2, w1=2)
+    active = dsr.parse_inclusion_exclusion(pool, "", "w1")
+    assert list(active.keys()) == ["w0"]
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        dsr.parse_inclusion_exclusion(_pool(w0=2), "w0", "w0")
+
+
+def test_filter_unknown_host_and_slot():
+    with pytest.raises(ValueError, match="not found"):
+        dsr.parse_inclusion_exclusion(_pool(w0=2), "w9", "")
+    with pytest.raises(ValueError, match="No slot"):
+        dsr.parse_inclusion_exclusion(_pool(w0=2), "w0:7", "")
+
+
+def test_filter_preserves_hostfile_order():
+    pool = _pool(a=2, b=2, c=2)
+    active = dsr.parse_inclusion_exclusion(pool, "c@a", "")
+    assert list(active.keys()) == ["a", "c"]
+
+
+def test_world_info_roundtrip():
+    info = {"w0": [0, 1], "w1": [2]}
+    enc = dsr.encode_world_info(info)
+    assert dsl.decode_world_info(enc) == info
+    # urlsafe base64 of compact json
+    assert json.loads(base64.urlsafe_b64decode(enc)) == info
+
+
+def test_resolve_node_rank_numeric_and_hostname():
+    info = {"hostA": [0], "hostB": [0]}
+
+    class A:
+        node_rank = "1"
+
+    assert dsl.resolve_node_rank(A, info) == 1
+
+    class B:
+        node_rank = "%n"  # pdsh token never substituted -> hostname lookup
+
+    import socket
+
+    info2 = {socket.gethostname(): [0], "other": [0]}
+    assert dsl.resolve_node_rank(B, info2) == 0
+
+
+def test_build_env_sets_coordinator_vars():
+    class Args:
+        master_addr = "10.0.0.1"
+        master_port = 29501
+
+    info = {"h0": [0, 1], "h1": [0, 1]}
+    env = dsl.build_env(Args, info, 1)
+    assert env["DS_TPU_COORDINATOR_ADDRESS"] == "10.0.0.1:29501"
+    assert env["DS_TPU_NUM_PROCESSES"] == "2"
+    assert env["DS_TPU_PROCESS_ID"] == "1"
+    assert env["RANK"] == "1" and env["WORLD_SIZE"] == "2"
+    assert env["DS_TPU_LOCAL_CHIPS"] == "0,1"
+
+
+def test_single_node_end_to_end(tmp_path):
+    """bin/deepspeed-equivalent single-node launch runs the user script with
+    the launcher env set."""
+    script = tmp_path / "user.py"
+    script.write_text(
+        "import os\n"
+        "print('RANK=' + os.environ.get('RANK', 'missing'))\n"
+        "print('WS=' + os.environ.get('WORLD_SIZE', 'missing'))\n"
+    )
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+            "--hostfile", str(tmp_path / "absent"), str(script),
+        ],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr
+    assert "RANK=0" in out.stdout
+    assert "WS=1" in out.stdout
