@@ -22,8 +22,10 @@ _maybe_auto_init()
 from .config import DeepSpeedConfig
 from .config import constants as _constants
 from .ops.optimizers import Adam, Lamb, Lion, Optimizer, SGD
+from .ops.transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
 from .runtime.engine import DeepSpeedEngine
 from .version import __version__
+from . import checkpointing
 
 
 def initialize(
@@ -114,9 +116,13 @@ def add_config_arguments(parser):
 
 __all__ = [
     "initialize",
+    "init_distributed",
     "add_config_arguments",
+    "checkpointing",
     "DeepSpeedConfig",
     "DeepSpeedEngine",
+    "DeepSpeedTransformerConfig",
+    "DeepSpeedTransformerLayer",
     "Optimizer",
     "Adam",
     "Lamb",

@@ -235,6 +235,22 @@ class DeepSpeedEngine:
 
         # ---- lr scheduler ---------------------------------------------
         self.lr_scheduler = self._configure_lr_scheduler()
+
+        # activation checkpointing module flags from the json config
+        # (reference _configure_checkpointing, deepspeed_light.py:374)
+        from .. import checkpointing as _act_ckpt
+
+        _act_ckpt.configure(self.mpu, deepspeed_config=self.config)
+
+        # rank-0 scalar event stream (reference tensorboard wiring,
+        # deepspeed_light.py:749-762,876-931)
+        from ..utils.monitor import Monitor
+
+        self.monitor = Monitor(
+            enabled=self.config.tensorboard_enabled and jax.process_index() == 0,
+            output_path=self.config.tensorboard_output_path,
+            job_name=self.config.tensorboard_job_name,
+        )
         base_lr = self.config.optimizer_params.get("lr", 1e-3)
         self._base_lr = float(base_lr)
 
@@ -609,6 +625,17 @@ class DeepSpeedEngine:
                 f"{float(self.loss_scale_state.loss_scale)}",
                 ranks=[0],
             )
+        if self.monitor.enabled and not self.last_overflow:
+            scalars = {
+                "Train/lr": float(self.get_lr()[0] if isinstance(
+                    self.get_lr(), (list, tuple)) else self.get_lr()),
+                "Train/loss_scale": float(self.loss_scale_state.loss_scale),
+            }
+            if self._pending_loss is not None:
+                scalars["Train/loss"] = float(self._pending_loss)
+            if self._last_grad_norm is not None:
+                scalars["Train/grad_norm"] = float(self._last_grad_norm)
+            self.monitor.write_scalars(scalars, self.global_steps)
 
     def train_batch(self, batch_iter_or_batches):
         """Native fast path: run a full accumulation window (forward,

@@ -1,0 +1,89 @@
+"""Monitoring: scalar event streams (TensorBoard with JSONL fallback).
+
+Reference: the engine's rank-0 TensorBoard wiring — loss/lr/per-phase-ms
+scalar streams created lazily behind the ``tensorboard`` config block
+(reference deepspeed_light.py:749-762,876-931 and get_summary_writer
+:374-381). torch's SummaryWriter is used when importable (torch-cpu ships
+one); otherwise events append to a ``events.jsonl`` so headless TPU pods
+still record training curves.
+"""
+
+import json
+import os
+import time
+
+from .logging import logger
+
+
+class JsonlSummaryWriter:
+    """Minimal SummaryWriter-compatible scalar sink: one JSON object per
+    line {tag, value, step, wall_time}."""
+
+    def __init__(self, log_dir):
+        os.makedirs(log_dir, exist_ok=True)
+        self._path = os.path.join(log_dir, "events.jsonl")
+        self._fd = open(self._path, "a")
+
+    def add_scalar(self, tag, value, global_step=None):
+        self._fd.write(
+            json.dumps(
+                {
+                    "tag": tag,
+                    "value": float(value),
+                    "step": global_step,
+                    "wall_time": time.time(),
+                }
+            )
+            + "\n"
+        )
+
+    def flush(self):
+        self._fd.flush()
+
+    def close(self):
+        self._fd.close()
+
+
+def get_summary_writer(
+    name="DeepSpeedJobName",
+    base=os.path.join(os.path.expanduser("~"), "tensorboard"),
+):
+    """Create a scalar writer under ``base/name`` (reference
+    deepspeed_light.py:374-381's directory convention)."""
+    log_dir = os.path.join(base, name)
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(log_dir=log_dir)
+    except Exception:
+        logger.info(
+            "torch tensorboard unavailable; writing scalar events to %s",
+            os.path.join(log_dir, "events.jsonl"),
+        )
+        return JsonlSummaryWriter(log_dir)
+
+
+class Monitor:
+    """Engine-facing facade: no-ops unless enabled on this process (rank 0
+    writes, like the reference's ``self.tensorboard_enabled() and
+    self.global_rank == 0`` guards)."""
+
+    def __init__(self, enabled, output_path="", job_name="DeepSpeedJobName"):
+        self.enabled = enabled
+        self.writer = None
+        if enabled:
+            base = output_path or os.path.join(
+                os.path.expanduser("~"), "tensorboard"
+            )
+            self.writer = get_summary_writer(name=job_name, base=base)
+
+    def write_scalars(self, scalars, step):
+        if not self.writer:
+            return
+        for tag, value in scalars.items():
+            self.writer.add_scalar(tag, value, global_step=step)
+        self.writer.flush()
+
+    def close(self):
+        if self.writer:
+            self.writer.close()

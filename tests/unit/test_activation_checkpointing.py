@@ -1,0 +1,162 @@
+"""Activation checkpointing API (reference deepspeed_checkpointing.py:
+RNG tracker, checkpoint(), partitioning, config plumbing)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import checkpointing as ckpt
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.parallel.mpu import TPUMpu
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    ckpt.configure(
+        None, partition_activations=False, checkpoint_in_cpu=False,
+        contiguous_checkpointing=False, num_checkpoints=1, profile=False,
+        synchronize=False,
+    )
+
+
+def _fn(x, w):
+    for _ in range(3):
+        x = jnp.tanh(x @ w)
+    return jnp.sum(x**2)
+
+
+def test_checkpoint_preserves_value_and_grad():
+    ckpt.configure(None)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((16, 16)) * 0.1, jnp.float32)
+
+    ref_val, ref_grad = jax.value_and_grad(_fn, argnums=1)(x, w)
+    val, grad = jax.value_and_grad(
+        lambda x, w: ckpt.checkpoint(_fn, x, w), argnums=1
+    )(x, w)
+    np.testing.assert_allclose(np.asarray(val), np.asarray(ref_val), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad), rtol=1e-6)
+
+
+@pytest.mark.parametrize("flag", ["partition", "cpu"])
+def test_checkpoint_modes_match_baseline(flag):
+    mesh = build_mesh(data_parallel_size=4, model_parallel_size=2)
+    ckpt.configure(
+        TPUMpu(mesh),
+        partition_activations=(flag == "partition"),
+        checkpoint_in_cpu=(flag == "cpu"),
+    )
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((16, 16)) * 0.1, jnp.float32)
+
+    @jax.jit
+    def loss(x, w):
+        return ckpt.checkpoint(_fn, x, w)
+
+    ref = _fn(x, w)
+    val, grad = jax.value_and_grad(loss, argnums=1)(x, w)
+    ref_grad = jax.grad(_fn, argnums=1)(x, w)
+    # sharding the saved residual reorders f32 reductions: tolerance is
+    # parity-level, not bit-level
+    np.testing.assert_allclose(np.asarray(val), np.asarray(ref), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grad), np.asarray(ref_grad), rtol=1e-3, atol=1e-5
+    )
+
+
+def test_configure_from_deepspeed_config(tmp_path):
+    import json
+
+    cfg_path = tmp_path / "ds.json"
+    cfg_path.write_text(json.dumps({
+        "train_batch_size": 8,
+        "activation_checkpointing": {
+            "partition_activations": True,
+            "cpu_checkpointing": True,
+            "profile": True,
+            "number_checkpoints": 4,
+        },
+    }))
+    from deepspeed_tpu.config import DeepSpeedConfig
+
+    ds_config = DeepSpeedConfig(str(cfg_path), world_size=1)
+    ckpt.configure(None, deepspeed_config=ds_config)
+    assert ckpt.is_configured()
+    assert ckpt.PARTITION_ACTIVATIONS and ckpt.CPU_CHECKPOINT and ckpt.PROFILE_TIME
+
+
+def test_contiguous_requires_num_checkpoints():
+    with pytest.raises(AssertionError, match="number of checkpoints"):
+        ckpt.configure(None, contiguous_checkpointing=True, num_checkpoints=-1)
+
+
+def test_rng_tracker_fork_streams():
+    tracker = ckpt.model_parallel_seed(1234)
+    with tracker.fork() as k1:
+        a = jax.random.normal(k1, (4,))
+    with tracker.fork() as k2:
+        b = jax.random.normal(k2, (4,))
+    # consecutive forks advance the stream
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    # re-seeding reproduces the same stream
+    tracker = ckpt.model_parallel_seed(1234)
+    with tracker.fork() as k1b:
+        a2 = jax.random.normal(k1b, (4,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(a2))
+
+
+def test_rng_tracker_mp_rank_dependence():
+    class FakeMpu:
+        def __init__(self, r):
+            self.r = r
+
+        def get_model_parallel_rank(self):
+            return self.r
+
+    t0 = ckpt.model_parallel_seed(7, mpu=FakeMpu(0))
+    with t0.fork() as k:
+        a = jax.random.normal(k, (4,))
+    t1 = ckpt.model_parallel_seed(7, mpu=FakeMpu(1))
+    with t1.fork() as k:
+        b = jax.random.normal(k, (4,))
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    # default (replicated) stream is rank-independent
+    d0 = t0.get_states()["default"]
+    d1 = t1.get_states()["default"]
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_duplicate_seed_rejected():
+    tracker = ckpt.RNGStatesTracker()
+    tracker.add("a", 1)
+    with pytest.raises(ValueError, match="seed"):
+        tracker.add("b", 1)
+    with pytest.raises(ValueError, match="state"):
+        tracker.add("a", 2)
+
+
+def test_engine_configures_checkpointing():
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return jnp.sum(nn.Dense(4)(x) ** 2)
+
+    m = M()
+    params = m.init(jax.random.PRNGKey(0), jnp.ones((2, 4)))["params"]
+    deepspeed_tpu.initialize(
+        model=m, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "activation_checkpointing": {"partition_activations": True},
+        },
+    )
+    assert ckpt.is_configured()
+    assert ckpt.PARTITION_ACTIVATIONS

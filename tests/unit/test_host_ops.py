@@ -1,0 +1,111 @@
+"""Native host-ops extension + numpy fallback parity."""
+
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import host_ops
+
+
+def _arrays():
+    rng = np.random.default_rng(0)
+    return [
+        rng.standard_normal((4, 8)).astype(np.float32),
+        rng.integers(0, 100, (16,)).astype(np.int64),
+        rng.standard_normal((2, 3, 5)).astype(np.float32),
+    ]
+
+
+def test_flatten_unflatten_roundtrip():
+    arrays = _arrays()
+    flat = host_ops.flatten(arrays)
+    assert flat.nbytes == sum(a.nbytes for a in arrays)
+    targets = [np.zeros_like(a) for a in arrays]
+    host_ops.unflatten_into(flat, targets)
+    for a, b in zip(arrays, targets):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_unflatten_size_mismatch_raises():
+    arrays = _arrays()
+    flat = host_ops.flatten(arrays)
+    bad = [np.zeros((1,), np.float32)]
+    with pytest.raises(ValueError):
+        host_ops.unflatten_into(flat, bad)
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.default_rng(1)
+    src = rng.standard_normal((100, 32)).astype(np.float32)
+    idx = rng.integers(0, 100, (17,)).astype(np.int64)
+    out = host_ops.gather_rows(src, idx)
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_gather_rows_bad_index():
+    src = np.zeros((4, 2), np.float32)
+    if not host_ops.HAVE_NATIVE:
+        pytest.skip("native bounds check only")
+    with pytest.raises(ValueError):
+        host_ops.gather_rows(src, np.asarray([5], np.int64))
+
+
+def test_shuffled_indices_deterministic_permutation():
+    a = host_ops.shuffled_indices(1000, seed=42)
+    b = host_ops.shuffled_indices(1000, seed=42)
+    c = host_ops.shuffled_indices(1000, seed=43)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    np.testing.assert_array_equal(np.sort(a), np.arange(1000))
+
+
+def test_prefetch_queue_orders_and_exhausts():
+    items = iter(range(5))
+
+    def producer():
+        try:
+            return next(items)
+        except StopIteration:
+            raise StopIteration
+
+    q = host_ops.make_prefetch_queue(producer, capacity=2)
+    got = [q.get(timeout=10.0) for _ in range(5)]
+    assert got == [0, 1, 2, 3, 4]
+    with pytest.raises((StopIteration, TimeoutError)):
+        q.get(timeout=5.0)
+    q.stop()
+
+
+def test_prefetch_queue_overlaps_producer():
+    """Producer sleeps; consumer should see items already buffered."""
+
+    state = {"n": 0}
+
+    def producer():
+        if state["n"] >= 3:
+            raise StopIteration
+        state["n"] += 1
+        time.sleep(0.05)
+        return state["n"]
+
+    q = host_ops.make_prefetch_queue(producer, capacity=4)
+    time.sleep(0.5)  # let the worker fill the buffer
+    assert q.qsize() >= 2
+    assert q.get(timeout=5.0) == 1
+    q.stop()
+
+
+def test_prefetch_queue_stop_mid_stream():
+    def producer():
+        time.sleep(0.01)
+        return 1
+
+    q = host_ops.make_prefetch_queue(producer, capacity=2)
+    assert q.get(timeout=5.0) == 1
+    q.stop()  # must not hang or crash
+
+
+@pytest.mark.skipif(not host_ops.HAVE_NATIVE, reason="extension not built")
+def test_native_extension_is_loaded():
+    assert host_ops.HAVE_NATIVE

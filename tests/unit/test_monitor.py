@@ -1,0 +1,77 @@
+"""Monitoring: scalar event streams + engine tensorboard wiring."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.utils.monitor import JsonlSummaryWriter, Monitor
+
+
+def test_jsonl_writer_roundtrip(tmp_path):
+    w = JsonlSummaryWriter(str(tmp_path / "tb"))
+    w.add_scalar("Train/loss", 1.5, global_step=3)
+    w.add_scalar("Train/lr", 0.01, global_step=3)
+    w.flush()
+    w.close()
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "tb" / "events.jsonl").read().splitlines()
+    ]
+    assert lines[0]["tag"] == "Train/loss" and lines[0]["value"] == 1.5
+    assert lines[1]["step"] == 3
+
+
+def test_monitor_disabled_is_noop():
+    m = Monitor(enabled=False)
+    m.write_scalars({"a": 1.0}, 1)  # must not raise
+    m.close()
+
+
+def test_engine_writes_events(tmp_path):
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, y, train=True):
+            pred = nn.Dense(1)(x)
+            return jnp.mean((pred[:, 0] - y) ** 2)
+
+    m = M()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    y = rng.standard_normal((8,)).astype(np.float32)
+    params = m.init(jax.random.PRNGKey(0), x[:2], y[:2])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 1000,
+            "tensorboard": {
+                "enabled": True,
+                "output_path": str(tmp_path),
+                "job_name": "job",
+            },
+        },
+    )
+    for _ in range(3):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    engine.monitor.close()
+    # either torch tensorboard event files or the jsonl fallback must exist
+    job_dir = tmp_path / "job"
+    assert job_dir.exists()
+    contents = os.listdir(job_dir)
+    assert contents, "no event files written"
+    if "events.jsonl" in contents:
+        lines = [
+            json.loads(l)
+            for l in open(job_dir / "events.jsonl").read().splitlines()
+        ]
+        tags = {l["tag"] for l in lines}
+        assert {"Train/lr", "Train/loss", "Train/loss_scale"} <= tags
