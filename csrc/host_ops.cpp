@@ -11,8 +11,9 @@
 //     (un)flattening of parameter/gradient pytrees for checkpoint IO.
 //   gather_rows(src, row_bytes, indices, out)   -- threaded row gather for
 //     batch assembly from a memory-mapped / pinned sample store.
-//   shuffled_indices(n, seed)                   -- Fisher-Yates epoch
-//     shuffle (mt19937_64), bit-stable across platforms for resume.
+//   shuffled_indices(n, seed)                   -- splitmix64 sort-key epoch
+//     shuffle, bit-stable across platforms AND across the numpy fallback
+//     (same permutation either way) for checkpoint resume of data order.
 //   PrefetchQueue                               -- bounded producer queue
 //     with a C++ thread driving a Python producer callable (GIL acquired
 //     per call, released while the consumer computes): overlaps host batch
@@ -25,13 +26,13 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
 #include <mutex>
-#include <random>
 #include <thread>
 #include <vector>
 
@@ -205,6 +206,28 @@ PyObject* py_gather_rows(PyObject*, PyObject* args) {
   }
 
   size_t n_idx = static_cast<size_t>(idx.len) / sizeof(int64_t);
+  if (n_idx == 0) {
+    // empty gather succeeds regardless of row_bytes (matches numpy fallback)
+    PyBuffer_Release(&src);
+    PyBuffer_Release(&idx);
+    PyBuffer_Release(&out);
+    if (out.len != 0) {
+      PyErr_SetString(PyExc_ValueError,
+                      "gather_rows: size mismatch for empty index set");
+      return nullptr;
+    }
+    Py_RETURN_NONE;
+  }
+  if (row_bytes <= 0 ||
+      static_cast<size_t>(src.len) % static_cast<size_t>(row_bytes) != 0) {
+    PyBuffer_Release(&src);
+    PyBuffer_Release(&idx);
+    PyBuffer_Release(&out);
+    PyErr_SetString(PyExc_ValueError,
+                    "gather_rows: row_bytes must be positive and divide "
+                    "the source buffer size");
+    return nullptr;
+  }
   size_t n_src_rows = static_cast<size_t>(src.len) / row_bytes;
   const int64_t* indices = static_cast<const int64_t*>(idx.buf);
   bool ok = static_cast<size_t>(out.len) == n_idx * row_bytes;
@@ -245,7 +268,20 @@ PyObject* py_gather_rows(PyObject*, PyObject* args) {
 
 // ---------------------------------------------------------------------------
 // shuffled_indices(n, seed) -> bytes of int64
+//
+// Sort-by-random-key permutation with splitmix64 per-index keys. Chosen over
+// mt19937_64 Fisher-Yates because the algorithm is fully specified here (no
+// std::uniform_int_distribution, whose output is implementation-defined), so
+// the numpy fallback in runtime/host_ops.py reproduces the exact permutation
+// bit-for-bit: checkpoint resume of the data order is backend-independent.
 // ---------------------------------------------------------------------------
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
 
 PyObject* py_shuffled_indices(PyObject*, PyObject* args) {
   Py_ssize_t n;
@@ -260,12 +296,17 @@ PyObject* py_shuffled_indices(PyObject*, PyObject* args) {
   if (!out) return nullptr;
   int64_t* data = reinterpret_cast<int64_t*>(PyByteArray_AS_STRING(out));
   Py_BEGIN_ALLOW_THREADS
-  for (Py_ssize_t i = 0; i < n; ++i) data[i] = i;
-  std::mt19937_64 rng(seed);
-  for (Py_ssize_t i = n - 1; i > 0; --i) {
-    std::uniform_int_distribution<Py_ssize_t> dist(0, i);
-    std::swap(data[i], data[dist(rng)]);
+  const uint64_t s0 = splitmix64(static_cast<uint64_t>(seed));
+  std::vector<uint64_t> keys(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    keys[i] = splitmix64(s0 ^ splitmix64(static_cast<uint64_t>(i)));
+    data[i] = i;
   }
+  // stable sort: key ties (vanishingly rare) break by index on both the
+  // native and numpy (kind='stable') paths identically
+  std::stable_sort(data, data + n, [&keys](int64_t a, int64_t b) {
+    return keys[static_cast<size_t>(a)] < keys[static_cast<size_t>(b)];
+  });
   Py_END_ALLOW_THREADS
   return out;
 }
@@ -281,6 +322,7 @@ struct PrefetchQueue {
   std::deque<PyObject*>* items;
   std::thread* worker;
   PyObject* producer;  // callable returning the next item, or raising StopIteration
+  PyObject* error;     // exception instance raised by the producer, if any
   size_t capacity;
   std::atomic<bool>* stopped;
   std::atomic<bool>* exhausted;
@@ -301,11 +343,13 @@ void prefetch_worker(PrefetchQueue* q) {
     if (!item) {
       if (PyErr_ExceptionMatches(PyExc_StopIteration)) {
         PyErr_Clear();
-        stop_iteration = true;
       } else {
-        PyErr_WriteUnraisable(q->producer);
-        stop_iteration = true;  // treat producer errors as end-of-stream
+        // stash the producer's exception; get() re-raises it so a data
+        // pipeline bug fails the training loop instead of silently
+        // truncating the epoch
+        q->error = PyErr_GetRaisedException();
       }
+      stop_iteration = true;
     }
     PyGILState_Release(gil);
     if (stop_iteration) {
@@ -339,6 +383,7 @@ PyObject* PrefetchQueue_new(PyTypeObject* type, PyObject* args, PyObject*) {
   self->exhausted = new std::atomic<bool>(false);
   Py_INCREF(producer);
   self->producer = producer;
+  self->error = nullptr;
   self->capacity = static_cast<size_t>(capacity);
   self->worker = new std::thread(prefetch_worker, self);
   return reinterpret_cast<PyObject*>(self);
@@ -385,6 +430,11 @@ PyObject* PrefetchQueue_get(PyObject* obj, PyObject* args, PyObject* kwargs) {
     return nullptr;
   }
   if (!item) {
+    if (self->error) {
+      PyErr_SetRaisedException(self->error);  // steals our reference
+      self->error = nullptr;
+      return nullptr;
+    }
     PyErr_SetString(PyExc_StopIteration, "producer exhausted");
     return nullptr;
   }
@@ -416,6 +466,7 @@ void PrefetchQueue_dealloc(PyObject* obj) {
   delete self->cv;
   delete self->stopped;
   delete self->exhausted;
+  Py_XDECREF(self->error);
   Py_XDECREF(self->producer);
   Py_TYPE(obj)->tp_free(obj);
 }
@@ -444,7 +495,7 @@ PyMethodDef module_methods[] = {
     {"gather_rows", py_gather_rows, METH_VARARGS,
      "gather_rows(src, row_bytes, int64_indices, out)"},
     {"shuffled_indices", py_shuffled_indices, METH_VARARGS,
-     "shuffled_indices(n, seed) -> bytearray of int64 (Fisher-Yates)"},
+     "shuffled_indices(n, seed) -> bytearray of int64 (splitmix64 sort keys)"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef module_def = {

@@ -229,6 +229,7 @@ def set_num_layers(nlayers):
 def reset():
     """Reference :579 resets per-iteration contiguous buffers; stateless
     here, but also clears the RNG tracker for test isolation."""
+    _RNG_TRACKER.reset()
 
 
 def configure(

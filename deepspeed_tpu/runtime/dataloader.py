@@ -7,6 +7,13 @@ per-rank DistributedSampler, a single global batch is assembled on host and
 gets its micro-batch slice directly, and the throughput timer starts on
 ``__next__`` exactly like the reference (:58-59).
 
+Host hot spots run through the native extension (runtime/host_ops.py,
+csrc/host_ops.cpp — the role torch's C++ DataLoader workers + apex host ops
+play for the reference): deterministic epoch shuffling
+(``shuffled_indices``), threaded row gather for array datasets
+(``gather_rows``), and a background prefetch queue overlapping batch
+assembly with device steps.
+
 Accepted datasets: torch-style map datasets (__len__/__getitem__), tuples of
 numpy/jnp arrays (sliced along dim 0), or any iterable of ready batches.
 """
@@ -14,6 +21,7 @@ numpy/jnp arrays (sliced along dim 0), or any iterable of ready batches.
 import numpy as np
 
 from ..parallel import mesh as mesh_lib
+from . import host_ops
 
 
 def _default_collate(samples):
@@ -37,6 +45,7 @@ class DeepSpeedDataLoader:
         seed=0,
         drop_last=True,
         tput_timer=None,
+        prefetch=2,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -46,6 +55,7 @@ class DeepSpeedDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.tput_timer = tput_timer
+        self.prefetch = prefetch
         self._epoch = 0
 
         if isinstance(dataset, (tuple, list)) and all(
@@ -77,18 +87,49 @@ class DeepSpeedDataLoader:
             for batch in self.dataset:
                 yield self._place(batch)
             return
-        order = np.arange(self._num_samples)
         if self.shuffle:
-            rng = np.random.default_rng(self.seed + self._epoch)
-            rng.shuffle(order)
+            # bit-stable permutation (native or numpy, identical either way)
+            # so checkpoint resume replays the same data order
+            order = host_ops.shuffled_indices(
+                self._num_samples, self.seed + self._epoch
+            )
+        else:
+            order = np.arange(self._num_samples, dtype=np.int64)
         nb = len(self)
-        for b in range(nb):
+        if self._mode == "arrays":
+            # hoist host conversion: for jnp-backed datasets np.asarray is a
+            # device->host copy, so do it once per epoch, not per batch
+            arrays = [np.asarray(a) for a in self.dataset]
+
+        def assemble(b):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
             if self._mode == "arrays":
-                batch = tuple(np.asarray(a)[idx] for a in self.dataset)
-            else:
-                batch = self.collate_fn([self.dataset[int(i)] for i in idx])
-            yield self._place(batch)
+                return tuple(
+                    host_ops.gather_rows(a, idx) if a.ndim >= 1 else a
+                    for a in arrays
+                )
+            return self.collate_fn([self.dataset[int(i)] for i in idx])
+
+        if self.prefetch and self.prefetch > 0:
+            counter = iter(range(nb))
+
+            def producer():
+                b = next(counter)  # StopIteration ends the stream
+                return assemble(b)
+
+            q = host_ops.make_prefetch_queue(producer, capacity=self.prefetch)
+            try:
+                while True:
+                    try:
+                        batch = q.get(timeout=600.0)
+                    except StopIteration:
+                        break
+                    yield self._place(batch)
+            finally:
+                q.stop()
+        else:
+            for b in range(nb):
+                yield self._place(assemble(b))
 
     def _place(self, batch):
         if self.tput_timer is not None:

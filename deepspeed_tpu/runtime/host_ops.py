@@ -9,6 +9,7 @@ queue.Queue when the extension is absent, so the framework works from a
 plain source checkout.
 """
 
+import logging
 import queue
 import threading
 
@@ -56,25 +57,34 @@ def gather_rows(src, indices, out=None):
     if out is None:
         out = np.empty((indices.shape[0],) + src.shape[1:], src.dtype)
     if HAVE_NATIVE:
-        row_bytes = src[0].nbytes if src.shape[0] else 0
+        # from the shape, not src[0]: stays positive for 0-row sources so
+        # the native and numpy paths agree on empty gathers
+        row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.itemsize
         _C.gather_rows(src, row_bytes, indices, out)
         return out
     np.take(src, indices, axis=0, out=out)
     return out
 
 
+def _splitmix64(x):
+    """Vectorized splitmix64 over uint64 arrays (wrapping arithmetic)."""
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
 def shuffled_indices(n, seed):
-    """Deterministic Fisher-Yates permutation of range(n) (bit-stable
-    across runs/platforms, for checkpoint-resume of the data order)."""
+    """Deterministic permutation of range(n): per-index splitmix64 sort
+    keys. Bit-identical between the native extension and this numpy path,
+    so checkpoint resume of the data order is backend-independent."""
+    seed = int(seed) & 0xFFFFFFFFFFFFFFFF  # match the native 'K' wrap
     if HAVE_NATIVE:
         return np.frombuffer(bytes(_C.shuffled_indices(n, seed)), dtype=np.int64)
-    # numpy fallback mirrors the same algorithm with the same generator
-    # family; exact permutation parity with the native path is not
-    # guaranteed, but determinism per (n, seed) is
-    rng = np.random.Generator(np.random.MT19937(seed))
-    idx = np.arange(n, dtype=np.int64)
-    rng.shuffle(idx)
-    return idx
+    s0 = _splitmix64(np.asarray(seed, np.uint64))
+    keys = _splitmix64(s0 ^ _splitmix64(np.arange(n, dtype=np.uint64)))
+    return np.argsort(keys, kind="stable").astype(np.int64)
 
 
 class _PyPrefetchQueue:
@@ -84,6 +94,7 @@ class _PyPrefetchQueue:
         self._q = queue.Queue(maxsize=capacity)
         self._stop = threading.Event()
         self._sentinel = object()
+        self._producer_error = None
 
         def run():
             while not self._stop.is_set():
@@ -92,7 +103,11 @@ class _PyPrefetchQueue:
                 except StopIteration:
                     self._q.put(self._sentinel)
                     return
-                except Exception:
+                except Exception as exc:  # surface from get(), don't swallow
+                    logging.getLogger("DeepSpeed").exception(
+                        "prefetch producer raised; stream terminated"
+                    )
+                    self._producer_error = exc
                     self._q.put(self._sentinel)
                     return
                 self._q.put(item)
@@ -103,6 +118,8 @@ class _PyPrefetchQueue:
     def get(self, timeout=60.0):
         item = self._q.get(timeout=timeout)
         if item is self._sentinel:
+            if self._producer_error is not None:
+                raise self._producer_error
             raise StopIteration("producer exhausted")
         return item
 

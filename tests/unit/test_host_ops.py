@@ -109,3 +109,72 @@ def test_prefetch_queue_stop_mid_stream():
 @pytest.mark.skipif(not host_ops.HAVE_NATIVE, reason="extension not built")
 def test_native_extension_is_loaded():
     assert host_ops.HAVE_NATIVE
+
+
+@pytest.mark.skipif(not host_ops.HAVE_NATIVE, reason="needs both backends")
+def test_shuffled_indices_native_matches_numpy_fallback():
+    """Checkpoint resume of the data order must not depend on whether the
+    extension is built: both backends emit the identical permutation."""
+    for n, seed in [(1, 0), (17, 3), (1000, 42), (4096, 2**63)]:
+        native = host_ops.shuffled_indices(n, seed)
+        s0 = host_ops._splitmix64(np.asarray(seed, np.uint64))
+        keys = host_ops._splitmix64(
+            s0 ^ host_ops._splitmix64(np.arange(n, dtype=np.uint64))
+        )
+        fallback = np.argsort(keys, kind="stable").astype(np.int64)
+        np.testing.assert_array_equal(native, fallback)
+
+
+def test_gather_rows_empty_src_rejected():
+    if not host_ops.HAVE_NATIVE:
+        pytest.skip("native guard only")
+    import _ds_host_ops as C
+
+    with pytest.raises(ValueError):
+        C.gather_rows(
+            np.zeros((0, 4), np.float32), 0,
+            np.asarray([0], np.int64), np.zeros((1, 4), np.float32),
+        )
+
+
+@pytest.mark.parametrize("backend", ["native", "fallback"])
+def test_prefetch_queue_surfaces_producer_error(backend):
+    """A data-pipeline bug must fail the consumer, not silently truncate
+    the epoch — on both the C++ queue and the Python fallback."""
+    if backend == "native" and not host_ops.HAVE_NATIVE:
+        pytest.skip("extension not built")
+    calls = {"n": 0}
+
+    def producer():
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise RuntimeError("pipeline bug")
+        return 7
+
+    if backend == "native":
+        import _ds_host_ops as C
+
+        q = C.PrefetchQueue(producer, 2)
+    else:
+        q = host_ops._PyPrefetchQueue(producer, capacity=2)
+    assert q.get(timeout=5.0) == 7
+    with pytest.raises(RuntimeError, match="pipeline bug"):
+        q.get(timeout=5.0)
+    q.stop()
+
+
+def test_gather_rows_empty_indices_parity():
+    """Empty gathers succeed identically with and without the extension."""
+    out = host_ops.gather_rows(
+        np.zeros((0, 4), np.float32), np.zeros((0,), np.int64)
+    )
+    assert out.shape == (0, 4)
+    out = host_ops.gather_rows(
+        np.zeros((3, 4), np.float32), np.zeros((0,), np.int64)
+    )
+    assert out.shape == (0, 4)
+
+
+def test_shuffled_indices_negative_seed():
+    a = host_ops.shuffled_indices(64, -1)
+    np.testing.assert_array_equal(np.sort(a), np.arange(64))
