@@ -227,9 +227,12 @@ def set_num_layers(nlayers):
 
 
 def reset():
-    """Reference :579 resets per-iteration contiguous buffers; stateless
-    here, but also clears the RNG tracker for test isolation."""
-    _RNG_TRACKER.reset()
+    """Per-iteration reset (reference deepspeed_checkpointing.py:579): the
+    reference frees its contiguous activation buffers here. This rebuild
+    keeps no per-iteration buffer state, so there is nothing to clear —
+    notably the RNG tracker survives, matching the reference (it is seeded
+    once and reused across iterations). Tests wanting RNG isolation use
+    get_cuda_rng_tracker().reset() directly."""
 
 
 def configure(

@@ -97,9 +97,10 @@ class DeepSpeedDataLoader:
             order = np.arange(self._num_samples, dtype=np.int64)
         nb = len(self)
         if self._mode == "arrays":
-            # hoist host conversion: for jnp-backed datasets np.asarray is a
-            # device->host copy, so do it once per epoch, not per batch
-            arrays = [np.asarray(a) for a in self.dataset]
+            # hoist host conversion: for jnp-backed or non-contiguous
+            # datasets this is a full copy, so do it once per epoch, not
+            # per batch (gather_rows needs C-contiguous input)
+            arrays = [np.ascontiguousarray(a) for a in self.dataset]
 
         def assemble(b):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
@@ -121,7 +122,11 @@ class DeepSpeedDataLoader:
             try:
                 while True:
                     try:
-                        batch = q.get(timeout=600.0)
+                        batch = q.get(timeout=60.0)
+                    except TimeoutError:
+                        # a slow producer is not an error: keep waiting,
+                        # matching the synchronous path's semantics
+                        continue
                     except StopIteration:
                         break
                     yield self._place(batch)

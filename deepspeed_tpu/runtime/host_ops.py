@@ -116,7 +116,11 @@ class _PyPrefetchQueue:
         self._thread.start()
 
     def get(self, timeout=60.0):
-        item = self._q.get(timeout=timeout)
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            # same exception type as the native queue
+            raise TimeoutError("PrefetchQueue.get timed out") from None
         if item is self._sentinel:
             if self._producer_error is not None:
                 raise self._producer_error
