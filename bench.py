@@ -1,68 +1,148 @@
-"""Throughput benchmark: BERT-large pretraining micro-step on one TPU chip.
+"""Throughput benchmark on one TPU chip.
 
-Headline metric matching BASELINE.md row 1: BERT-large (24L/1024h/16heads),
-seq 128, masked-LM pretraining samples/sec on a single chip. Reference
-baseline: 272 samples/s on 1x V100 32GB
+Headline metric (BASELINE.md row 1): BERT-large (24L/1024h/16heads), seq 128,
+masked-LM pretraining samples/sec on a single chip. Reference baseline:
+272 samples/s on 1x V100 32GB
 (docs/_posts/2020-05-28-fastest-bert-training.md:38-39).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Secondary metric (BASELINE.json): GPT-2 causal-LM tokens/sec/chip, seq 1024,
+bf16 + fp32 masters, Adam, ZeRO-2 config, matching the spirit of the
+reference perf harness (tests/model/Megatron_GPT2/run_perf_test.py:18-60).
+The reference publishes no direct tokens/s for 1.5B; its sustained
+">38 TFLOPS/GPU for GPT family under ZeRO-2" claim
+(docs/_tutorials/megatron.md:402) converts to 38e12 / (6 * n_params)
+tokens/s/chip, which is the vs_baseline denominator.
+
+Memory discipline (this bench runs on a 16 GB v5e-class chip):
+- per-layer remat on the scanned encoder; the default policy keeps matmul
+  outputs and recomputes elementwise chains (dots_with_no_batch_dims);
+- gradient accumulation: a fixed TOTAL batch split into micro-batches;
+- automatic backoff on RESOURCE_EXHAUSTED: each (model, remat-policy,
+  micro-batch) attempt runs in its OWN subprocess, so a failed attempt
+  can't leak HBM into the next one; the first attempt that fits wins.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
 Extra diagnostics go to stderr.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+OOM_EXIT = 43  # worker exit code meaning "this attempt ran out of memory"
+
+BERT_ATTEMPTS = [
+    # (remat_policy, micro): measured best first (v5e 16GB: micro=64 with
+    # matmul-outputs-saved remat ~358 samples/s); full-remat fallbacks after.
+    ("dots_with_no_batch_dims_saveable", 64),
+    ("dots_with_no_batch_dims_saveable", 32),
+    ("full", 256),
+    ("full", 128),
+    ("full", 64),
+    ("full", 32),
+    ("full", 16),
+]
+
+GPT2_MODELS = ["gpt2_1.5b", "gpt2_large_774m", "gpt2_medium_355m"]
+GPT2_ATTEMPTS = [
+    ("dots_with_no_batch_dims_saveable", 8),
+    ("dots_with_no_batch_dims_saveable", 4),
+    ("full", 4),
+    ("full", 2),
+    ("full", 1),
+]
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def main():
+def _is_oom(err) -> bool:
+    s = str(err)
+    return (
+        "RESOURCE_EXHAUSTED" in s
+        or "Out of memory" in s
+        or "out of memory" in s
+        or "OOM" in s
+        or "Ran out of memory" in s
+    )
+
+
+def _measure_engine(engine, micro_batches, accum, warmup_windows, measure_windows):
+    """Run warmup + measured accumulation windows; return seconds/window."""
+    import itertools
+
+    def window_iter():
+        return itertools.islice(itertools.cycle(micro_batches), accum)
+
+    t0 = time.time()
+    loss = engine.train_batch(window_iter())
+    log(f"  first window (compile) {time.time() - t0:.1f}s, loss={float(loss):.4f}")
+    for _ in range(warmup_windows - 1):
+        loss = engine.train_batch(window_iter())
+    float(loss)  # sync before opening the timing window
+
+    t0 = time.time()
+    for _ in range(measure_windows):
+        loss = engine.train_batch(window_iter())
+    final_loss = float(loss)  # hard sync on the last window
+    elapsed = time.time() - t0
+    log(f"  {measure_windows} windows in {elapsed:.2f}s (loss {final_loss:.4f})")
+    return elapsed / measure_windows
+
+
+# ---------------------------------------------------------------------------
+# workers: run exactly ONE attempt in this process; print JSON on success,
+# exit(OOM_EXIT) when the attempt doesn't fit.
+# ---------------------------------------------------------------------------
+def bert_attempt(policy, micro, total):
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models import BertConfig, BertForPreTraining
 
-    BASELINE_SAMPLES_PER_SEC = 272.0  # 1x V100 32GB, seq 128
     SEQ = 128
-    BATCH = int(__import__("os").environ.get("BENCH_BATCH", "256"))
-    MEASURE_STEPS = 8
-    WARMUP_STEPS = 3
-
-    platform = jax.devices()[0].platform
-    log(f"devices: {jax.devices()} (platform={platform})")
-
+    accum = total // micro
     cfg = BertConfig.bert_large(
         max_position_embeddings=SEQ,
-        hidden_dropout_prob=0.1,
-        attention_probs_dropout_prob=0.1,
+        attn_dropout_checkpoint=True,  # per-layer remat of the scanned stack
+        remat_policy=policy,
     )
     model = BertForPreTraining(cfg)
+    # Param shapes don't depend on the attention impl; init on host with the
+    # XLA path (Pallas doesn't lower on the CPU backend).
+    init_model = BertForPreTraining(dataclasses.replace(cfg, use_flash=False))
 
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (BATCH, SEQ)).astype(np.int32)
-    mask = np.ones((BATCH, SEQ), np.int32)
-    mlm = np.where(rng.random((BATCH, SEQ)) < 0.15, ids, -1).astype(np.int32)
-    nsp = rng.integers(0, 2, (BATCH,)).astype(np.int32)
+    ids = rng.integers(0, cfg.vocab_size, (total, SEQ)).astype(np.int32)
+    mask = np.ones((total, SEQ), np.int32)
+    mlm = np.where(rng.random((total, SEQ)) < 0.15, ids, -1).astype(np.int32)
+    nsp = rng.integers(0, 2, (total,)).astype(np.int32)
 
     t0 = time.time()
-    params = model.init(
-        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
-        jnp.asarray(ids[:2]), jnp.asarray(mask[:2]), None,
-        jnp.asarray(mlm[:2]), jnp.asarray(nsp[:2]),
-    )["params"]
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = init_model.init(
+            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+            jnp.asarray(ids[:2]), jnp.asarray(mask[:2]), None,
+            jnp.asarray(mlm[:2]), jnp.asarray(nsp[:2]),
+        )["params"]
     n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
-    log(f"init done in {time.time()-t0:.1f}s; params={n_params/1e6:.1f}M")
+    log(f"BERT-large init {time.time() - t0:.1f}s; params={n_params / 1e6:.1f}M")
 
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         model_parameters=params,
         config_params={
-            "train_batch_size": BATCH,
+            "train_batch_size": total,
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": accum,
             "optimizer": {
                 "type": "Lamb",
                 "params": {"lr": 1e-3, "weight_decay": 0.01},
@@ -71,49 +151,228 @@ def main():
             "steps_per_print": 10_000,
         },
     )
-    del params
-
-    batch = (ids, mask, np.zeros_like(ids), mlm, nsp)
-
-    def step():
-        loss = engine(*batch)
-        engine.backward(loss)
-        engine.step()
-        return loss
-
-    t0 = time.time()
-    loss = step()
-    jax.block_until_ready(loss)
-    log(f"first step (compile) {time.time()-t0:.1f}s, loss={float(loss):.4f}")
-    for _ in range(WARMUP_STEPS - 1):
-        step()
-    jax.effects_barrier()
-
-    t0 = time.time()
-    for _ in range(MEASURE_STEPS):
-        loss = step()
-    jax.block_until_ready(loss)
-    elapsed = time.time() - t0
-
-    samples_per_sec = BATCH * MEASURE_STEPS / elapsed
-    log(
-        f"{MEASURE_STEPS} steps in {elapsed:.2f}s -> "
-        f"{samples_per_sec:.1f} samples/s (loss {float(loss):.4f})"
-    )
-    # rough MLM-model FLOPs: 6 * params * tokens (fwd+bwd)
-    tflops = 6 * n_params * BATCH * SEQ * MEASURE_STEPS / elapsed / 1e12
-    log(f"approx {tflops:.1f} TFLOPS")
-
-    print(
-        json.dumps(
-            {
-                "metric": "bert_large_pretrain_seq128_samples_per_sec_per_chip",
-                "value": round(samples_per_sec, 2),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
-            }
+    micro_batches = [
+        (
+            ids[i * micro:(i + 1) * micro],
+            mask[i * micro:(i + 1) * micro],
+            np.zeros((micro, SEQ), np.int32),
+            mlm[i * micro:(i + 1) * micro],
+            nsp[i * micro:(i + 1) * micro],
         )
+        for i in range(accum)
+    ]
+    sec_per_window = _measure_engine(
+        engine, micro_batches, accum, warmup_windows=3, measure_windows=8,
     )
+    sps = total / sec_per_window
+    tflops = 6 * n_params * total * SEQ / sec_per_window / 1e12
+    log(f"BERT-large: {sps:.1f} samples/s ({tflops:.1f} model TFLOPS)")
+    return {
+        "metric": "bert_large_pretrain_seq128_samples_per_sec_per_chip",
+        "value": round(sps, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps / 272.0, 3),
+        "micro_batch": micro,
+        "accum": accum,
+        "remat_policy": policy,
+        "model_tflops": round(tflops, 1),
+    }
+
+
+def gpt2_attempt(model_name, policy, micro):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    SEQ = 1024
+    REF_TFLOPS = 38e12  # megatron.md:402 sustained per-GPU compute
+    mk = {
+        "gpt2_1.5b": GPT2Config.xl_1_5b,
+        "gpt2_large_774m": GPT2Config.large,
+        "gpt2_medium_355m": GPT2Config.medium,
+    }[model_name]
+    cfg = mk(remat=True, remat_policy=policy)
+    model = GPT2LMHeadModel(cfg)
+    init_model = GPT2LMHeadModel(dataclasses.replace(cfg, use_flash=False))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (micro, SEQ)).astype(np.int32)
+    t0 = time.time()
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = init_model.init(
+            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+            jnp.asarray(ids[:1]), jnp.asarray(ids[:1]),
+        )["params"]
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    log(f"GPT-2 {model_name} init {time.time() - t0:.1f}s; params={n_params / 1e6:.0f}M")
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": micro,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 10_000,
+        },
+    )
+    sec_per_window = _measure_engine(
+        engine, [(ids, ids)], 1, warmup_windows=2, measure_windows=6,
+    )
+    tps = micro * SEQ / sec_per_window
+    tflops = 6 * n_params * micro * SEQ / sec_per_window / 1e12
+    baseline_tps = REF_TFLOPS / (6 * n_params)
+    log(f"GPT-2 {model_name}: {tps:.0f} tokens/s ({tflops:.1f} model TFLOPS)")
+    return {
+        "metric": f"{model_name}_causal_lm_seq1024_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps / baseline_tps, 3),
+        "baseline_tokens_per_sec": round(baseline_tps, 1),
+        "micro_batch": micro,
+        "remat_policy": policy,
+        "model_tflops": round(tflops, 1),
+        "n_params_m": round(n_params / 1e6),
+    }
+
+
+def _worker_main():
+    spec = json.loads(os.environ["BENCH_WORKER"])
+    try:
+        if spec["kind"] == "bert":
+            result = bert_attempt(spec["policy"], spec["micro"], spec["total"])
+        else:
+            result = gpt2_attempt(spec["model"], spec["policy"], spec["micro"])
+    except Exception as e:  # noqa: BLE001
+        if _is_oom(e):
+            log(f"worker OOM: {type(e).__name__}")
+            sys.exit(OOM_EXIT)
+        raise
+    print(json.dumps(result))
+
+
+# ---------------------------------------------------------------------------
+# driver: one subprocess per attempt (a failed attempt cannot leak HBM or a
+# wedged runtime into the next), first success wins.
+# ---------------------------------------------------------------------------
+def _run_attempt(spec, timeout=1500):
+    env = dict(os.environ)
+    env["BENCH_WORKER"] = json.dumps(spec)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"  attempt timed out after {timeout}s")
+        return None
+    for line in proc.stderr.splitlines():
+        if not line.startswith(("WARNING", "I0", "W0", "E0")):
+            log(f"  | {line}")
+    if proc.returncode == OOM_EXIT:
+        return None
+    if proc.returncode != 0:
+        log(f"  attempt failed rc={proc.returncode} (not OOM); continuing")
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return None
+
+
+def bench_bert():
+    total = int(os.environ.get("BENCH_BATCH", "256"))
+    micro_env = os.environ.get("BENCH_MICRO")
+    attempts = (
+        [("dots_with_no_batch_dims_saveable", int(micro_env))]
+        if micro_env
+        else BERT_ATTEMPTS
+    )
+    runnable = [(p, m) for p, m in attempts if total % m == 0]
+    if not runnable:
+        log(
+            f"BERT: no micro-batch candidate divides BENCH_BATCH={total}; "
+            f"tried {[m for _, m in attempts]}"
+        )
+        return None
+    for policy, micro in runnable:
+        log(f"BERT attempt: micro={micro} total={total} policy={policy}")
+        result = _run_attempt(
+            {"kind": "bert", "policy": policy, "micro": micro, "total": total}
+        )
+        if result is not None:
+            return result
+    log("BERT: all attempts failed")
+    return None
+
+
+_GPT2_DIMS = {  # (n_layer, n_embd), models/gpt2.py presets
+    "gpt2_1.5b": (48, 1600),
+    "gpt2_large_774m": (36, 1280),
+    "gpt2_medium_355m": (24, 1024),
+}
+
+
+def _gpt2_params_estimate(name):
+    L, H = _GPT2_DIMS[name]
+    vocab_padded = (50257 + 127) // 128 * 128
+    return vocab_padded * H + 1024 * H + L * (12 * H * H + 13 * H) + 2 * H
+
+
+def bench_gpt2():
+    models = GPT2_MODELS
+    name_env = os.environ.get("BENCH_GPT2")
+    if name_env:
+        models = [m for m in models if m == name_env]
+    hbm_bytes = float(os.environ.get("BENCH_HBM_GB", "16")) * 1e9
+    for name in models:
+        # fp32 params + grads + Adam m + v = 16 bytes/param of pure state;
+        # if that alone exceeds HBM, no micro-batch can save the attempt.
+        state_bytes = 16 * _gpt2_params_estimate(name)
+        if state_bytes > 0.95 * hbm_bytes:
+            log(
+                f"GPT-2 {name}: optimizer+grad state alone needs "
+                f"{state_bytes / 1e9:.1f} GB > {hbm_bytes / 1e9:.1f} GB HBM; "
+                "skipping (this is the model ZeRO shards across chips)"
+            )
+            continue
+        for policy, micro in GPT2_ATTEMPTS:
+            log(f"GPT-2 {name} attempt: micro={micro} policy={policy}")
+            result = _run_attempt(
+                {"kind": "gpt2", "model": name, "policy": policy, "micro": micro}
+            )
+            if result is not None:
+                return result
+    log("GPT-2: no candidate fit on this chip")
+    return None
+
+
+def main():
+    if os.environ.get("BENCH_WORKER"):
+        _worker_main()
+        return
+    only = os.environ.get("BENCH_ONLY")  # "bert" | "gpt2" | unset
+
+    bert = bench_bert() if only in (None, "bert") else None
+    gpt2 = bench_gpt2() if only in (None, "gpt2") else None
+
+    primary = bert or gpt2
+    if primary is None:
+        log("FATAL: no benchmark produced a number")
+        sys.exit(1)
+    out = {
+        "metric": primary["metric"],
+        "value": primary["value"],
+        "unit": primary["unit"],
+        "vs_baseline": primary["vs_baseline"],
+        "extras": {"bert": bert, "gpt2": gpt2},
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

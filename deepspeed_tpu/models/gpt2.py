@@ -38,6 +38,11 @@ class GPT2Config:
     layer_norm_eps: float = 1e-5
     use_flash: bool = True
     remat: bool = False
+    remat_policy: str = "full"
+    # Device mesh forwarded to the transformer layers: enables the
+    # sequence-parallel (ring/Ulysses) path when the mesh has a >1
+    # ``sequence`` axis, and per-shard flash via shard_map under dp/mp.
+    mesh: object = dataclasses.field(default=None, hash=False, compare=False)
 
     @property
     def vocab_padded(self):
@@ -80,6 +85,7 @@ class GPT2Config:
             pre_layer_norm=True,  # GPT-2 is pre-LN
             layer_norm_eps=self.layer_norm_eps,
             normalize_invertible=self.remat,  # remat flag reuse
+            remat_policy=self.remat_policy,
         )
 
 
@@ -109,7 +115,7 @@ class GPT2Model(nn.Module):
         )(
             DeepSpeedTransformerLayer(
                 config=cfg.layer_config(), causal=True,
-                use_flash=cfg.use_flash, name="h",
+                use_flash=cfg.use_flash, mesh=cfg.mesh, name="h",
             ),
             x,
             None,

@@ -59,6 +59,14 @@ class DeepSpeedTransformerConfig:
     stochastic_mode: bool = False
     huggingface: bool = False
     layer_norm_eps: float = 1e-12
+    # Remat granularity when a memory mode is on: "full" recomputes the
+    # whole block in backward (max memory saving, ~1 extra forward of
+    # FLOPs); any other value names a jax.checkpoint_policies entry, e.g.
+    # "dots_saveable" keeps matmul outputs and recomputes only the cheap
+    # elementwise chains (LN/GeLU/dropout) — the sweet spot the reference
+    # reaches with its per-buffer recompute flags
+    # (ds_transformer_cuda.cpp:189-191).
+    remat_policy: str = "full"
 
     @property
     def intermediate(self):
@@ -178,11 +186,14 @@ class DeepSpeedTransformerLayer(nn.Module):
                     dropout_rng=attn_rng,
                 )
             else:
+                # with a dp/mp mesh the dispatcher runs flash per-shard via
+                # shard_map instead of falling back to O(S^2) attention
                 ctx = attention(
                     split_heads(q), split_heads(k_), split_heads(v),
                     mask=attention_mask, causal=self.causal,
                     dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
                     dropout_rng=attn_rng, use_flash=self.use_flash,
+                    mesh=self.mesh,
                 )
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, H)  # transform4d_0213
             attn_out = ctx @ attn_ow + attn_ob
@@ -204,5 +215,11 @@ class DeepSpeedTransformerLayer(nn.Module):
             return x
 
         if cfg.use_remat:
-            block = jax.checkpoint(block)
+            if cfg.remat_policy == "full":
+                block = jax.checkpoint(block)
+            else:
+                block = jax.checkpoint(
+                    block,
+                    policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                )
         return block(hidden_states)

@@ -120,12 +120,36 @@ class DeepSpeedDataLoader:
 
             q = host_ops.make_prefetch_queue(producer, capacity=self.prefetch)
             try:
+                timeouts = 0
                 while True:
                     try:
                         batch = q.get(timeout=60.0)
+                        timeouts = 0
                     except TimeoutError:
-                        # a slow producer is not an error: keep waiting,
-                        # matching the synchronous path's semantics
+                        # a slow producer is not an error — keep waiting as
+                        # long as the worker is demonstrably alive; only a
+                        # dead worker (killed without enqueueing its
+                        # sentinel) should surface instead of hanging
+                        # forever. Queues without a liveness probe fall back
+                        # to a 10-minute no-progress cutoff.
+                        alive = getattr(q, "alive", None)
+                        if alive is not None:
+                            # a finished producer enqueues its sentinel
+                            # before exiting, so dead thread + empty queue
+                            # means it died without signalling
+                            if not alive() and q.qsize() == 0:
+                                raise RuntimeError(
+                                    "prefetch producer thread died without "
+                                    "signalling end-of-stream"
+                                )
+                            continue
+                        timeouts += 1
+                        if timeouts >= 10:
+                            raise RuntimeError(
+                                "prefetch producer made no progress for "
+                                f"{timeouts * 60:.0f}s; assuming the worker "
+                                "died"
+                            )
                         continue
                     except StopIteration:
                         break

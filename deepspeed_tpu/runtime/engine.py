@@ -232,6 +232,7 @@ class DeepSpeedEngine:
         self._grad_buffer = None  # lazily allocated on first backward
         self._pending_grads = None
         self._pending_loss = None
+        self._window_losses = []  # device arrays; one per micro-step
 
         # ---- lr scheduler ---------------------------------------------
         self.lr_scheduler = self._configure_lr_scheduler()
@@ -261,7 +262,13 @@ class DeepSpeedEngine:
         self.last_overflow = False
         self.lamb_coeffs = []
         self._training = True
-        self._rng = jax.random.PRNGKey(rng_seed)
+        # rbg keys generate random bits ~an order of magnitude faster than
+        # threefry on TPU (hardware RNG path); dropout masks stay
+        # deterministic per key. Non-TPU backends keep the default impl.
+        if jax.devices()[0].platform == "tpu":
+            self._rng = jax.random.key(rng_seed, impl="rbg")
+        else:
+            self._rng = jax.random.PRNGKey(rng_seed)
 
         # ---- timers ---------------------------------------------------
         self.wall_clock_breakdown = self.config.wall_clock_breakdown
@@ -472,9 +479,21 @@ class DeepSpeedEngine:
 
         self._jit_accumulate = jax.jit(accumulate, donate_argnums=(0,))
 
-        def apply_update(params, opt_state, grad_buffer, scaler_state, lr):
+        # Full inf/nan-scan overflow detection exists for fp16 loss-scaling
+        # semantics (reference fp16_optimizer.py); the reference likewise
+        # only wraps the optimizer in FP16_Optimizer when fp16 is on
+        # (deepspeed_light.py:506-525). bf16/fp32 runs keep a cheaper guard:
+        # a non-finite global grad norm skips the update on-device, so a
+        # loss spike can't NaN the params — without the per-step host sync
+        # that fp16's skipped-step accounting needs.
+        check_overflow = self.config.fp16_enabled
+
+        def update_body(params, opt_state, grad_buffer, scaler_state, lr):
             inv_scale = 1.0 / scaler_state.loss_scale
-            overflow = has_overflow(grad_buffer)
+            if check_overflow:
+                overflow = has_overflow(grad_buffer)
+            else:
+                overflow = ~jnp.isfinite(global_norm(grad_buffer))
 
             def do_update(operands):
                 params, opt_state, grads = operands
@@ -512,7 +531,8 @@ class DeepSpeedEngine:
                 )
 
             new_params, new_opt, grad_norm, coeffs = jax.lax.cond(
-                overflow, skip_update, do_update, (params, opt_state, grad_buffer)
+                overflow, skip_update, do_update,
+                (params, opt_state, grad_buffer),
             )
             new_params = jax.tree_util.tree_map(
                 lambda p, s: jax.lax.with_sharding_constraint(p, s),
@@ -523,7 +543,54 @@ class DeepSpeedEngine:
             zero_buffer = jax.tree_util.tree_map(jnp.zeros_like, grad_buffer)
             return new_params, new_opt, zero_buffer, new_scaler, overflow, grad_norm, coeffs
 
-        self._jit_apply_update = jax.jit(apply_update, donate_argnums=(0, 1, 2))
+        self._jit_apply_update = jax.jit(update_body, donate_argnums=(0, 1, 2))
+
+        def train_window(params, opt_state, scaler_state, batches, rng_keys, lr):
+            """One full accumulation window in a single compiled program:
+            accum x (forward+backward) -> grad sum -> optimizer update.
+
+            ``batches`` leaves carry a leading [accum] axis; ``rng_keys`` is
+            [accum, key]. Fusing the window removes per-micro-step dispatch
+            (significant on remote-tunneled platforms) and lets XLA overlap
+            the update with the last backward.
+            """
+            loss_scale = scaler_state.loss_scale
+            if accum == 1:
+                first = jax.tree_util.tree_map(lambda x: x[0], batches)
+                loss, grads = fwd_bwd(params, first, rng_keys[0], loss_scale)
+                losses = loss.astype(jnp.float32)[None]
+            else:
+                zeros = jax.tree_util.tree_map(
+                    lambda p, s: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, jnp.float32), s
+                    ),
+                    params,
+                    grad_shardings,
+                )
+
+                def body(gbuf, xs):
+                    b, k = xs
+                    loss, g = fwd_bwd(params, b, k, loss_scale)
+                    gbuf = jax.tree_util.tree_map(
+                        lambda a, gg, s: jax.lax.with_sharding_constraint(
+                            a + gg, s
+                        ),
+                        gbuf,
+                        g,
+                        grad_shardings,
+                    )
+                    return gbuf, loss.astype(jnp.float32)
+
+                grads, losses = jax.lax.scan(body, zeros, (batches, rng_keys))
+            new_params, new_opt, _, new_scaler, overflow, grad_norm, coeffs = (
+                update_body(params, opt_state, grads, scaler_state, lr)
+            )
+            return (
+                new_params, new_opt, new_scaler, overflow, grad_norm, coeffs,
+                jnp.mean(losses),
+            )
+
+        self._jit_train_window = jax.jit(train_window, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------
     # training API
@@ -568,6 +635,8 @@ class DeepSpeedEngine:
                 self._grad_buffer, self._pending_grads
             )
         self._pending_grads = None
+        if self._pending_loss is not None:
+            self._window_losses.append(self._pending_loss)
         self.micro_steps += 1
         if self.wall_clock_breakdown:
             self.timers(BACKWARD_TIMER).stop()
@@ -597,9 +666,34 @@ class DeepSpeedEngine:
             self.loss_scale_state,
             lr,
         )
-        self.last_overflow = bool(overflow)
+        window_loss = None
+        if self._window_losses:
+            # mean UNSCALED loss over the whole accumulation window
+            # (reference logs the window loss, deepspeed_light.py:876-885)
+            window_loss = jnp.mean(
+                jnp.stack([l.astype(jnp.float32) for l in self._window_losses])
+            )
+        self._window_losses = []
+        if self.wall_clock_breakdown:
+            self.timers(STEP_TIMER).stop()
+        self._finish_step(overflow, grad_norm, coeffs, window_loss)
+
+    def _finish_step(self, overflow, grad_norm, coeffs, window_loss):
+        """Post-update host bookkeeping shared by step() and train_batch():
+        overflow/skipped-step accounting, LR schedule, throughput window,
+        periodic step line, monitor scalars."""
         self._last_grad_norm = grad_norm
         self.lamb_coeffs = coeffs
+        if self.config.fp16_enabled:
+            # fp16 semantics need the overflow flag NOW (it gates the LR
+            # schedule and skipped-step accounting) — one host sync.
+            self.last_overflow = bool(overflow)
+        else:
+            # bf16/fp32: the jitted update still skips on a non-finite grad
+            # norm (params stay safe on device), but the loop stays fully
+            # async — the next window dispatches while this one runs, and a
+            # rare device-side skip isn't reflected in host-side counters.
+            self.last_overflow = False
         if self.last_overflow:
             self.skipped_steps += 1
             log_dist(
@@ -611,8 +705,6 @@ class DeepSpeedEngine:
             self.global_steps += 1
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
-        if self.wall_clock_breakdown:
-            self.timers(STEP_TIMER).stop()
         # close the samples/sec window opened by the dataloader's __next__
         self.tput_timer.stop(report_speed=True)
         if (
@@ -631,51 +723,96 @@ class DeepSpeedEngine:
                     self.get_lr(), (list, tuple)) else self.get_lr()),
                 "Train/loss_scale": float(self.loss_scale_state.loss_scale),
             }
-            if self._pending_loss is not None:
-                scalars["Train/loss"] = float(self._pending_loss)
-            if self._last_grad_norm is not None:
-                scalars["Train/grad_norm"] = float(self._last_grad_norm)
+            if window_loss is not None:
+                scalars["Train/loss"] = float(window_loss)
+            if grad_norm is not None:
+                scalars["Train/grad_norm"] = float(grad_norm)
             self.monitor.write_scalars(scalars, self.global_steps)
 
     def train_batch(self, batch_iter_or_batches):
         """Native fast path: run a full accumulation window (forward,
-        accumulate, update) and return the mean loss. Equivalent to
-        gradient_accumulation_steps x (forward+backward) + step."""
-        losses = []
+        accumulate, update) as ONE compiled program and return the mean
+        unscaled loss. Semantically equivalent to
+        gradient_accumulation_steps x (forward()+backward()) + step()."""
         accum = self.gradient_accumulation_steps()
         it = iter(batch_iter_or_batches)
+        batches = []
         for _ in range(accum):
             batch = next(it)
             if not isinstance(batch, (tuple, list)):
                 batch = (batch,)
-            loss = self.forward(*batch)
-            self.backward(loss)
-            losses.append(loss)
-        self.step()
-        return float(np.mean([float(l) for l in losses]))
+            batches.append(tuple(batch))
+
+        def stack_leaf(*xs):
+            # Stack host leaves on host so the window goes to devices ONCE,
+            # directly in its target sharding; a device-side jnp.stack would
+            # stage the whole unsharded window through the default device.
+            if any(isinstance(x, jax.Array) for x in xs):
+                return jnp.stack([jnp.asarray(x) for x in xs])
+            return np.stack([np.asarray(x) for x in xs])
+
+        stacked = jax.tree_util.tree_map(stack_leaf, *batches)
+        stacked = self._shard_window_batch(stacked)
+        self._rng, sub = jax.random.split(self._rng)
+        keys = jax.random.split(sub, accum)
+
+        lr = jnp.float32(self._current_lr())
+        (
+            self.params,
+            self.optimizer_state,
+            self.loss_scale_state,
+            overflow,
+            grad_norm,
+            coeffs,
+            mean_loss,
+        ) = self._jit_train_window(
+            self.params,
+            self.optimizer_state,
+            self.loss_scale_state,
+            stacked,
+            keys,
+            lr,
+        )
+        self.micro_steps += accum
+        self._finish_step(overflow, grad_norm, coeffs, mean_loss)
+        # Returned as a device scalar: float(loss) would serialize the train
+        # loop on the device (costly on remote-tunneled TPU platforms).
+        # Callers that want a python float call float() on it.
+        return mean_loss
 
     # ------------------------------------------------------------------
-    def _shard_batch(self, inputs):
-        # user-supplied meshes may lack the sequence axis
-        sp = dict(self._mesh.shape).get(mesh_lib.SEQ_AXIS, 1)
+    def _place_leaf(self, x, batch_axis):
+        """device_put one batch leaf: the batch dim shards over data, the
+        following (token) dim over sequence when sizes divide; anything that
+        doesn't fit the mesh is replicated."""
         from jax.sharding import NamedSharding, PartitionSpec
 
+        sp = dict(self._mesh.shape).get(mesh_lib.SEQ_AXIS, 1)
+        spec = [None] * x.ndim
+        if x.ndim > batch_axis and x.shape[batch_axis] % self.dp_world_size == 0:
+            spec[batch_axis] = mesh_lib.DATA_AXIS
+        if sp > 1 and x.ndim > batch_axis + 1 and x.shape[batch_axis + 1] % sp == 0:
+            spec[batch_axis + 1] = mesh_lib.SEQ_AXIS
+        try:
+            return jax.device_put(
+                x, NamedSharding(self._mesh, PartitionSpec(*spec))
+            )
+        except ValueError:
+            return jax.device_put(x, mesh_lib.replicated(self._mesh))
+
+    def _shard_batch(self, inputs):
         def place(x):
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
-            # batch dim over data; token dim over sequence when it divides
-            spec = [None] * x.ndim
-            if x.ndim >= 1 and x.shape[0] % self.dp_world_size == 0:
-                spec[0] = mesh_lib.DATA_AXIS
-            if sp > 1 and x.ndim >= 2 and x.shape[1] % sp == 0:
-                spec[1] = mesh_lib.SEQ_AXIS
-            try:
-                return jax.device_put(
-                    x, NamedSharding(self._mesh, PartitionSpec(*spec))
-                )
-            except ValueError:
-                return jax.device_put(x, mesh_lib.replicated(self._mesh))
+            return self._place_leaf(x, 0)
 
         return tuple(jax.tree_util.tree_map(place, x) for x in inputs)
+
+    def _shard_window_batch(self, stacked):
+        """Place a stacked accumulation window: leaves are [accum, micro, ...];
+        the micro-batch dim (axis 1) shards over data."""
+        return jax.tree_util.tree_map(
+            lambda x: self._place_leaf(x, 1), stacked
+        )
 
     def _zero_grad_buffer(self):
         if self._grad_buffer is not None:

@@ -127,6 +127,11 @@ class _PyPrefetchQueue:
             raise StopIteration("producer exhausted")
         return item
 
+    def alive(self):
+        """True while the producer thread is still running (consumers use
+        this to tell a slow producer apart from a dead one)."""
+        return self._thread.is_alive()
+
     def qsize(self):
         return self._q.qsize()
 
