@@ -56,6 +56,15 @@ class BertConfig:
     # attention when the mesh has a >1 sequence axis; per-shard flash via
     # shard_map under dp/mp meshes).
     mesh: object = dataclasses.field(default=None, hash=False, compare=False)
+    # Route embedding-table gradients through the CSR sparse all-reduce
+    # (runtime/sparse.py) instead of a dense [vocab, H] psum — the
+    # ``sparse_gradients`` config path (reference deepspeed_light.py:177-184).
+    # NOTE: BERT ties word_embeddings to the MLM decoder, whose cotangent is
+    # dense — the traffic win only materializes for untied tables (see
+    # runtime/sparse.py caveat).
+    sparse_gradients: bool = dataclasses.field(
+        default=False, hash=False, compare=False
+    )
 
     @staticmethod
     def bert_large(**kw):
@@ -102,7 +111,13 @@ class BertEmbeddings(nn.Module):
         tok = self.param("token_type_embeddings", init, (cfg.type_vocab_size, cfg.hidden_size))
 
         s = input_ids.shape[1]
-        x = word[input_ids] + pos[None, :s, :]
+        if cfg.sparse_gradients:
+            from ..runtime.sparse import sparse_embedding_lookup
+
+            x = sparse_embedding_lookup(word, input_ids, cfg.mesh)
+        else:
+            x = word[input_ids]
+        x = x + pos[None, :s, :]
         if token_type_ids is not None:
             x = x + tok[token_type_ids]
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="LayerNorm")(x)

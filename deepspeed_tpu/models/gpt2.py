@@ -43,6 +43,13 @@ class GPT2Config:
     # sequence-parallel (ring/Ulysses) path when the mesh has a >1
     # ``sequence`` axis, and per-shard flash via shard_map under dp/mp.
     mesh: object = dataclasses.field(default=None, hash=False, compare=False)
+    # Route wte gradients through the CSR sparse all-reduce
+    # (runtime/sparse.py; reference deepspeed_light.py:177-184). NOTE: the
+    # tied lm head's cotangent is dense, so the traffic win only
+    # materializes for untied tables (see runtime/sparse.py caveat).
+    sparse_gradients: bool = dataclasses.field(
+        default=False, hash=False, compare=False
+    )
 
     @property
     def vocab_padded(self):
@@ -100,7 +107,12 @@ class GPT2Model(nn.Module):
         wpe = self.param("wpe", init, (cfg.n_positions, cfg.n_embd))
 
         s = input_ids.shape[1]
-        x = wte[input_ids] + wpe[None, :s, :]
+        if cfg.sparse_gradients:
+            from ..runtime.sparse import sparse_embedding_lookup
+
+            x = sparse_embedding_lookup(wte, input_ids, cfg.mesh) + wpe[None, :s, :]
+        else:
+            x = wte[input_ids] + wpe[None, :s, :]
         if train and cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout, deterministic=False)(
                 x, rng=self.make_rng("dropout")

@@ -5,8 +5,10 @@ deepspeed/pt/deepspeed_light.py:536), FusedLamb
 (deepspeed/pt/deepspeed_fused_lamb.py:13-201 + csrc/lamb CUDA kernels) — with
 pure-JAX updates. "Fusion" needs no hand-written kernel here: each leaf's
 update is a handful of elementwise ops that XLA fuses into one or two HBM
-passes; the Pallas variants in ``deepspeed_tpu.ops.pallas`` exist for the
-multi-tensor single-pass flavor on very fragmented pytrees.
+passes. ``deepspeed_tpu.ops.pallas.FusedLamb`` (config name "FusedLamb")
+is the hand-fused variant mirroring the reference's 3-phase CUDA kernel:
+the Adam update and both L2-norm partial reductions happen in a single
+Pallas pass over HBM.
 
 LAMB reproduces the reference's trust-ratio semantics (csrc/lamb/
 fused_lamb_cuda_kernel.cu part1-3: Adam update, L2 norms of weight & update,
@@ -276,6 +278,12 @@ def build_optimizer(name: str, params_dict: dict) -> Optimizer:
     if name == "lamb":
         kw.pop("max_grad_norm", None)
         return Lamb(**kw)
+    if name in ("fusedlamb", "fused_lamb"):
+        # Pallas phase-1 kernel variant (ops/pallas.py), numerics-identical
+        from .pallas import FusedLamb
+
+        kw.pop("max_grad_norm", None)
+        return FusedLamb(**kw)
     if name == "sgd":
         return SGD(**kw)
     if name == "lion":

@@ -57,17 +57,41 @@ def _flatten(tree):
     return leaves, treedef
 
 
+def _to_host(leaf):
+    """Fetch a (possibly multi-host-sharded) array to host memory."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
+
+
+def _barrier(name):
+    """Cross-host barrier (reference sequences checkpoint writers with
+    dist barriers, deepspeed_light.py:1315-1324). No-op single-process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def save_checkpoint(engine, save_dir, tag=None, client_state=None):
+    """Multi-host write discipline (reference deepspeed_light.py:1282-1360):
+    process 0 writes the model-states file; optimizer shard files are
+    distributed round-robin over processes (the analog of every dp rank
+    writing its own zero_pp_rank file); everyone barriers; process 0 then
+    publishes the ``latest`` tag — so a tag never points at a half-written
+    checkpoint."""
     if tag is None:
         tag = f"global_step{engine.global_steps}"
-    mp_rank = 0  # single-controller: one process writes the whole state
+    mp_rank = 0  # tensor-parallel state is global under GSPMD: one file
+    proc = jax.process_index()
+    n_proc = jax.process_count()
     ckpt_dir = os.path.join(save_dir, str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
 
-    # ---- model states file ------------------------------------------
-    params_np = jax.tree_util.tree_map(
-        lambda p: np.asarray(jax.device_get(p)), engine.params
-    )
+    # ---- model states file (process 0 only) -------------------------
+    params_np = jax.tree_util.tree_map(_to_host, engine.params)
     scaler = engine.loss_scale_state
     state = {
         "module": serialization.to_state_dict(params_np),
@@ -90,18 +114,21 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None):
         ),
         "client_state": client_state or {},
     }
-    model_path = os.path.join(ckpt_dir, MODEL_FILE.format(mp=mp_rank))
-    with open(model_path, "wb") as f:
-        f.write(serialization.msgpack_serialize(state))
+    if proc == 0:
+        model_path = os.path.join(ckpt_dir, MODEL_FILE.format(mp=mp_rank))
+        with open(model_path, "wb") as f:
+            f.write(serialization.msgpack_serialize(state))
 
-    # ---- optimizer shard files --------------------------------------
+    # ---- optimizer shard files (round-robin over processes) ---------
     leaves, _ = _flatten(engine.optimizer_state)
     axes = [_data_axis_of(l) for l in leaves]
     dp = engine.dp_world_size if engine.zero_stage >= 1 else 1
+    host_leaves = [_to_host(l) for l in leaves]
     for rank in range(dp):
+        if rank % n_proc != proc:
+            continue
         shard_leaves = []
-        for leaf, ax in zip(leaves, axes):
-            arr = np.asarray(jax.device_get(leaf))
+        for arr, ax in zip(host_leaves, axes):
             if ax >= 0 and dp > 1 and arr.shape[ax] % dp == 0:
                 shard_leaves.append(
                     np.array_split(arr, dp, axis=ax)[rank]
@@ -114,7 +141,7 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None):
             "shard_axes": [int(a) for a in axes],
             "splittable": [
                 bool(a >= 0 and dp > 1 and np.asarray(l.shape)[a] % dp == 0)
-                for l, a in zip(leaves, axes)
+                for l, a in zip(host_leaves, axes)
             ],
             "leaves": {str(i): arr for i, arr in enumerate(shard_leaves)},
         }
@@ -122,8 +149,11 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None):
         with open(path, "wb") as f:
             f.write(serialization.msgpack_serialize(payload))
 
-    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-        f.write(str(tag))
+    # every writer finishes before the tag becomes visible
+    _barrier(f"ckpt_save_{tag}")
+    if proc == 0:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(str(tag))
     log_dist(f"Saved checkpoint {tag} to {save_dir}", ranks=[0])
     return True
 

@@ -170,6 +170,19 @@ class DeepSpeedEngine:
             raise ValueError(
                 "model_parameters (the initialized parameter pytree) is required"
             )
+        # The engine configures the module it wraps (the reference casts and
+        # moves it, deepspeed_light.py:463-491; here we inject the device
+        # mesh — so layers can pick sequence-parallel / shard_map attention
+        # paths — and the sparse-gradient routing for embedding tables,
+        # deepspeed_light.py:177-184). Mutation happens before first trace.
+        mcfg = getattr(model, "config", None)
+        if mcfg is not None:
+            if hasattr(mcfg, "mesh") and getattr(mcfg, "mesh", None) is None:
+                mcfg.mesh = self._mesh
+            if self.config.sparse_gradients_enabled and hasattr(
+                mcfg, "sparse_gradients"
+            ):
+                mcfg.sparse_gradients = True
         self._loss_fn = self._build_loss_fn(model)
 
         # ---- precision ------------------------------------------------
@@ -218,6 +231,18 @@ class DeepSpeedEngine:
 
         # ---- optimizer ------------------------------------------------
         self.optimizer_obj = self._configure_optimizer()
+        if stage >= 1 and type(self.optimizer_obj).__name__ == "FusedLamb":
+            # the opaque pallas_call is not partitionable by GSPMD: sharded
+            # optimizer-state leaves would be gathered at the kernel
+            # boundary, silently undoing the ZeRO memory saving
+            log_dist(
+                "WARNING: FusedLamb's Pallas kernel is not GSPMD-"
+                "partitionable; with zero_optimization.stage >= 1 the "
+                "sharded optimizer state is gathered at the kernel "
+                "boundary. Use optimizer type 'Lamb' (XLA-fused, shards "
+                "cleanly) with ZeRO.",
+                ranks=[0],
+            )
         opt_state = self.optimizer_obj.init(self.params)
         self._opt_shardings = zero_lib.specs_to_shardings(
             zero_lib.optstate_specs_like(

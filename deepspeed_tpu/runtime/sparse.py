@@ -124,3 +124,70 @@ def sparse_allreduce_average(csr: CSRTensor, mesh, axis_name=C.DATA_AXIS):
     """Averaged variant (gradient averaging semantics of DP allreduce)."""
     world = dict(mesh.shape).get(axis_name, 1)
     return sparse_all_reduce(csr, mesh, axis_name) / world
+
+
+# ---------------------------------------------------------------------------
+# Sparse-gradient embedding lookup (the engine-side wiring of the CSR path)
+# ---------------------------------------------------------------------------
+# The reference converts nn.Embedding grads to CSR and reduces them with a
+# size-padded all_gather instead of a dense allreduce
+# (deepspeed_light.py:177-184 marks the modules, :1037-1093 csr_allreduce).
+# Under GSPMD the embedding grad would otherwise be a dense [vocab, H] psum
+# over the data axis every step. This lookup's custom VJP replaces that with
+# the sparse collective: each data shard contributes its (token ids, output
+# cotangents) — the CSR (indices, values) pair, whose sparsity is KNOWN from
+# the ids, no nonzero-scan needed — gathered over the data axis and
+# scatter-added into the dense table shape on every shard. Traffic is
+# world * B_local * S * (H + 1) instead of vocab * H: a win whenever the
+# batch touches few vocab rows.
+#
+# CAVEAT (same as the reference's): the win requires the table's OTHER uses
+# to be sparse too. A weight-TIED language-model head (logits = h @ table.T,
+# models/gpt2.py / the BERT MLM decoder) produces a fully dense cotangent
+# for the same table, so the dense reduction still runs and this path only
+# adds traffic. The reference's CSR machinery likewise targeted untied
+# embedding-bag models (deepspeed_light.py:177-184 converts nn.Embedding
+# only). Enable ``sparse_gradients`` for untied tables.
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _sparse_lookup(table, ids, mesh, axis_name):
+    return jnp.take(table, ids, axis=0)
+
+
+def _sparse_lookup_fwd(table, ids, mesh, axis_name):
+    # residuals must be arrays: a zero-width slice carries the table's row
+    # count and dtype without holding any data
+    marker = jnp.zeros((table.shape[0], 0), table.dtype)
+    return jnp.take(table, ids, axis=0), (ids, marker)
+
+
+def _sparse_lookup_bwd(mesh, axis_name, residuals, g):
+    import numpy as np
+
+    ids, marker = residuals
+    table_shape = (marker.shape[0], g.shape[-1])
+    dtype = marker.dtype
+    flat_ids = ids.reshape(-1)
+    flat_g = g.reshape(-1, g.shape[-1])
+
+    csr = CSRTensor(indices=flat_ids, values=flat_g, dense_size=table_shape)
+    dtable = sparse_all_reduce(csr, mesh, axis_name=axis_name)
+    # integer primal -> float0 cotangent
+    return dtable.astype(dtype), np.zeros(ids.shape, jax.dtypes.float0)
+
+
+_sparse_lookup.defvjp(_sparse_lookup_fwd, _sparse_lookup_bwd)
+
+
+def sparse_embedding_lookup(table, ids, mesh=None, axis_name=C.DATA_AXIS):
+    """``table[ids]`` whose gradient flows through the sparse all-reduce
+    when a data-parallel mesh is supplied (the ``sparse_gradients`` config
+    path); plain gather (XLA scatter-add grad + dense psum) otherwise."""
+    import math
+
+    dp = 1 if mesh is None else dict(mesh.shape).get(axis_name, 1)
+    if dp <= 1 or math.prod(ids.shape) % dp != 0:
+        return jnp.take(table, ids, axis=0)
+    return _sparse_lookup(table, ids, mesh, axis_name)

@@ -1,0 +1,112 @@
+"""Model-scale loss-parity suite: GPT-2 training must be numerically
+IDENTICAL (within tolerance) across parallelism layouts.
+
+The analog of the reference's Megatron-GPT2 functional suite, which runs
+baseline-vs-deepspeed training pairs across mp x zero grids and compares
+`LM loss` within relative tolerance (reference:
+tests/model/Megatron_GPT2/run_func_test.py:19-120). Here the baseline is a
+single-device stage-0 run and every parallel layout — ZeRO-1, ZeRO-2,
+ZeRO-2 + tensor parallel, ZeRO-2 + sequence parallel — must reproduce its
+loss trajectory on the 8-device virtual mesh: the test that proves the
+parallelism stack trains *identically*, not just runs.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel, partition_specs
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+STEPS = 20
+BATCH = 8
+SEQ = 64
+RTOL = 1e-2  # reference uses 0.01 on LM loss (run_func_test.py)
+
+
+def _cfg(mesh=None):
+    return GPT2Config(
+        vocab_size=512,
+        n_positions=SEQ,
+        n_embd=128,
+        n_layer=2,
+        n_head=4,
+        dropout=0.0,  # parity runs compare exact trajectories
+        mesh=mesh,
+    )
+
+
+def _data():
+    # two fixed batches cycled so the loss actually decreases (random
+    # tokens are memorizable; fresh random data would sit at ln(512))
+    rng = np.random.default_rng(1234)
+    fixed = [
+        rng.integers(0, 512, (BATCH, SEQ)).astype(np.int32) for _ in range(2)
+    ]
+    return [fixed[i % 2] for i in range(STEPS)]
+
+
+def _train(mesh, zero_stage, use_mp=False):
+    cfg = _cfg(mesh=mesh)
+    model = GPT2LMHeadModel(cfg)
+    ids0 = jax.numpy.asarray(_data()[0])
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids0, ids0,
+    )["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        mesh=mesh,
+        param_specs=partition_specs(params) if use_mp else None,
+        config_params={
+            "train_batch_size": BATCH,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": zero_stage},
+            "steps_per_print": 10_000,
+        },
+        rng_seed=0,
+    )
+    losses = []
+    for ids in _data():
+        loss = engine(ids, ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert engine.global_steps == STEPS
+    return np.asarray(losses)
+
+
+@pytest.fixture(scope="module")
+def baseline_losses():
+    mesh = build_mesh(devices=jax.devices()[:1], data_parallel_size=1)
+    losses = _train(mesh, zero_stage=0)
+    # sanity: the baseline itself must be training
+    assert losses[-1] < 0.9 * losses[0], losses
+    return losses
+
+
+PARALLEL_LAYOUTS = {
+    "zero1_dp8": dict(dp=8, mp=1, sp=1, stage=1),
+    "zero2_dp8": dict(dp=8, mp=1, sp=1, stage=2),
+    "zero2_dp4_mp2": dict(dp=4, mp=2, sp=1, stage=2),
+    "zero2_dp4_sp2": dict(dp=4, mp=1, sp=2, stage=2),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PARALLEL_LAYOUTS))
+def test_parallel_layout_matches_baseline(name, baseline_losses):
+    lay = PARALLEL_LAYOUTS[name]
+    mesh = build_mesh(
+        data_parallel_size=lay["dp"],
+        model_parallel_size=lay["mp"],
+        sequence_parallel_size=lay["sp"],
+    )
+    losses = _train(mesh, zero_stage=lay["stage"], use_mp=lay["mp"] > 1)
+    np.testing.assert_allclose(
+        losses, baseline_losses, rtol=RTOL,
+        err_msg=f"{name} diverged from the single-device baseline",
+    )

@@ -122,3 +122,45 @@ def test_latest_tag_tracking(tmp_path):
     engine2 = make_engine(config_dict(batch_size=16, lr=1e-2))
     engine2.load_checkpoint(str(tmp_path))  # should pick tagB via latest
     assert engine2.global_steps == engine.global_steps
+
+
+# ---------------------------------------------------------------------------
+# Multi-host write discipline (reference deepspeed_light.py:1282-1360)
+# ---------------------------------------------------------------------------
+def test_multihost_write_guard(tmp_path, monkeypatch):
+    """Under n_processes > 1 only process 0 writes model states + latest;
+    optimizer shard files are split round-robin across processes; the
+    barrier runs before the tag is published."""
+    from deepspeed_tpu.runtime import checkpointing as ckpt
+
+    engine = make_engine(config_dict(batch_size=16, lr=1e-2, zero_stage=2))
+    run_steps(engine, n=1)
+
+    calls = []
+    monkeypatch.setattr(ckpt, "_barrier", lambda name: calls.append(name))
+
+    # --- pretend to be process 1 of 2 --------------------------------
+    monkeypatch.setattr(ckpt.jax, "process_index", lambda: 1)
+    monkeypatch.setattr(ckpt.jax, "process_count", lambda: 2)
+    d1 = tmp_path / "p1"
+    engine.save_checkpoint(str(d1), tag="t")
+    files1 = sorted(p.name for p in (d1 / "t").glob("*"))
+    assert not any("model_states" in f for f in files1), files1
+    assert not (d1 / "latest").exists()
+    # process 1 of 2 owns the odd dp shards only
+    dp = engine.dp_world_size
+    expected = {
+        ckpt.OPTIM_FILE.format(dp=r, mp=0) for r in range(dp) if r % 2 == 1
+    }
+    assert set(files1) == expected, (files1, expected)
+    assert calls == ["ckpt_save_t"]
+
+    # --- process 0 of 2 ----------------------------------------------
+    monkeypatch.setattr(ckpt.jax, "process_index", lambda: 0)
+    d0 = tmp_path / "p0"
+    engine.save_checkpoint(str(d0), tag="t")
+    files0 = sorted(p.name for p in (d0 / "t").glob("*"))
+    assert any("model_states" in f for f in files0), files0
+    assert (d0 / "latest").read_text() == "t"
+    even = {ckpt.OPTIM_FILE.format(dp=r, mp=0) for r in range(dp) if r % 2 == 0}
+    assert set(files0) == even | {ckpt.MODEL_FILE.format(mp=0)}, files0

@@ -1,0 +1,77 @@
+"""Pallas fused LAMB: numerical parity with the pure-JAX Lamb.
+
+The analog of validating csrc/lamb/fused_lamb_cuda_kernel.cu against the
+unfused torch math (the reference never shipped such a test; here parity is
+asserted leaf-for-leaf including the trust-ratio coefficients)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.optimizers import Lamb
+from deepspeed_tpu.ops.pallas import BLOCK, FusedLamb
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    # leaf sizes chosen to cover: sub-block, exact block multiple, ragged
+    shapes = [(17,), (BLOCK // 128, 128), (3, 1000), (257, 129)]
+    params = {f"p{i}": jnp.asarray(rng.standard_normal(s), jnp.float32)
+              for i, s in enumerate(shapes)}
+    grads = {f"p{i}": jnp.asarray(rng.standard_normal(s) * 0.1, jnp.float32)
+             for i, s in enumerate(shapes)}
+    return params, grads
+
+
+@pytest.mark.parametrize("weight_decay", [0.0, 0.01])
+@pytest.mark.parametrize("eps_inside_sqrt", [False, True])
+def test_fused_lamb_matches_pure_jax(weight_decay, eps_inside_sqrt):
+    kw = dict(weight_decay=weight_decay, eps_inside_sqrt=eps_inside_sqrt)
+    ref = Lamb(**kw)
+    fused = FusedLamb(**kw)
+    params, grads = _tree()
+    state_r = ref.init(params)
+    state_f = fused.init(params)
+    lr = jnp.float32(1e-2)
+    for step in range(3):
+        params_r, state_r, aux_r = ref.apply(params, grads, state_r, lr)
+        params_f, state_f, aux_f = fused.apply(params, grads, state_f, lr)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(params_r),
+            jax.tree_util.tree_leaves(params_f),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-6, atol=2e-6
+            )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state_r["mu"]),
+            jax.tree_util.tree_leaves(state_f["mu"]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-6, atol=2e-6
+            )
+        # blocked partial sums reorder the norm accumulation: tiny float
+        # drift in the trust ratios is expected
+        np.testing.assert_allclose(
+            np.asarray(jnp.stack(aux_r["lamb_coeffs"])),
+            np.asarray(jnp.stack(aux_f["lamb_coeffs"])),
+            rtol=1e-4,
+        )
+        params = params_r  # advance both from the same point
+        grads = jax.tree_util.tree_map(lambda g: g * 0.9, grads)
+
+
+def test_fused_lamb_under_jit():
+    fused = FusedLamb()
+    params, grads = _tree(seed=3)
+    state = fused.init(params)
+
+    @jax.jit
+    def step(params, grads, state, lr):
+        return fused.apply(params, grads, state, lr)
+
+    new_params, new_state, aux = step(params, grads, state, jnp.float32(1e-3))
+    assert int(new_state["step"]) == 1
+    for leaf in jax.tree_util.tree_leaves(new_params):
+        assert np.isfinite(np.asarray(leaf)).all()
