@@ -86,6 +86,38 @@ class DeepSpeedTransformerConfig:
         )
 
 
+def resolve_remat_policy(spec: str):
+    """Resolve a remat-policy spec: '+'-separated parts, each either a
+    ``jax.checkpoint_policies`` attribute or a ``checkpoint_name`` tag to
+    save (e.g. "dots_with_no_batch_dims_saveable+flash_out+flash_lse" keeps
+    weight-matmul outputs AND the flash kernel's residuals, so backward
+    recomputes only cheap elementwise chains)."""
+    import functools as _ft
+
+    from .attention import CHECKPOINT_NAMES
+
+    parts = spec.split("+")
+    policies, names = [], []
+    for p in parts:
+        if hasattr(jax.checkpoint_policies, p):
+            policies.append(getattr(jax.checkpoint_policies, p))
+        elif p in CHECKPOINT_NAMES:
+            names.append(p)
+        else:
+            # a typo'd policy name must fail loudly, not silently become a
+            # never-matching name-saver that recomputes everything
+            raise ValueError(
+                f"unknown remat policy part {p!r}: neither a "
+                f"jax.checkpoint_policies attribute nor a known checkpoint "
+                f"name {CHECKPOINT_NAMES}"
+            )
+    if names:
+        policies.append(jax.checkpoint_policies.save_only_these_names(*names))
+    if not policies:
+        raise ValueError(f"unresolvable remat policy spec: {spec!r}")
+    return _ft.reduce(jax.checkpoint_policies.save_from_both_policies, policies)
+
+
 class DeepSpeedTransformerLayer(nn.Module):
     """One transformer block. __call__(hidden [B,S,H], attention_mask
     additive [B,1,1,S] or None) -> [B,S,H]."""
@@ -219,7 +251,6 @@ class DeepSpeedTransformerLayer(nn.Module):
                 block = jax.checkpoint(block)
             else:
                 block = jax.checkpoint(
-                    block,
-                    policy=getattr(jax.checkpoint_policies, cfg.remat_policy),
+                    block, policy=resolve_remat_policy(cfg.remat_policy)
                 )
         return block(hidden_states)

@@ -191,7 +191,10 @@ def ulysses_attention_local(
 
     S = Sl * sp
     on_tpu = jax.default_backend() == "tpu"
-    can_flash = use_flash and on_tpu and S % 128 == 0
+    from ..ops.attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, pick_block
+
+    bq, bk = pick_block(S, DEFAULT_BLOCK_Q), pick_block(S, DEFAULT_BLOCK_K)
+    can_flash = use_flash and on_tpu and bq > 0 and bk > 0
     if can_flash:
         seed = jnp.asarray(0, jnp.int32)
         if use_dropout:
@@ -199,6 +202,7 @@ def ulysses_attention_local(
         ctx = flash_attention(
             qg, kg, vg, kv_mask=kvv_full, causal=causal, sm_scale=sm_scale,
             dropout_rate=dropout_rate if use_dropout else 0.0, dropout_seed=seed,
+            block_q=bq, block_k=bk,
         )
     else:
         mask = None

@@ -120,30 +120,35 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None):
             f.write(serialization.msgpack_serialize(state))
 
     # ---- optimizer shard files (round-robin over processes) ---------
+    # Gather ONE leaf at a time and slice it into every owned rank's
+    # payload immediately: peak host memory is one full leaf, not the whole
+    # optimizer state (which ZeRO sharded precisely because it doesn't fit
+    # in one place). Production multi-host pods should still prefer
+    # addressable-shard streaming writers; process_allgather here is the
+    # correct-but-chatty fallback.
     leaves, _ = _flatten(engine.optimizer_state)
     axes = [_data_axis_of(l) for l in leaves]
     dp = engine.dp_world_size if engine.zero_stage >= 1 else 1
-    host_leaves = [_to_host(l) for l in leaves]
-    for rank in range(dp):
-        if rank % n_proc != proc:
-            continue
-        shard_leaves = []
-        for arr, ax in zip(host_leaves, axes):
-            if ax >= 0 and dp > 1 and arr.shape[ax] % dp == 0:
-                shard_leaves.append(
-                    np.array_split(arr, dp, axis=ax)[rank]
-                )
+    owned_ranks = [r for r in range(dp) if r % n_proc == proc]
+    rank_leaves = {r: [] for r in owned_ranks}
+    splittable = []
+    for leaf, ax in zip(leaves, axes):
+        arr = _to_host(leaf)
+        can_split = bool(ax >= 0 and dp > 1 and arr.shape[ax] % dp == 0)
+        splittable.append(can_split)
+        for rank in owned_ranks:
+            if can_split:
+                rank_leaves[rank].append(np.array_split(arr, dp, axis=ax)[rank])
             else:
                 # replicated (or unsplittable) leaves ride in rank 0 only
-                shard_leaves.append(arr if rank == 0 else np.zeros((0,)))
+                rank_leaves[rank].append(arr if rank == 0 else np.zeros((0,)))
+        del arr
+    for rank in owned_ranks:
         payload = {
             "num_shards": dp,
             "shard_axes": [int(a) for a in axes],
-            "splittable": [
-                bool(a >= 0 and dp > 1 and np.asarray(l.shape)[a] % dp == 0)
-                for l, a in zip(host_leaves, axes)
-            ],
-            "leaves": {str(i): arr for i, arr in enumerate(shard_leaves)},
+            "splittable": splittable,
+            "leaves": {str(i): a for i, a in enumerate(rank_leaves[rank])},
         }
         path = os.path.join(ckpt_dir, OPTIM_FILE.format(dp=rank, mp=mp_rank))
         with open(path, "wb") as f:

@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Cluster installer for deepspeed_tpu (the reference's install.sh analog:
+# builds one wheel, fans it out over the hostfile with pdsh/ssh, pip
+# installs everywhere — reference install.sh:1-247, adapted for TPU VMs:
+# no CUDA/apex build step; the only native piece is the csrc/ host-ops
+# extension, built per-host because the wheel is pure-source).
+#
+# Usage:
+#   ./install.sh              # local install only
+#   ./install.sh -r           # remote hosts only (from hostfile)
+#   ./install.sh -a           # local + all remote hosts
+#   ./install.sh -H hostfile  # alternate hostfile (default /job/hostfile)
+#   ./install.sh -n           # no native extension build (pure python)
+set -euo pipefail
+
+HOSTFILE=/job/hostfile
+LOCAL=1
+REMOTE=0
+BUILD_EXT=1
+
+usage() { grep '^#' "$0" | sed 's/^# \{0,1\}//'; exit "${1:-0}"; }
+
+while getopts "ranH:h" opt; do
+  case $opt in
+    r) LOCAL=0; REMOTE=1 ;;
+    a) LOCAL=1; REMOTE=1 ;;
+    n) BUILD_EXT=0 ;;
+    H) HOSTFILE=$OPTARG ;;
+    h) usage ;;
+    *) usage 1 ;;
+  esac
+done
+
+cd "$(dirname "$0")"
+
+echo "Building sdist..."
+rm -rf dist
+python setup.py -q sdist
+PKG=$(ls dist/*.tar.gz | head -1)
+echo "Built $PKG"
+
+install_cmd() {
+  # build_ext is per-host: the compiled host-ops .so is not portable
+  local extras=""
+  [ "$BUILD_EXT" = 0 ] && extras="DS_TPU_SKIP_NATIVE=1 "
+  echo "${extras}python -m pip install --upgrade --no-deps"
+}
+
+if [ "$LOCAL" = 1 ]; then
+  echo "Installing locally..."
+  eval "$(install_cmd) \"$PKG\""
+fi
+
+if [ "$REMOTE" = 1 ]; then
+  if [ ! -f "$HOSTFILE" ]; then
+    echo "hostfile $HOSTFILE not found (use -H)" >&2
+    exit 1
+  fi
+  HOSTS=$(awk '!/^#/ && NF {print $1}' "$HOSTFILE")
+  TMP=/tmp/deepspeed_tpu_install
+  for h in $HOSTS; do
+    echo "Installing on $h..."
+    ssh -o StrictHostKeyChecking=no "$h" "mkdir -p $TMP"
+    scp -o StrictHostKeyChecking=no "$PKG" "$h:$TMP/"
+    ssh -o StrictHostKeyChecking=no "$h" \
+      "$(install_cmd) $TMP/$(basename "$PKG")"
+  done
+  echo "Remote install done on: $(echo "$HOSTS" | paste -sd, -)"
+fi
+echo "Done."

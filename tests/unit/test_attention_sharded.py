@@ -108,3 +108,40 @@ def test_dispatcher_falls_back_when_heads_do_not_divide(dp_mp_mesh):
     out = attention(q, k, v, mesh=dp_mp_mesh)
     ref = mha_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_autotune_flash_blocks_smoke():
+    """gemm_test.h analog: sweeps candidates, returns a valid best pair."""
+    from deepspeed_tpu.ops.autotune import autotune_flash_blocks
+
+    (bq, bk), table = autotune_flash_blocks(
+        2, 2, 128, 64, causal=True, dtype=jnp.float32,
+        candidates=((64, 64), (128, 128)), steps=1,
+    )
+    assert (bq, bk) in table and len(table) == 2
+    # cached second call returns identical result without re-timing
+    again, _ = autotune_flash_blocks(
+        2, 2, 128, 64, causal=True, dtype=jnp.float32,
+        candidates=((64, 64), (128, 128)), steps=1,
+    )
+    assert again == (bq, bk)
+
+
+def test_pick_block_falls_back_to_dividing_block():
+    from deepspeed_tpu.ops.attention import pick_block
+
+    assert pick_block(1024, 512) == 512
+    assert pick_block(768, 512) == 256   # 768 % 512 != 0 -> halve
+    assert pick_block(128, 512) == 128
+    assert pick_block(17, 512) == 17     # single full-dim block is tileable
+    assert pick_block(1030, 512) == 0    # 2*5*103: nothing >= 8 divides
+
+
+def test_resolve_remat_policy_rejects_typos():
+    import pytest as _pytest
+
+    from deepspeed_tpu.ops.transformer import resolve_remat_policy
+
+    resolve_remat_policy("dots_with_no_batch_dims_saveable+flash_out")
+    with _pytest.raises(ValueError, match="unknown remat policy part"):
+        resolve_remat_policy("dots_with_no_batch_dims_savable")  # typo
