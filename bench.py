@@ -105,7 +105,7 @@ def _measure_engine(engine, micro_batches, accum, warmup_windows, measure_window
 # workers: run exactly ONE attempt in this process; print JSON on success,
 # exit(OOM_EXIT) when the attempt doesn't fit.
 # ---------------------------------------------------------------------------
-def bert_attempt(policy, micro, total):
+def bert_attempt(policy, micro, total, seq=128, baseline=272.0):
     import dataclasses
 
     import jax
@@ -114,7 +114,7 @@ def bert_attempt(policy, micro, total):
     import deepspeed_tpu
     from deepspeed_tpu.models import BertConfig, BertForPreTraining
 
-    SEQ = 128
+    SEQ = seq
     accum = total // micro
     cfg = BertConfig.bert_large(
         max_position_embeddings=SEQ,
@@ -172,16 +172,76 @@ def bert_attempt(policy, micro, total):
     )
     sps = total / sec_per_window
     tflops = 6 * n_params * total * SEQ / sec_per_window / 1e12
-    log(f"BERT-large: {sps:.1f} samples/s ({tflops:.1f} model TFLOPS)")
+    log(f"BERT-large seq{SEQ}: {sps:.1f} samples/s ({tflops:.1f} model TFLOPS)")
     return {
-        "metric": "bert_large_pretrain_seq128_samples_per_sec_per_chip",
+        "metric": f"bert_large_pretrain_seq{SEQ}_samples_per_sec_per_chip",
         "value": round(sps, 2),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(sps / 272.0, 3),
+        "vs_baseline": round(sps / baseline, 3),
         "micro_batch": micro,
         "accum": accum,
         "remat_policy": policy,
         "model_tflops": round(tflops, 1),
+    }
+
+
+def squad_attempt(policy, micro):
+    """BERT-large extractive-QA fine-tune throughput, seq 384 (the
+    BingBertSquad rows of BASELINE.md: 63.01 samples/s at micro-bs 32 on a
+    1x V100 32GB, docs/_posts/2020-05-28-...md:113-121)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import BertConfig, BertForQuestionAnswering
+
+    SEQ, BASELINE = 384, 63.01
+    cfg = BertConfig.bert_large(
+        max_position_embeddings=SEQ, attn_dropout_checkpoint=True,
+        remat_policy=policy,
+    )
+    model = BertForQuestionAnswering(cfg)
+    init_model = BertForQuestionAnswering(
+        dataclasses.replace(cfg, use_flash=False)
+    )
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (micro, SEQ)).astype(np.int32)
+    starts = rng.integers(0, SEQ, micro).astype(np.int32)
+    ends = rng.integers(0, SEQ, micro).astype(np.int32)
+    t0 = time.time()
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = init_model.init(
+            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+            jnp.asarray(ids[:2]), None, None,
+            jnp.asarray(starts[:2]), jnp.asarray(ends[:2]),
+        )["params"]
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    log(f"SQuAD init {time.time() - t0:.1f}s; params={n_params / 1e6:.1f}M")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": micro,
+            "optimizer": {"type": "Adam", "params": {"lr": 3e-5}},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    batches = [(ids, None, None, starts, ends)]
+    sec_per_window = _measure_engine(
+        engine, batches, 1, warmup_windows=3, measure_windows=8,
+    )
+    sps = micro / sec_per_window
+    log(f"SQuAD seq384: {sps:.1f} samples/s")
+    return {
+        "metric": "bert_large_squad_finetune_seq384_samples_per_sec_per_chip",
+        "value": round(sps, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps / BASELINE, 3),
+        "micro_batch": micro,
+        "remat_policy": policy,
     }
 
 
@@ -250,7 +310,12 @@ def _worker_main():
     spec = json.loads(os.environ["BENCH_WORKER"])
     try:
         if spec["kind"] == "bert":
-            result = bert_attempt(spec["policy"], spec["micro"], spec["total"])
+            result = bert_attempt(
+                spec["policy"], spec["micro"], spec["total"],
+                seq=spec.get("seq", 128), baseline=spec.get("baseline", 272.0),
+            )
+        elif spec["kind"] == "squad":
+            result = squad_attempt(spec["policy"], spec["micro"])
         else:
             result = gpt2_attempt(spec["model"], spec["policy"], spec["micro"])
     except Exception as e:  # noqa: BLE001
@@ -330,6 +395,36 @@ def _gpt2_params_estimate(name):
     return vocab_padded * H + 1024 * H + L * (12 * H * H + 13 * H) + 2 * H
 
 
+def bench_bert_seq512():
+    """BASELINE.md row 2: BERT-large seq 512, 52 samples/s on 1x V100."""
+    attempts = [
+        (GPT2_POLICY, 16),  # flash engages at seq 512; save its residuals
+        ("dots_with_no_batch_dims_saveable", 16),
+        ("full", 16),
+        ("full", 8),
+    ]
+    for policy, micro in attempts:
+        log(f"BERT seq512 attempt: micro={micro} total=64 policy={policy}")
+        result = _run_attempt(
+            {"kind": "bert", "policy": policy, "micro": micro, "total": 64,
+             "seq": 512, "baseline": 52.0}
+        )
+        if result is not None:
+            return result
+    log("BERT seq512: all attempts failed")
+    return None
+
+
+def bench_squad():
+    for policy, micro in [(GPT2_POLICY, 32), (GPT2_POLICY, 16), ("full", 16)]:
+        log(f"SQuAD attempt: micro={micro} policy={policy}")
+        result = _run_attempt({"kind": "squad", "policy": policy, "micro": micro})
+        if result is not None:
+            return result
+    log("SQuAD: all attempts failed")
+    return None
+
+
 def bench_gpt2():
     models = GPT2_MODELS
     name_env = os.environ.get("BENCH_GPT2")
@@ -362,12 +457,15 @@ def main():
     if os.environ.get("BENCH_WORKER"):
         _worker_main()
         return
-    only = os.environ.get("BENCH_ONLY")  # "bert" | "gpt2" | unset
+    # "bert" | "bert512" | "squad" | "gpt2" | unset (= run everything)
+    only = os.environ.get("BENCH_ONLY")
 
     bert = bench_bert() if only in (None, "bert") else None
+    bert512 = bench_bert_seq512() if only in (None, "bert512") else None
+    squad = bench_squad() if only in (None, "squad") else None
     gpt2 = bench_gpt2() if only in (None, "gpt2") else None
 
-    primary = bert or gpt2
+    primary = bert or gpt2 or bert512 or squad
     if primary is None:
         log("FATAL: no benchmark produced a number")
         sys.exit(1)
@@ -376,7 +474,9 @@ def main():
         "value": primary["value"],
         "unit": primary["unit"],
         "vs_baseline": primary["vs_baseline"],
-        "extras": {"bert": bert, "gpt2": gpt2},
+        "extras": {
+            "bert": bert, "bert_seq512": bert512, "squad": squad, "gpt2": gpt2,
+        },
     }
     print(json.dumps(out))
 

@@ -2,6 +2,7 @@ from .bert import (
     BertConfig,
     BertEncoder,
     BertForPreTraining,
+    BertForQuestionAnswering,
     BertModel,
     cross_entropy_ignore_index,
 )
@@ -11,6 +12,7 @@ __all__ = [
     "BertConfig",
     "BertEncoder",
     "BertForPreTraining",
+    "BertForQuestionAnswering",
     "BertModel",
     "GPT2Config",
     "GPT2LMHeadModel",

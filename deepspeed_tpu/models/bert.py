@@ -203,6 +203,40 @@ def cross_entropy_ignore_index(logits, labels, ignore_values=(-1, -100)):
     return num / den
 
 
+class BertForQuestionAnswering(nn.Module):
+    """Extractive-QA head: start/end span logits over the sequence
+    (reference: the vendored modeling.py BertForQuestionAnswering consumed
+    by the BingBertSquad harness, tests/model/BingBertSquad/*).
+
+    ``__call__(ids, mask, token_type_ids, start_positions, end_positions)``
+    returns the scalar loss (engine contract) when positions are given,
+    else ``(start_logits, end_logits)`` for inference.
+    """
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(
+        self, input_ids, attention_mask=None, token_type_ids=None,
+        start_positions=None, end_positions=None, train=True,
+    ):
+        cfg = self.config
+        seq_out, _, _ = BertModel(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, train=train
+        )
+        logits = nn.Dense(2, name="qa_outputs")(seq_out)  # [B, S, 2]
+        start_logits = logits[..., 0]
+        end_logits = logits[..., 1]
+        if start_positions is None or end_positions is None:
+            return start_logits, end_logits
+        # positions index into the sequence: CE over S "classes"
+        loss = 0.5 * (
+            cross_entropy_ignore_index(start_logits, start_positions)
+            + cross_entropy_ignore_index(end_logits, end_positions)
+        )
+        return loss
+
+
 class BertForPreTraining(nn.Module):
     """MLM + NSP pretraining objective; __call__ returns the scalar loss
     (the engine's model contract)."""

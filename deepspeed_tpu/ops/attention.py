@@ -536,14 +536,15 @@ def flash_attention(
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    block_q = min(block_q, q.shape[2])
-    block_k = min(block_k, k.shape[2])
     sq, sk = q.shape[2], k.shape[2]
-    if sq % block_q != 0 or sk % block_k != 0:
+    # shrink to the largest dividing block so e.g. seq 768 runs with
+    # 256-blocks instead of failing the divisibility check on the default
+    block_q = pick_block(sq, block_q)
+    block_k = pick_block(sk, block_k)
+    if block_q == 0 or block_k == 0:
         raise ValueError(
-            f"flash_attention requires seq lengths divisible by block sizes: "
-            f"sq={sq} % {block_q}, sk={sk} % {block_k}; pad the sequence or "
-            f"use attention()/mha_reference"
+            f"flash_attention found no block size dividing sq={sq}/sk={sk}; "
+            f"pad the sequence or use attention()/mha_reference"
         )
     if kv_mask is None and mask is not None:
         kv_mask = additive_mask_to_kv_valid(mask)
@@ -601,8 +602,12 @@ def flash_attention_sharded(
 
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    block_q = min(block_q, q.shape[2])
-    block_k = min(block_k, k.shape[2])
+    block_q = pick_block(q.shape[2], block_q)
+    block_k = pick_block(k.shape[2], block_k)
+    if block_q == 0 or block_k == 0:
+        raise ValueError(
+            f"no block size divides sq={q.shape[2]}/sk={k.shape[2]}"
+        )
     qspec = P(DATA_AXIS, MODEL_AXIS, None, None)
     use_mask = kv_mask is not None
     seed = jnp.asarray(dropout_seed, jnp.int32)

@@ -56,6 +56,8 @@ def autotune_flash_blocks(
         bq_eff, bk_eff = min(bq, seq), min(bk, seq)
         if seq % bq_eff or seq % bk_eff:
             continue
+        if (bq_eff, bk_eff) in results:
+            continue  # clamped duplicates: don't re-time the same config
 
         if include_backward:
             def run(q, k, v, bq=bq_eff, bk=bk_eff):

@@ -138,7 +138,12 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None):
         splittable.append(can_split)
         for rank in owned_ranks:
             if can_split:
-                rank_leaves[rank].append(np.array_split(arr, dp, axis=ax)[rank])
+                # copy: array_split returns VIEWS that would pin the full
+                # gathered leaf, defeating the leaf-at-a-time peak-memory
+                # bound this loop exists for
+                rank_leaves[rank].append(
+                    np.ascontiguousarray(np.array_split(arr, dp, axis=ax)[rank])
+                )
             else:
                 # replicated (or unsplittable) leaves ride in rank 0 only
                 rank_leaves[rank].append(arr if rank == 0 else np.zeros((0,)))

@@ -1,0 +1,70 @@
+"""Fine-tune quality gate: BERT extractive QA must reach an exact-match
+threshold after fine-tuning through the engine.
+
+The scaled-down analog of the reference's BingBertSquad e2e gate, which
+fine-tunes on SQuAD v1.1 and asserts EM 83.98 / F1 90.71 after ~5 GPU-hours
+(reference: tests/model/BingBertSquad/test_e2e_squad.py:53-58). Here the
+task is synthetic extractive QA — the answer span is delimited by sentinel
+tokens the model must locate — so the same train-to-quality contract runs
+in seconds: engine fine-tune -> argmax span -> EM >= 0.9.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import BertConfig, BertForQuestionAnswering
+
+VOCAB, SEQ = 64, 64
+START_TOK, END_TOK = 2, 3
+
+
+def _make_batch(rng, n):
+    ids = rng.integers(4, VOCAB, (n, SEQ)).astype(np.int32)
+    starts = rng.integers(1, SEQ - 6, n).astype(np.int32)
+    ends = (starts + 1 + rng.integers(1, 4, n)).astype(np.int32)
+    for i in range(n):
+        ids[i, starts[i]] = START_TOK
+        ids[i, ends[i]] = END_TOK
+    return ids, starts, ends
+
+
+def test_qa_finetune_reaches_exact_match_gate():
+    cfg = BertConfig(
+        vocab_size=VOCAB, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=SEQ, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    model = BertForQuestionAnswering(cfg)
+    rng = np.random.default_rng(0)
+    ids0, s0, e0 = _make_batch(rng, 4)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jnp.asarray(ids0), None, None, jnp.asarray(s0), jnp.asarray(e0),
+    )["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": 32,
+            "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+            "steps_per_print": 10_000,
+        },
+    )
+    for _ in range(80):
+        ids, starts, ends = _make_batch(rng, 32)
+        loss = engine(ids, None, None, starts, ends)
+        engine.backward(loss)
+        engine.step()
+
+    # held-out evaluation: exact match of the argmax span
+    ids, starts, ends = _make_batch(np.random.default_rng(999), 64)
+    start_logits, end_logits = model.apply(
+        {"params": engine.params}, jnp.asarray(ids), train=False
+    )
+    pred_s = np.asarray(jnp.argmax(start_logits, axis=-1))
+    pred_e = np.asarray(jnp.argmax(end_logits, axis=-1))
+    em = float(np.mean((pred_s == starts) & (pred_e == ends)))
+    assert em >= 0.9, f"exact match {em:.2f} below the 0.9 gate"

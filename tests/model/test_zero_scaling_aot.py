@@ -1,70 +1,76 @@
-"""ZeRO scaling proof, ahead-of-time: the GPT-2 1.5B training step — which
-cannot fit one 16 GB chip (fp32 params+grads+Adam state = 24.8 GB) — must
-compile under ZeRO sharding on an 8-device mesh with a per-device footprint
-that fits.
+"""ZeRO scaling proofs, ahead-of-time: models that cannot fit one 16 GB
+chip must compile under ZeRO sharding with a per-device footprint that
+fits — validated from XLA's memory analysis without materializing a byte.
 
-This is the scaling claim of the reference's perf harness
-(tests/model/Megatron_GPT2/run_perf_test.py: 1.5B across 16 GPUs with
-ZeRO-2) validated without hardware: AOT-lower the jitted step against
-sharded abstract inputs and read XLA's memory analysis. No 1.5B buffers are
-ever materialized — everything runs on ShapeDtypeStructs.
+Covers the reference's scaling claims (tests/model/Megatron_GPT2/
+run_perf_test.py: GPT-2 1.5B across 16 GPUs with ZeRO-2; the Turing-NLG
+17B announcement trained with ZeRO + Megatron MP) on virtual CPU meshes.
+``memory_analysis()`` reports PER-DEVICE bytes; arguments + temps bound the
+live footprint (outputs alias donated arguments in the real engine step).
 """
+
+import os
+import subprocess
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
-from deepspeed_tpu.parallel.mesh import build_mesh
-from deepspeed_tpu.runtime import zero as zero_lib
-from deepspeed_tpu.ops.optimizers import Adam
-
 HBM_BYTES = 16e9
-N_DEV = 8
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-@pytest.mark.parametrize("preset,min_params_b", [("xl_1_5b", 1.5)])
-def test_zero2_step_shards_within_one_chip(preset, min_params_b):
-    mesh = build_mesh(data_parallel_size=N_DEV)
-    cfg = getattr(GPT2Config, preset)(
-        remat=True, remat_policy="dots_with_no_batch_dims_saveable",
+def _aot_footprint(cfg_kwargs, dp, mp, stage, micro, seq=1024):
+    """Lower+compile the sharded train step; return (n_params, args+temp
+    per-device bytes). Runs in-process on the current (8-device) mesh."""
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel, partition_specs
+    from deepspeed_tpu.ops.optimizers import Adam
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime import zero as zero_lib
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = GPT2Config(
+        dropout=0.0, remat=True,
+        remat_policy="dots_with_no_batch_dims_saveable",
         use_flash=False,  # CPU lowering; kernel choice doesn't move state
-        dropout=0.0,
+        **cfg_kwargs,
     )
     model = GPT2LMHeadModel(cfg)
-    MICRO, SEQ = 8, 1024
-    ids_shape = jax.ShapeDtypeStruct((MICRO, SEQ), jnp.int32)
+    mesh = build_mesh(data_parallel_size=dp, model_parallel_size=mp)
 
     params_shape = jax.eval_shape(
         lambda rng: model.init(
-            {"params": rng}, jnp.zeros((1, SEQ), jnp.int32),
-            jnp.zeros((1, SEQ), jnp.int32), train=False,
+            {"params": rng}, jnp.zeros((1, seq), jnp.int32),
+            jnp.zeros((1, seq), jnp.int32), train=False,
         )["params"],
         jax.random.PRNGKey(0),
     )
     n_params = sum(
         int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params_shape)
     )
-    assert n_params >= min_params_b * 1e9
-
     opt = Adam()
     opt_shape = jax.eval_shape(opt.init, params_shape)
-
-    stage = 2
-    param_specs = zero_lib.zero_param_specs(params_shape, N_DEV, stage)
-    grad_specs = zero_lib.zero_grad_specs(params_shape, N_DEV, stage)
-    optstate_param_specs = zero_lib.zero_optstate_specs(
-        params_shape, N_DEV, stage
-    )
-    param_sh = zero_lib.specs_to_shardings(param_specs, mesh)
-    grad_sh = zero_lib.specs_to_shardings(grad_specs, mesh)
-    opt_sh = zero_lib.specs_to_shardings(
-        zero_lib.optstate_specs_like(opt_shape, optstate_param_specs, params_shape),
+    mp_specs = partition_specs(params_shape) if mp > 1 else None
+    param_sh = zero_lib.specs_to_shardings(
+        zero_lib.zero_param_specs(params_shape, dp, stage, model_specs=mp_specs),
         mesh,
     )
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
+    grad_sh = zero_lib.specs_to_shardings(
+        zero_lib.zero_grad_specs(params_shape, dp, stage, model_specs=mp_specs),
+        mesh,
+    )
+    opt_sh = zero_lib.specs_to_shardings(
+        zero_lib.optstate_specs_like(
+            opt_shape,
+            zero_lib.zero_optstate_specs(
+                params_shape, dp, stage, model_specs=mp_specs
+            ),
+            params_shape,
+        ),
+        mesh,
+    )
     data_sh = NamedSharding(mesh, P("data", None))
 
     def train_step(params, opt_state, ids):
@@ -86,35 +92,75 @@ def test_zero2_step_shards_within_one_chip(preset, min_params_b):
         )
         return new_params, new_opt
 
-    def shaped(tree, shardings):
+    def shaped(tree, sh):
         return jax.tree_util.tree_map(
             lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
-            tree, shardings,
+            tree, sh,
         )
 
-    lowered = jax.jit(
+    compiled = jax.jit(
         train_step,
         in_shardings=(param_sh, opt_sh, data_sh),
         out_shardings=(param_sh, opt_sh),
     ).lower(
         shaped(params_shape, param_sh),
         shaped(opt_shape, opt_sh),
-        jax.ShapeDtypeStruct(ids_shape.shape, ids_shape.dtype, sharding=data_sh),
-    )
-    compiled = lowered.compile()
+        jax.ShapeDtypeStruct((micro, seq), jnp.int32, sharding=data_sh),
+    ).compile()
     mem = compiled.memory_analysis()
     if mem is None:
         pytest.skip("backend provides no memory analysis")
-    per_device = (
-        mem.argument_size_in_bytes / N_DEV
-        + mem.temp_size_in_bytes / N_DEV
-        + mem.output_size_in_bytes / N_DEV
+    return n_params, mem.argument_size_in_bytes + mem.temp_size_in_bytes
+
+
+def test_gpt2_1_5b_zero2_fits_per_chip():
+    """The reference's 1.5B perf config, ZeRO-2 over 8 chips: per-device
+    footprint must fit although the unsharded fp32 state (~25 GB) cannot."""
+    n, per_dev = _aot_footprint(
+        dict(n_embd=1600, n_layer=48, n_head=25), dp=8, mp=1, stage=2, micro=8,
     )
-    # unsharded fp32 state alone is ~25 GB; sharded step must fit one chip
-    assert per_device < HBM_BYTES, (
-        f"per-device footprint {per_device / 1e9:.1f} GB exceeds HBM"
+    assert n >= 1.5e9
+    assert 16 * n > HBM_BYTES  # the unsharded state really doesn't fit
+    assert per_dev < HBM_BYTES, f"{per_dev / 1e9:.1f} GB"
+
+
+def test_gpt2_1_5b_zero3_shards_params_too():
+    """Stage 3 (beyond the reference) additionally shards parameters: the
+    per-device footprint must drop well below stage 2's."""
+    n, s2 = _aot_footprint(
+        dict(n_embd=1600, n_layer=48, n_head=25), dp=8, mp=1, stage=2, micro=8,
     )
-    # and ZeRO must actually be doing something: the all-device total
-    # divided by N must be far below the unsharded state
-    unsharded_state = 16 * n_params
-    assert per_device < 0.8 * unsharded_state, (per_device, unsharded_state)
+    _, s3 = _aot_footprint(
+        dict(n_embd=1600, n_layer=48, n_head=25), dp=8, mp=1, stage=3, micro=8,
+    )
+    assert s3 < 0.65 * s2, (s3 / 1e9, s2 / 1e9)
+
+
+TURING_SNIPPET = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=128"
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {repo!r} + "/tests")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from model.test_zero_scaling_aot import _aot_footprint, HBM_BYTES
+n, per_dev = _aot_footprint(
+    dict(n_embd=4256, n_layer=78, n_head=28), dp=16, mp=8, stage=2, micro=16,
+)
+assert n >= 17e9, n
+assert per_dev < HBM_BYTES, per_dev
+print(f"TURING17B_OK {{n}} {{per_dev}}")
+"""
+
+
+def test_turing_17b_zero2_mp8_fits_per_chip_on_128_devices():
+    """Turing-NLG-scale 17B, ZeRO-2 x Megatron-MP8 over 128 devices (the
+    BASELINE 'v5p-128' config): needs its own 128-device interpreter."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", TURING_SNIPPET.format(repo=REPO)],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "TURING17B_OK" in proc.stdout
