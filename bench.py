@@ -105,6 +105,23 @@ def _measure_engine(engine, micro_batches, accum, warmup_windows, measure_window
 # workers: run exactly ONE attempt in this process; print JSON on success,
 # exit(OOM_EXIT) when the attempt doesn't fit.
 # ---------------------------------------------------------------------------
+def _host_init(init_model, *example_args):
+    """Initialize params on the host CPU (param shapes don't depend on the
+    attention impl; Pallas doesn't lower on the CPU backend, so callers
+    pass a use_flash=False twin of their model). Returns (params, n)."""
+    import jax
+
+    t0 = time.time()
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = init_model.init(
+            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+            *example_args,
+        )["params"]
+    n = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    log(f"host init {time.time() - t0:.1f}s; params={n / 1e6:.1f}M")
+    return params, n
+
+
 def bert_attempt(policy, micro, total, seq=128, baseline=272.0):
     import dataclasses
 
@@ -132,15 +149,10 @@ def bert_attempt(policy, micro, total, seq=128, baseline=272.0):
     mlm = np.where(rng.random((total, SEQ)) < 0.15, ids, -1).astype(np.int32)
     nsp = rng.integers(0, 2, (total,)).astype(np.int32)
 
-    t0 = time.time()
-    with jax.default_device(jax.devices("cpu")[0]):
-        params = init_model.init(
-            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
-            jnp.asarray(ids[:2]), jnp.asarray(mask[:2]), None,
-            jnp.asarray(mlm[:2]), jnp.asarray(nsp[:2]),
-        )["params"]
-    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
-    log(f"BERT-large init {time.time() - t0:.1f}s; params={n_params / 1e6:.1f}M")
+    params, n_params = _host_init(
+        init_model, jnp.asarray(ids[:2]), jnp.asarray(mask[:2]), None,
+        jnp.asarray(mlm[:2]), jnp.asarray(nsp[:2]),
+    )
 
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
@@ -210,15 +222,10 @@ def squad_attempt(policy, micro):
     ids = rng.integers(0, cfg.vocab_size, (micro, SEQ)).astype(np.int32)
     starts = rng.integers(0, SEQ, micro).astype(np.int32)
     ends = rng.integers(0, SEQ, micro).astype(np.int32)
-    t0 = time.time()
-    with jax.default_device(jax.devices("cpu")[0]):
-        params = init_model.init(
-            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
-            jnp.asarray(ids[:2]), None, None,
-            jnp.asarray(starts[:2]), jnp.asarray(ends[:2]),
-        )["params"]
-    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
-    log(f"SQuAD init {time.time() - t0:.1f}s; params={n_params / 1e6:.1f}M")
+    params, n_params = _host_init(
+        init_model, jnp.asarray(ids[:2]), None, None,
+        jnp.asarray(starts[:2]), jnp.asarray(ends[:2]),
+    )
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         model_parameters=params,
@@ -266,14 +273,9 @@ def gpt2_attempt(model_name, policy, micro):
     init_model = GPT2LMHeadModel(dataclasses.replace(cfg, use_flash=False))
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (micro, SEQ)).astype(np.int32)
-    t0 = time.time()
-    with jax.default_device(jax.devices("cpu")[0]):
-        params = init_model.init(
-            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
-            jnp.asarray(ids[:1]), jnp.asarray(ids[:1]),
-        )["params"]
-    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
-    log(f"GPT-2 {model_name} init {time.time() - t0:.1f}s; params={n_params / 1e6:.0f}M")
+    params, n_params = _host_init(
+        init_model, jnp.asarray(ids[:1]), jnp.asarray(ids[:1]),
+    )
 
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,

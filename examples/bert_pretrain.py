@@ -1,0 +1,83 @@
+"""BERT pretraining with fused LAMB + remat (the DeepSpeedExamples
+`bing_bert` workload shape). Synthetic MLM/NSP data; swap in a real corpus
+for actual pretraining.
+
+    python examples/bert_pretrain.py            # bert-base, bf16, LAMB
+    BERT=large python examples/bert_pretrain.py # bert-large (needs >8GB HBM)
+"""
+
+import itertools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import BertConfig, BertForPreTraining
+
+SEQ = 128
+
+
+def make_batches(cfg, total, micro, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, (total, SEQ)).astype(np.int32)
+    mask = np.ones((total, SEQ), np.int32)
+    mlm = np.where(rng.random((total, SEQ)) < 0.15, ids, -1).astype(np.int32)
+    nsp = rng.integers(0, 2, total).astype(np.int32)
+    return [
+        (ids[i:i + micro], mask[i:i + micro], np.zeros((micro, SEQ), np.int32),
+         mlm[i:i + micro], nsp[i:i + micro])
+        for i in range(0, total, micro)
+    ]
+
+
+def main():
+    large = os.environ.get("BERT") == "large"
+    mk = BertConfig.bert_large if large else BertConfig.bert_base
+    cfg = mk(
+        max_position_embeddings=SEQ,
+        attn_dropout_checkpoint=True,  # per-layer remat
+        remat_policy="dots_with_no_batch_dims_saveable",
+    )
+    model = BertForPreTraining(cfg)
+    micro, accum = (64, 4) if large else (64, 1)  # micro = GLOBAL micro-batch
+    world = jax.device_count()  # default mesh: all devices on the data axis
+    assert micro % world == 0, f"global micro-batch {micro} % devices {world}"
+    total = micro * accum
+    batches = make_batches(cfg, total, micro)
+
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        *(jnp.asarray(x[:2]) for x in batches[0]),
+    )["params"]
+
+    engine, _, _, scheduler = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": total,
+            "train_micro_batch_size_per_gpu": micro // world,
+            "gradient_accumulation_steps": accum,
+            "optimizer": {
+                "type": "Lamb",
+                "params": {"lr": 2e-3, "weight_decay": 0.01},
+            },
+            "bf16": {"enabled": True},
+            "scheduler": {
+                "type": "WarmupLR",
+                "params": {"warmup_max_lr": 2e-3, "warmup_num_steps": 50},
+            },
+            "steps_per_print": 10,
+        },
+    )
+    steps = int(os.environ.get("STEPS", "100"))
+    for step in range(steps):
+        loss = engine.train_batch(itertools.islice(itertools.cycle(batches), accum))
+        if step % 10 == 0:
+            print(f"step {step}: loss {float(loss):.4f}, "
+                  f"lamb trust ratios: {np.asarray(engine.lamb_coeffs)[:4]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""CIFAR-10-style image classification with ZeRO-1 (the DeepSpeedExamples
+`cifar` workload shape: small convnet, single host, ZeRO-1 config).
+
+Runs on synthetic CIFAR-shaped data so it works offline; swap `make_data`
+for a real loader to train CIFAR-10 proper.
+
+    python examples/cifar10_zero1.py
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+
+
+class ConvNet(nn.Module):
+    @nn.compact
+    def __call__(self, images, labels, train=True):
+        x = images
+        for feat in (32, 64):
+            x = nn.Conv(feat, (3, 3))(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(256)(x))
+        logits = nn.Dense(10)(x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def make_data(n, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+    labels = (images.mean(axis=(1, 2, 3)) > 0).astype(np.int32) * 5 + rng.integers(0, 5, n).astype(np.int32)
+    return images, labels
+
+
+def main():
+    model = ConvNet()
+    images, labels = make_data(4)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.asarray(images), jnp.asarray(labels),
+    )["params"]
+
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        training_data=make_data(2048),
+        config_params={
+            "train_batch_size": 128,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 5,
+        },
+    )
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            loss = engine(*batch)
+            engine.backward(loss)
+            engine.step()
+        print(f"epoch {epoch}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,9 +22,23 @@ HBM_BYTES = 16e9
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+_FOOTPRINT_CACHE = {}
+
+
 def _aot_footprint(cfg_kwargs, dp, mp, stage, micro, seq=1024):
     """Lower+compile the sharded train step; return (n_params, args+temp
-    per-device bytes). Runs in-process on the current (8-device) mesh."""
+    per-device bytes). Runs in-process on the current (8-device) mesh.
+
+    The step is compiled WITHOUT donation and outputs are excluded from the
+    footprint: the real engine's update donates params+opt state
+    (runtime/engine.py, donate_argnums), so at runtime outputs alias the
+    argument buffers one-for-one (identical tree structure and shardings).
+    Compiling WITH donation here would be wrong the other way — this
+    backend's memory_analysis folds donated outputs into temps, double
+    counting them. Results are memoized per config."""
+    key = (tuple(sorted(cfg_kwargs.items())), dp, mp, stage, micro, seq)
+    if key in _FOOTPRINT_CACHE:
+        return _FOOTPRINT_CACHE[key]
     from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel, partition_specs
     from deepspeed_tpu.ops.optimizers import Adam
     from deepspeed_tpu.parallel.mesh import build_mesh
@@ -110,7 +124,9 @@ def _aot_footprint(cfg_kwargs, dp, mp, stage, micro, seq=1024):
     mem = compiled.memory_analysis()
     if mem is None:
         pytest.skip("backend provides no memory analysis")
-    return n_params, mem.argument_size_in_bytes + mem.temp_size_in_bytes
+    result = (n_params, mem.argument_size_in_bytes + mem.temp_size_in_bytes)
+    _FOOTPRINT_CACHE[key] = result
+    return result
 
 
 def test_gpt2_1_5b_zero2_fits_per_chip():
