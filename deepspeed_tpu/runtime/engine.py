@@ -285,6 +285,7 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.skipped_steps = 0
         self.last_overflow = False
+        self.last_aux = ()  # extra model outputs (multi-output contract)
         self.lamb_coeffs = []
         self._training = True
         # rbg keys generate random bits ~an order of magnitude faster than
@@ -468,14 +469,21 @@ class DeepSpeedEngine:
             )
 
         def scaled_loss_fn(params, batch, rng, loss_scale):
-            loss = loss_fn(cast_params(params), cast_batch(batch), rng)
+            out = loss_fn(cast_params(params), cast_batch(batch), rng)
+            # multi-output contract (reference multi_output_model.py: the
+            # trained loss plus per-head losses the user wants to observe):
+            # a tuple return trains on out[0]; the rest ride as aux.
+            if isinstance(out, (tuple, list)):
+                loss, aux = out[0], tuple(out[1:])
+            else:
+                loss, aux = out, ()
             return (
                 loss.astype(jnp.float32) * loss_scale / accum,
-                loss,
+                (loss, aux),
             )
 
         def fwd_bwd(params, batch, rng, loss_scale):
-            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(
+            grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
                 params, batch, rng, loss_scale
             )
             grads = jax.tree_util.tree_map(
@@ -485,12 +493,17 @@ class DeepSpeedEngine:
                 grads,
                 grad_shardings,
             )
-            return loss, grads
+            return loss, aux, grads
 
         self._jit_fwd_bwd = jax.jit(fwd_bwd)
 
         def fwd_only(params, batch, rng):
-            return loss_fn(cast_params(params), cast_batch(batch), rng)
+            out = loss_fn(cast_params(params), cast_batch(batch), rng)
+            # same multi-output split as the train path: scalar loss out,
+            # extra outputs as aux
+            if isinstance(out, (tuple, list)):
+                return out[0], tuple(out[1:])
+            return out, ()
 
         self._jit_fwd_only = jax.jit(fwd_only)
 
@@ -582,8 +595,12 @@ class DeepSpeedEngine:
             loss_scale = scaler_state.loss_scale
             if accum == 1:
                 first = jax.tree_util.tree_map(lambda x: x[0], batches)
-                loss, grads = fwd_bwd(params, first, rng_keys[0], loss_scale)
+                loss, aux, grads = fwd_bwd(
+                    params, first, rng_keys[0], loss_scale
+                )
                 losses = loss.astype(jnp.float32)[None]
+                # match the accum>1 scan's [accum]-stacked aux layout
+                aux = jax.tree_util.tree_map(lambda a: a[None], aux)
             else:
                 zeros = jax.tree_util.tree_map(
                     lambda p, s: jax.lax.with_sharding_constraint(
@@ -595,7 +612,7 @@ class DeepSpeedEngine:
 
                 def body(gbuf, xs):
                     b, k = xs
-                    loss, g = fwd_bwd(params, b, k, loss_scale)
+                    loss, aux, g = fwd_bwd(params, b, k, loss_scale)
                     gbuf = jax.tree_util.tree_map(
                         lambda a, gg, s: jax.lax.with_sharding_constraint(
                             a + gg, s
@@ -604,15 +621,17 @@ class DeepSpeedEngine:
                         g,
                         grad_shardings,
                     )
-                    return gbuf, loss.astype(jnp.float32)
+                    return gbuf, (loss.astype(jnp.float32), aux)
 
-                grads, losses = jax.lax.scan(body, zeros, (batches, rng_keys))
+                grads, (losses, aux) = jax.lax.scan(
+                    body, zeros, (batches, rng_keys)
+                )
             new_params, new_opt, _, new_scaler, overflow, grad_norm, coeffs = (
                 update_body(params, opt_state, grads, scaler_state, lr)
             )
             return (
                 new_params, new_opt, new_scaler, overflow, grad_norm, coeffs,
-                jnp.mean(losses),
+                jnp.mean(losses), aux,
             )
 
         self._jit_train_window = jax.jit(train_window, donate_argnums=(0, 1, 2))
@@ -629,13 +648,15 @@ class DeepSpeedEngine:
         batch = self._shard_batch(inputs)
         self._rng, key = jax.random.split(self._rng)
         if self._training:
-            loss, grads = self._jit_fwd_bwd(
+            loss, aux, grads = self._jit_fwd_bwd(
                 self.params, batch, key, self.loss_scale_state.loss_scale
             )
             self._pending_grads = grads
             self._pending_loss = loss
+            self.last_aux = aux
         else:
-            loss = self._jit_fwd_only(self.params, batch, key)
+            loss, aux = self._jit_fwd_only(self.params, batch, key)
+            self.last_aux = aux
         if self.wall_clock_breakdown:
             self.timers(FORWARD_TIMER).stop()
         return loss
@@ -790,6 +811,7 @@ class DeepSpeedEngine:
             grad_norm,
             coeffs,
             mean_loss,
+            aux,
         ) = self._jit_train_window(
             self.params,
             self.optimizer_state,
@@ -799,6 +821,8 @@ class DeepSpeedEngine:
             lr,
         )
         self.micro_steps += accum
+        # aux outputs from a multi-output model, [accum, ...]-stacked
+        self.last_aux = aux
         self._finish_step(overflow, grad_norm, coeffs, mean_loss)
         # Returned as a device scalar: float(loss) would serialize the train
         # loop on the device (costly on remote-tunneled TPU platforms).

@@ -1,0 +1,94 @@
+"""argparse injection tests (reference tests/unit/test_ds_arguments.py):
+add_config_arguments must coexist with user args, default sensibly, and
+accept the deprecated --deepscale* aliases."""
+
+import argparse
+
+import pytest
+
+import deepspeed_tpu
+
+
+def basic_parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int)
+    return parser
+
+
+def test_no_ds_arguments_no_ds_parser():
+    args = basic_parser().parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert not hasattr(args, "deepspeed")
+    assert not hasattr(args, "deepspeed_config")
+
+
+def test_no_ds_arguments():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+
+
+def test_core_deepspeed_arguments():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(
+        ["--num_epochs", "2", "--deepspeed", "--deepspeed_config", "ds.json"]
+    )
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "ds.json"
+
+
+def test_only_ds_arguments():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--deepspeed"])
+    assert args.deepspeed is True
+    assert args.num_epochs is None
+
+
+def test_deprecated_deepscale_aliases():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--deepscale", "--deepscale_config", "ds.json"])
+    assert args.deepscale is True
+    assert args.deepscale_config == "ds.json"
+
+
+def test_mpi_discovery_flag():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--deepspeed_mpi"])
+    assert args.deepspeed_mpi is True
+
+
+def test_engine_reads_config_path_from_args(tmp_path):
+    """initialize(args=...) must pick up --deepspeed_config (and the
+    deprecated alias) exactly like the reference engine
+    (deepspeed_light.py:428-435)."""
+    import json
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    cfg_path = tmp_path / "ds.json"
+    cfg_path.write_text(json.dumps({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }))
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return jnp.mean(nn.Dense(4)(x) ** 2)
+
+    model = M()
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((2, 4))
+    )["params"]
+
+    for flag in ("--deepspeed_config", "--deepscale_config"):
+        parser = deepspeed_tpu.add_config_arguments(argparse.ArgumentParser())
+        args = parser.parse_args([flag, str(cfg_path)])
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            args=args, model=model, model_parameters=params
+        )
+        assert engine.train_batch_size() == 8
