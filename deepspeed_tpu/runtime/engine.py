@@ -64,6 +64,14 @@ BACKWARD_TIMER = "backward"
 STEP_TIMER = "step"
 
 
+def _split_model_output(out):
+    """Multi-output contract (reference multi_output_model.py): a tuple
+    return trains on element 0; the rest ride along as observable aux."""
+    if isinstance(out, (tuple, list)):
+        return out[0], tuple(out[1:])
+    return out, ()
+
+
 class EngineOptimizerFacade:
     """What ``initialize()`` returns as ``optimizer``: exposes the
     reference's optimizer duck-type (loss_scale, overflow, lamb_coeffs)
@@ -257,7 +265,9 @@ class DeepSpeedEngine:
         self._grad_buffer = None  # lazily allocated on first backward
         self._pending_grads = None
         self._pending_loss = None
+        self._pending_aux = ()
         self._window_losses = []  # device arrays; one per micro-step
+        self._window_aux = []  # per-micro-step aux tuples (stacked at step())
 
         # ---- lr scheduler ---------------------------------------------
         self.lr_scheduler = self._configure_lr_scheduler()
@@ -470,13 +480,7 @@ class DeepSpeedEngine:
 
         def scaled_loss_fn(params, batch, rng, loss_scale):
             out = loss_fn(cast_params(params), cast_batch(batch), rng)
-            # multi-output contract (reference multi_output_model.py: the
-            # trained loss plus per-head losses the user wants to observe):
-            # a tuple return trains on out[0]; the rest ride as aux.
-            if isinstance(out, (tuple, list)):
-                loss, aux = out[0], tuple(out[1:])
-            else:
-                loss, aux = out, ()
+            loss, aux = _split_model_output(out)
             return (
                 loss.astype(jnp.float32) * loss_scale / accum,
                 (loss, aux),
@@ -499,11 +503,7 @@ class DeepSpeedEngine:
 
         def fwd_only(params, batch, rng):
             out = loss_fn(cast_params(params), cast_batch(batch), rng)
-            # same multi-output split as the train path: scalar loss out,
-            # extra outputs as aux
-            if isinstance(out, (tuple, list)):
-                return out[0], tuple(out[1:])
-            return out, ()
+            return _split_model_output(out)
 
         self._jit_fwd_only = jax.jit(fwd_only)
 
@@ -653,6 +653,9 @@ class DeepSpeedEngine:
             )
             self._pending_grads = grads
             self._pending_loss = loss
+            self._pending_aux = aux
+            # mid-window view: this micro-step's raw aux; step() replaces it
+            # with the [accum]-stacked window (same layout as train_batch)
             self.last_aux = aux
         else:
             loss, aux = self._jit_fwd_only(self.params, batch, key)
@@ -681,8 +684,10 @@ class DeepSpeedEngine:
                 self._grad_buffer, self._pending_grads
             )
         self._pending_grads = None
-        if self._pending_loss is not None:
-            self._window_losses.append(self._pending_loss)
+        self._window_losses.append(self._pending_loss)
+        self._pending_loss = None
+        self._window_aux.append(self._pending_aux)
+        self._pending_aux = ()
         self.micro_steps += 1
         if self.wall_clock_breakdown:
             self.timers(BACKWARD_TIMER).stop()
@@ -720,6 +725,14 @@ class DeepSpeedEngine:
                 jnp.stack([l.astype(jnp.float32) for l in self._window_losses])
             )
         self._window_losses = []
+        if self._window_aux:
+            # [accum]-stack the window's aux — the same layout train_batch()
+            # produces, so multi-output logging code sees one contract on
+            # both train paths
+            self.last_aux = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *self._window_aux
+            )
+        self._window_aux = []
         if self.wall_clock_breakdown:
             self.timers(STEP_TIMER).stop()
         self._finish_step(overflow, grad_norm, coeffs, window_loss)

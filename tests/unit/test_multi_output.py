@@ -4,7 +4,10 @@ producing several losses, trained on their weighted combination under
 gradient accumulation while the individual losses stay observable).
 
 Contract here: a tuple return trains on element 0; the rest ride as aux
-(`engine.last_aux`), stacked per micro-step on the fused window path."""
+(`engine.last_aux`). After an optimizer step — on BOTH train paths —
+last_aux holds the window's aux [accum]-stacked; between forward() and
+step() it shows the latest micro-step's raw aux; in eval mode it is the
+raw aux of the last forward."""
 
 import flax.linen as nn
 import jax
@@ -73,16 +76,20 @@ def test_two_output_model_trains_and_exposes_head_losses():
         b2 = (x[16:], y1[16:], y2[16:])
         loss = engine(*b1)
         engine.backward(loss)
-        # aux from the step-wise path: raw per-micro-step tuple
+        # mid-window view: this micro-step's raw aux tuple
         l1, l2 = engine.last_aux
         assert np.isfinite(float(l1)) and np.isfinite(float(l2))
         loss = engine(*b2)
         engine.backward(loss)
         engine.step()
+        # post-step: the window's aux, [accum]-stacked — the same layout
+        # train_batch() produces
+        s1, s2 = engine.last_aux
+        assert s1.shape == (2,) and s2.shape == (2,)
         if first is None:
-            first = (float(l1), float(l2))
+            first = (float(jnp.mean(s1)), float(jnp.mean(s2)))
     # both heads must have learned, not just the combined objective
-    last = tuple(float(v) for v in engine.last_aux)
+    last = tuple(float(jnp.mean(v)) for v in engine.last_aux)
     assert last[0] < 0.5 * first[0], (first, last)
     assert last[1] < 0.5 * first[1], (first, last)
 
