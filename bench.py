@@ -36,8 +36,13 @@ import numpy as np
 OOM_EXIT = 43  # worker exit code meaning "this attempt ran out of memory"
 
 BERT_ATTEMPTS = [
-    # (remat_policy, micro): measured best first (v5e 16GB: micro=64 with
-    # matmul-outputs-saved remat ~358 samples/s); full-remat fallbacks after.
+    # (remat_policy, micro): measured best first (v5e 16GB sweep:
+    # dots_saveable@32 375.7 samples/s > dots_saveable@16 372.3 >
+    # dots_with_no_batch_dims_saveable@64 361.7 > none@32 342.2 >
+    # dots_with_no_batch_dims_saveable@128 311.5; micro=64 without remat
+    # OOMs). dots_saveable also keeps the attention-score matmuls, so
+    # backward recomputes only elementwise chains.
+    ("dots_saveable", 32),
     ("dots_with_no_batch_dims_saveable", 64),
     ("dots_with_no_batch_dims_saveable", 32),
     ("full", 256),
@@ -135,8 +140,11 @@ def bert_attempt(policy, micro, total, seq=128, baseline=272.0):
     accum = total // micro
     cfg = BertConfig.bert_large(
         max_position_embeddings=SEQ,
-        attn_dropout_checkpoint=True,  # per-layer remat of the scanned stack
-        remat_policy=policy,
+        # "none" = no remat at all (small micro-batches can afford to keep
+        # every activation; recompute-free backward); anything else enables
+        # per-layer remat of the scanned stack under that policy
+        attn_dropout_checkpoint=(policy != "none"),
+        remat_policy=policy if policy != "none" else "full",
     )
     model = BertForPreTraining(cfg)
     # Param shapes don't depend on the attention impl; init on host with the
@@ -361,11 +369,18 @@ def _run_attempt(spec, timeout=1500):
 def bench_bert():
     total = int(os.environ.get("BENCH_BATCH", "256"))
     micro_env = os.environ.get("BENCH_MICRO")
-    attempts = (
-        [("dots_with_no_batch_dims_saveable", int(micro_env))]
-        if micro_env
-        else BERT_ATTEMPTS
-    )
+    policy_env = os.environ.get("BENCH_POLICY")
+    if micro_env:
+        attempts = [(policy_env or "dots_saveable", int(micro_env))]
+    elif policy_env:
+        # policy pinned, micro free: sweep the default micro ladder under it
+        seen, attempts = set(), []
+        for _, m in BERT_ATTEMPTS:
+            if m not in seen:
+                seen.add(m)
+                attempts.append((policy_env, m))
+    else:
+        attempts = BERT_ATTEMPTS
     runnable = [(p, m) for p, m in attempts if total % m == 0]
     if not runnable:
         log(

@@ -39,6 +39,22 @@ class GPT2Config:
     use_flash: bool = True
     remat: bool = False
     remat_policy: str = "full"
+    # Pipeline parallelism (beyond the reference, which has no pipeline
+    # engine): split the layer stack into this many stages over the mesh's
+    # ``pipe`` axis and run the SPMD GPipe schedule
+    # (parallel/pipeline.py). n_layer must divide evenly.
+    pipeline_stages: int = 1
+    # microbatches per forward through the pipeline (bubble fraction is
+    # (P-1)/(M+P-1)); 0 = default of 2*stages. The batch must divide by it.
+    pipeline_microbatches: int = 0
+    # Mixture-of-Experts (beyond the reference): >0 replaces every layer's
+    # FFN with an expert-parallel MoE of this many experts (ops/moe.py);
+    # experts shard over the mesh's data axis, router aux losses join the
+    # objective and surface via the multi-output contract.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 1e-2
     # Device mesh forwarded to the transformer layers: enables the
     # sequence-parallel (ring/Ulysses) path when the mesh has a >1
     # ``sequence`` axis, and per-shard flash via shard_map under dp/mp.
@@ -96,6 +112,37 @@ class GPT2Config:
         )
 
 
+class _StackedBlockParams(nn.Module):
+    """Creates the 12-tensor transformer params with a leading ``layers``
+    axis — the same names/shapes the ``nn.scan`` path produces, so
+    checkpoints interchange between the scanned and pipelined stacks."""
+
+    layer_cfg: object
+    n_layer: int
+
+    @nn.compact
+    def __call__(self):
+        from ..ops.transformer import TRANSFORMER_PARAM_LAYOUT
+
+        cfg = self.layer_cfg
+        H = cfg.hidden_size
+        shapes = {"H": H, "3H": 3 * H, "I": cfg.intermediate}
+        init = nn.initializers.normal(stddev=cfg.initializer_range)
+        makers = {
+            "init": init,
+            "zeros": nn.initializers.zeros,
+            "ones32": nn.initializers.ones,
+            "zeros32": nn.initializers.zeros,
+        }
+        return {
+            name: self.param(
+                name, makers[kind],
+                (self.n_layer, *(shapes[d] for d in dims)), jnp.float32,
+            )
+            for name, dims, kind in TRANSFORMER_PARAM_LAYOUT
+        }
+
+
 class GPT2Model(nn.Module):
     config: GPT2Config
 
@@ -118,41 +165,168 @@ class GPT2Model(nn.Module):
                 x, rng=self.make_rng("dropout")
             )
 
-        x, _ = nn.scan(
-            lambda mdl, c, _: (mdl(c, None, train=train), None),
-            variable_axes={"params": 0},
-            split_rngs={"params": True, "dropout": True},
-            length=cfg.n_layer,
-            metadata_params={nn.PARTITION_NAME: "layers"},
-        )(
-            DeepSpeedTransformerLayer(
-                config=cfg.layer_config(), causal=True,
-                use_flash=cfg.use_flash, mesh=cfg.mesh, name="h",
-            ),
-            x,
-            None,
-        )
+        moe_aux = None
+        if cfg.pipeline_stages > 1:
+            if cfg.moe_experts > 0:
+                raise ValueError(
+                    "pipeline_stages > 1 with moe_experts > 0 is not "
+                    "supported yet; pick one of pp or ep for the stack"
+                )
+            x = self._pipelined_stack(x, train)
+        elif cfg.moe_experts > 0:
+            from ..ops.moe import DeepSpeedMoETransformerLayer, MoEConfig
+
+            x, aux_per_layer = nn.scan(
+                lambda mdl, c, _: mdl(c, None, train=train),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(
+                DeepSpeedMoETransformerLayer(
+                    config=cfg.layer_config(),
+                    moe=MoEConfig(
+                        n_experts=cfg.moe_experts,
+                        top_k=cfg.moe_top_k,
+                        capacity_factor=cfg.moe_capacity_factor,
+                        aux_loss_weight=cfg.moe_aux_loss_weight,
+                    ),
+                    causal=True, use_flash=cfg.use_flash, mesh=cfg.mesh,
+                    name="h",
+                ),
+                x,
+                None,
+            )
+            moe_aux = jnp.sum(aux_per_layer)
+        else:
+            x, _ = nn.scan(
+                lambda mdl, c, _: (mdl(c, None, train=train), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(
+                DeepSpeedTransformerLayer(
+                    config=cfg.layer_config(), causal=True,
+                    use_flash=cfg.use_flash, mesh=cfg.mesh, name="h",
+                ),
+                x,
+                None,
+            )
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_f")(x)
-        return x, wte
+        return (x, wte) if moe_aux is None else (x, wte, moe_aux)
+
+    def _pipelined_stack(self, x, train):
+        """Run the layer stack as an SPMD GPipe pipeline over the mesh's
+        ``pipe`` axis (parallel/pipeline.py). Embeddings and the LM head
+        stay outside (pipe-replicated under GSPMD)."""
+        from ..config import constants as C
+        from ..ops.transformer import transformer_block_apply
+        from ..parallel.pipeline import gpipe_spmd
+
+        cfg = self.config
+        n_stages = cfg.pipeline_stages
+        layer_cfg = cfg.layer_config()
+        if cfg.mesh is None or dict(cfg.mesh.shape).get(C.PIPELINE_AXIS, 1) != n_stages:
+            raise ValueError(
+                f"pipeline_stages={n_stages} needs a mesh whose "
+                f"'{C.PIPELINE_AXIS}' axis has that size (got "
+                f"{None if cfg.mesh is None else dict(cfg.mesh.shape)})"
+            )
+        if cfg.n_layer % n_stages:
+            raise ValueError(
+                f"n_layer={cfg.n_layer} must divide into "
+                f"pipeline_stages={n_stages}"
+            )
+        layers_per_stage = cfg.n_layer // n_stages
+        n_micro = cfg.pipeline_microbatches or 2 * n_stages
+        b, s, H = x.shape
+        if b % n_micro:
+            raise ValueError(
+                f"batch {b} must divide into pipeline microbatches {n_micro}"
+            )
+
+        p = _StackedBlockParams(layer_cfg, cfg.n_layer, name="h")()
+        stacked = jax.tree_util.tree_map(
+            lambda a: a.reshape(n_stages, layers_per_stage, *a.shape[1:]), p
+        )
+        need_rng = train and cfg.dropout > 0
+        if need_rng:
+            seed = jax.random.randint(
+                self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max
+            )
+        else:
+            seed = jnp.int32(0)
+
+        x_mb = x.reshape(n_micro, b // n_micro, s, H)
+        dp = dict(cfg.mesh.shape).get(C.DATA_AXIS, 1)
+        if (b // n_micro) % dp == 0:
+            # keep each microbatch data-sharded (auto axis inside the
+            # pipeline's shard_map); smaller microbatches are left to GSPMD
+            x_mb = jax.lax.with_sharding_constraint(
+                x_mb,
+                jax.sharding.NamedSharding(
+                    cfg.mesh, P(None, C.DATA_AXIS, None, None)
+                ),
+            )
+
+        def stage_fn(local_p, h, t, extras):
+            stage = jax.lax.axis_index(C.PIPELINE_AXIS)
+            mb_idx = t - stage  # which microbatch this stage sees this tick
+
+            def one_layer(h, sl):
+                layer_p, li = sl
+                if need_rng:
+                    key = jax.random.PRNGKey(extras["seed"])
+                    key = jax.random.fold_in(key, mb_idx)
+                    key = jax.random.fold_in(key, stage * layers_per_stage + li)
+                else:
+                    key = None
+                y = transformer_block_apply(
+                    layer_cfg, layer_p, h, None,
+                    causal=True, use_flash=cfg.use_flash, mesh=None,
+                    train=train, dropout_rng=key,
+                )
+                return y, None
+
+            h, _ = jax.lax.scan(
+                one_layer, h, (local_p, jnp.arange(layers_per_stage))
+            )
+            return h
+
+        out = gpipe_spmd(
+            stage_fn, stacked, x_mb, cfg.mesh,
+            extras={"seed": seed},
+        )
+        return out.reshape(b, s, H)
 
 
 class GPT2LMHeadModel(nn.Module):
     """__call__(input_ids, labels) -> scalar next-token LM loss
-    (labels typically input_ids; the shift happens inside)."""
+    (labels typically input_ids; the shift happens inside).
+
+    With ``moe_experts > 0`` the return is the multi-output tuple
+    ``(lm_loss + aux, lm_loss, aux)`` — the engine trains on element 0 and
+    the router load-balancing loss stays observable via ``last_aux``."""
 
     config: GPT2Config
 
     @nn.compact
     def __call__(self, input_ids, labels=None, train: bool = True):
-        x, wte = GPT2Model(self.config, name="transformer")(input_ids, train=train)
+        out = GPT2Model(self.config, name="transformer")(input_ids, train=train)
+        x, wte = out[0], out[1]
+        moe_aux = out[2] if len(out) == 3 else None
         logits = x @ wte.T  # tied lm head
         if labels is None:
             return logits
         # next-token prediction: logits[:, :-1] vs labels[:, 1:]
-        return cross_entropy_ignore_index(logits[:, :-1], labels[:, 1:])
+        lm_loss = cross_entropy_ignore_index(logits[:, :-1], labels[:, 1:])
+        if moe_aux is None:
+            return lm_loss
+        return lm_loss + moe_aux, lm_loss, moe_aux
 
 
-def partition_specs(params, mp_axis=MODEL_AXIS):
+def partition_specs(params, mp_axis=MODEL_AXIS, pipeline=False):
     """Megatron-style tensor-parallel PartitionSpecs for a GPT2LMHeadModel
     param tree (same structure, PartitionSpec leaves).
 
@@ -160,22 +334,43 @@ def partition_specs(params, mp_axis=MODEL_AXIS):
     Row-parallel (shard input dim): attn out (attn_ow), mlp down (output_w).
     Embeddings: shard the vocab dim. Scanned layer params carry a leading
     ``layers`` axis, so dims below shift by one.
+
+    With ``pipeline=True`` the leading ``layers`` axis of the stacked layer
+    params shards over the mesh's ``pipe`` axis: layer L = stages * L/stage
+    splits into contiguous per-stage blocks, exactly the [P, L/P, ...]
+    reshape the pipelined stack performs (models/gpt2.py:_pipelined_stack),
+    so each pipe rank stores only its own stage's weights.
     """
+    from ..config.constants import PIPELINE_AXIS
+
+    lead = PIPELINE_AXIS if pipeline else None
 
     def spec_for(path, leaf):
         names = [getattr(k, "key", None) for k in path]
         nd = leaf.ndim
+        if any(n and n.startswith(("expert_", "gate_")) for n in names):
+            # MoE subtree: experts shard over the data axis (ops/moe.py)
+            from ..ops.moe import moe_leaf_spec
+
+            return moe_leaf_spec(names, leaf)
         if "wte" in names:
             return P(mp_axis, None)
         if "wpe" in names:
             return P()
         # scanned transformer params: leading 'layers' dim
         if "attn_qkvw" in names or "inter_w" in names:
-            return P(None, None, mp_axis) if nd == 3 else P(None, mp_axis)
+            return P(lead, None, mp_axis) if nd == 3 else P(None, mp_axis)
         if "attn_qkvb" in names or "inter_b" in names:
-            return P(None, mp_axis) if nd == 2 else P(mp_axis)
+            return P(lead, mp_axis) if nd == 2 else P(mp_axis)
         if "attn_ow" in names or "output_w" in names:
-            return P(None, mp_axis, None) if nd == 3 else P(mp_axis, None)
-        return P()  # biases of row-parallel, norms, ln_f: replicated
+            return P(lead, mp_axis, None) if nd == 3 else P(mp_axis, None)
+        if nd >= 1 and any(
+            n in names
+            for n in ("attn_ob", "attn_nw", "attn_nb", "output_b",
+                      "norm_w", "norm_b")
+        ):
+            # stacked per-layer vectors: shard the layers dim over pipe too
+            return P(lead, None) if nd == 2 else P(lead)
+        return P()  # ln_f etc.: replicated
 
     return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -1,6 +1,17 @@
 from .attention import attention, flash_attention, mha_reference
 from .optimizers import SGD, Adam, Lamb, Lion, Optimizer, build_optimizer
-from .transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
+from .moe import (
+    DeepSpeedMoETransformerLayer,
+    MoEConfig,
+    MoEMLP,
+    moe_partition_specs,
+    top_k_gating,
+)
+from .transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+    transformer_block_apply,
+)
 
 __all__ = [
     "attention",
@@ -14,4 +25,10 @@ __all__ = [
     "build_optimizer",
     "DeepSpeedTransformerConfig",
     "DeepSpeedTransformerLayer",
+    "transformer_block_apply",
+    "DeepSpeedMoETransformerLayer",
+    "MoEConfig",
+    "MoEMLP",
+    "moe_partition_specs",
+    "top_k_gating",
 ]

@@ -1,0 +1,246 @@
+"""Mixture-of-Experts with expert parallelism, the GSPMD way.
+
+Beyond-reference capability (v0.2.0 has no MoE; SURVEY §2.4 lists EP as
+absent). Built as the GShard/GSPMD einsum pattern rather than a port of
+torch all-to-all MoE: the router produces one-hot dispatch/combine
+tensors, token->expert movement is two einsums whose operands carry
+sharding constraints — experts sharded over the mesh's ``data`` axis (the
+standard expert=data layout), tokens sharded over the same axis on the
+group dim — and XLA inserts the all-to-alls over ICI. No hand-written
+collectives, and the whole layer stays differentiable/jit-friendly
+(static capacity, dropped-token semantics).
+
+Router: top-2 gating with the Switch/GShard load-balancing auxiliary loss
+(mean gate fraction x mean dispatch fraction x E), capacity
+``capacity_factor * S * K / E`` tokens per expert per group; overflow
+tokens fall through to the residual path (standard MoE semantics).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config.constants import DATA_AXIS
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class MoEConfig:
+    n_experts: int = 8
+    # top-k routing (1 = Switch, 2 = GShard default)
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    # weight of the load-balancing aux loss added via ``aux_loss`` output
+    aux_loss_weight: float = 1e-2
+    # experts shard over this mesh axis (expert parallelism); the
+    # conventional choice is the data axis — each dp rank hosts E/dp experts
+    expert_axis: str = DATA_AXIS
+
+
+def top_k_gating(logits, k, capacity):
+    """GShard-style top-k gating.
+
+    Args:
+      logits: [G, S, E] router logits (G token groups, S tokens, E experts).
+      k: how many experts per token.
+      capacity: max tokens per (group, expert).
+
+    Returns:
+      dispatch: [G, S, E, C] one-hot dispatch mask (0/1, float32).
+      combine: [G, S, E, C] combine weights (gate prob at the dispatched
+        slot, 0 elsewhere).
+      aux_loss: scalar load-balancing loss (mean_gates . mean_dispatch * E).
+    """
+    G, S, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [G,S,E]
+
+    # aux loss uses the FIRST choice's dispatch fraction (Switch eq. 4):
+    # E * sum_e(mean-gate_e * dispatch-fraction_e), averaged over groups;
+    # == 1 at perfect balance
+    top1 = jnp.argmax(gates, axis=-1)  # [G,S]
+    top1_1h = jax.nn.one_hot(top1, E, dtype=jnp.float32)
+    aux_loss = E * jnp.mean(
+        jnp.sum(jnp.mean(gates, axis=1) * jnp.mean(top1_1h, axis=1), axis=-1)
+    )
+
+    dispatch = jnp.zeros((G, S, E, capacity), jnp.float32)
+    combine = jnp.zeros((G, S, E, capacity), jnp.float32)
+    remaining = gates
+    # running per-expert fill count, carried across the k choices so the
+    # second choice respects slots taken by first choices
+    fill = jnp.zeros((G, E), jnp.int32)
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)  # [G,S]
+        choice_1h = jax.nn.one_hot(choice, E, dtype=jnp.float32)
+        gate_val = jnp.sum(remaining * choice_1h, axis=-1)  # [G,S]
+        # position of each token within its chosen expert's queue:
+        # tokens earlier in the group claim earlier slots
+        pos_in_expert = (
+            jnp.cumsum(choice_1h, axis=1) - choice_1h
+        )  # [G,S,E] count of same-expert tokens before this one
+        pos = jnp.einsum("gse,gse->gs", pos_in_expert, choice_1h)
+        pos = pos + jnp.take_along_axis(
+            fill.astype(jnp.float32), choice, axis=1
+        )
+        keep = pos < capacity  # dropped tokens fall through to residual
+        pos_1h = jax.nn.one_hot(
+            jnp.where(keep, pos, capacity).astype(jnp.int32),
+            capacity, dtype=jnp.float32,
+        )  # [G,S,C] (overflow maps past the last slot -> all-zero row)
+        d = choice_1h[..., None] * pos_1h[:, :, None, :]  # [G,S,E,C]
+        dispatch = dispatch + d
+        combine = combine + d * gate_val[..., None, None]
+        fill = fill + jnp.sum(
+            (choice_1h * keep[..., None]).astype(jnp.int32), axis=1
+        )
+        remaining = remaining * (1.0 - choice_1h)  # mask the chosen expert
+    return dispatch, combine, aux_loss
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel FFN: ``[G, S, M] -> [G, S, M]`` plus an aux loss.
+
+    Expert weights are stored stacked ``[E, M, I]``/``[E, I, M]`` and
+    sharded over ``cfg.expert_axis``; the dispatch/combine einsums carry
+    sharding constraints so GSPMD materializes the token all-to-all over
+    ICI (the einsum MoE of the GShard paper, TPU-native).
+    """
+
+    hidden: int
+    intermediate: int
+    cfg: MoEConfig
+    mesh: Optional[object] = None
+    initializer_range: float = 0.02
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        M, I, E = self.hidden, self.intermediate, cfg.n_experts
+        G, S, _ = x.shape
+        init = nn.initializers.normal(stddev=self.initializer_range)
+        wg = self.param("gate_w", init, (M, E), jnp.float32)
+        wi = self.param("expert_in_w", init, (E, M, I), x.dtype)
+        bi = self.param("expert_in_b", nn.initializers.zeros, (E, I), x.dtype)
+        wo = self.param("expert_out_w", init, (E, I, M), x.dtype)
+        bo = self.param("expert_out_b", nn.initializers.zeros, (E, M), x.dtype)
+
+        capacity = max(1, int(cfg.capacity_factor * S * cfg.top_k / E))
+        logits = x.astype(jnp.float32) @ wg  # router in fp32
+        dispatch, combine, aux = top_k_gating(logits, cfg.top_k, capacity)
+        dispatch = dispatch.astype(x.dtype)
+        combine = combine.astype(x.dtype)
+
+        def shard(t, spec):
+            if self.mesh is None:
+                return t
+            if dict(self.mesh.shape).get(cfg.expert_axis, 1) == 1:
+                return t
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(self.mesh, spec)
+            )
+
+        # tokens -> expert queues: [G,S,E,C] x [G,S,M] -> [E,G,C,M]
+        expert_in = jnp.einsum("gsec,gsm->egcm", dispatch, x)
+        expert_in = shard(expert_in, P(cfg.expert_axis))
+        h = jnp.einsum("egcm,emi->egci", expert_in, wi) + bi[:, None, None, :]
+        h = nn.gelu(h, approximate=True)
+        out = jnp.einsum("egci,eim->egcm", h, wo) + bo[:, None, None, :]
+        out = shard(out, P(cfg.expert_axis))
+        # expert queues -> tokens (weighted by gate prob; dropped tokens
+        # receive zeros and ride the residual connection)
+        y = jnp.einsum("gsec,egcm->gsm", combine, out)
+        return y, cfg.aux_loss_weight * aux
+
+
+def moe_leaf_spec(names, leaf, expert_axis=DATA_AXIS):
+    """PartitionSpec for one MoE param leaf (by its path names):
+    expert-stacked weights shard their E axis over ``expert_axis`` (dim 0
+    standalone, dim 1 under a scanned stack's leading ``layers`` axis);
+    the router gate is replicated."""
+    if any(n and n.startswith("expert_") for n in names):
+        base_nd = 3 if any(
+            n in ("expert_in_w", "expert_out_w") for n in names
+        ) else 2
+        if leaf.ndim == base_nd:  # [E, ...]
+            return P(expert_axis, *([None] * (leaf.ndim - 1)))
+        # scanned: [L, E, ...]
+        return P(None, expert_axis, *([None] * (leaf.ndim - 2)))
+    return P()
+
+
+def moe_partition_specs(params, expert_axis=DATA_AXIS):
+    """PartitionSpecs for a param tree containing MoEMLP subtrees; non-MoE
+    params come back replicated."""
+
+    def spec_for(path, leaf):
+        names = [getattr(k, "key", None) for k in path]
+        return moe_leaf_spec(names, leaf, expert_axis)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+class DeepSpeedMoETransformerLayer(nn.Module):
+    """Transformer block whose FFN sublayer is an expert-parallel MoE.
+
+    Attention sublayer, LN order, dropout and residual structure are the
+    fused layer's (ops/transformer.py:transformer_block_apply with
+    ``ffn_fn`` swapped); returns ``(hidden, aux_loss)`` — callers (the
+    GPT-2 MoE stack) accumulate the router losses into the objective.
+    """
+
+    config: object  # DeepSpeedTransformerConfig
+    moe: MoEConfig
+    causal: bool = False
+    use_flash: bool = True
+    mesh: Optional[object] = None
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None, train: bool = True):
+        from .transformer import transformer_block_apply
+
+        cfg = self.config
+        if cfg.use_remat:
+            # raw jax.checkpoint around a closure that calls a flax
+            # submodule (the MoE) would re-enter module scopes; use
+            # nn.remat at the stack level instead if needed
+            raise ValueError(
+                "DeepSpeedMoETransformerLayer does not support the layer "
+                "memory modes; leave remat flags off for MoE layers"
+            )
+        H = cfg.hidden_size
+        dtype = hidden_states.dtype
+        init = nn.initializers.normal(stddev=cfg.initializer_range)
+        # attention + norm params only; the FFN params live in the MoE
+        p = {
+            "attn_qkvw": self.param("attn_qkvw", init, (H, 3 * H), dtype),
+            "attn_qkvb": self.param(
+                "attn_qkvb", nn.initializers.zeros, (3 * H,), dtype),
+            "attn_ow": self.param("attn_ow", init, (H, H), dtype),
+            "attn_ob": self.param(
+                "attn_ob", nn.initializers.zeros, (H,), dtype),
+            "attn_nw": self.param(
+                "attn_nw", nn.initializers.ones, (H,), jnp.float32),
+            "attn_nb": self.param(
+                "attn_nb", nn.initializers.zeros, (H,), jnp.float32),
+            "norm_w": self.param(
+                "norm_w", nn.initializers.ones, (H,), jnp.float32),
+            "norm_b": self.param(
+                "norm_b", nn.initializers.zeros, (H,), jnp.float32),
+        }
+        moe = MoEMLP(
+            hidden=H, intermediate=cfg.intermediate, cfg=self.moe,
+            mesh=self.mesh, initializer_range=cfg.initializer_range,
+            name="moe",
+        )
+        need_rng = train and (
+            cfg.attn_dropout_ratio > 0 or cfg.hidden_dropout_ratio > 0
+        )
+        rng = self.make_rng("dropout") if need_rng else None
+        return transformer_block_apply(
+            cfg, p, hidden_states, attention_mask,
+            causal=self.causal, use_flash=self.use_flash, mesh=self.mesh,
+            train=train, dropout_rng=rng, ffn_fn=moe,
+        )

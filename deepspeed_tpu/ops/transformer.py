@@ -118,6 +118,166 @@ def resolve_remat_policy(spec: str):
     return _ft.reduce(jax.checkpoint_policies.save_from_both_policies, policies)
 
 
+#: The reference's 12-tensor parameter layout (deepspeed_cuda.py:393-520).
+#: shapes as functions of (H, intermediate I); norms are always fp32.
+TRANSFORMER_PARAM_LAYOUT = (
+    ("attn_qkvw", ("H", "3H"), "init"),
+    ("attn_qkvb", ("3H",), "zeros"),
+    ("attn_ow", ("H", "H"), "init"),
+    ("attn_ob", ("H",), "zeros"),
+    ("attn_nw", ("H",), "ones32"),
+    ("attn_nb", ("H",), "zeros32"),
+    ("inter_w", ("H", "I"), "init"),
+    ("inter_b", ("I",), "zeros"),
+    ("output_w", ("I", "H"), "init"),
+    ("output_b", ("H",), "zeros"),
+    ("norm_w", ("H",), "ones32"),
+    ("norm_b", ("H",), "zeros32"),
+)
+
+
+def transformer_block_apply(
+    cfg: DeepSpeedTransformerConfig,
+    p: dict,
+    hidden_states,
+    attention_mask=None,
+    *,
+    causal=False,
+    use_flash=True,
+    mesh=None,
+    seq_parallel_impl="auto",
+    train=True,
+    dropout_rng=None,
+    ffn_fn=None,
+):
+    """Pure-function transformer block over the 12-tensor param dict ``p``
+    (keys per TRANSFORMER_PARAM_LAYOUT). Shared by the flax layer module
+    (which creates the params) and the pipeline-parallel stack (which
+    slices them from a pipe-sharded stack). Applies the config's remat
+    policy itself.
+
+    ``ffn_fn``: optional replacement for the dense FFN sublayer —
+    ``ffn_fn(ff_in) -> h`` or ``-> (h, aux)`` (pre-residual, pre-dropout).
+    Used by the MoE layer (ops/moe.py) to swap in an expert-parallel FFN
+    while keeping the attention sublayer and LN/dropout/residual
+    structure; when it returns an aux value (the router's load-balancing
+    loss) this function returns ``(out, aux)``."""
+    H = cfg.hidden_size
+    heads = cfg.heads
+    head_dim = H // heads
+    assert head_dim * heads == H, "hidden_size must divide heads"
+
+    # All RNG keys are drawn BEFORE the (optionally remat'd) block so the
+    # closure is a pure array function — safe under jax.checkpoint, and
+    # recompute regenerates identical dropout masks (the semantics the
+    # reference gets from its saved byte masks / RNG tracker).
+    need_rng = train and dropout_rng is not None and (
+        cfg.attn_dropout_ratio > 0 or cfg.hidden_dropout_ratio > 0
+    )
+    if need_rng:
+        attn_rng, h1_rng, h2_rng = jax.random.split(dropout_rng, 3)
+    else:
+        attn_rng = h1_rng = h2_rng = None
+
+    def hid_dropout(x, drop_rng):
+        rate = cfg.hidden_dropout_ratio
+        if not train or rate <= 0 or drop_rng is None:
+            return x
+        keep = jax.random.bernoulli(drop_rng, 1.0 - rate, x.shape)
+        return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+    def layer_norm(x, scale, bias):
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps)
+        return (y * scale + bias).astype(x.dtype)
+
+    def block(x):
+        b, s, _ = x.shape
+        # ---- attention sublayer -----------------------------------
+        residual = x
+        attn_in = (
+            layer_norm(x, p["attn_nw"], p["attn_nb"])
+            if cfg.pre_layer_norm else x
+        )
+        qkv = attn_in @ p["attn_qkvw"] + p["attn_qkvb"]
+        q, k_, v = jnp.split(qkv, 3, axis=-1)
+        # [B,S,H] -> [B,heads,S,hd]  (the reference's
+        # bias_add_transform_0213, transform_kernels.cu:149)
+        def split_heads(t):
+            return t.reshape(b, s, heads, head_dim).transpose(0, 2, 1, 3)
+
+        from ..config import constants as C
+
+        seq_parallel = (
+            mesh is not None
+            and dict(mesh.shape).get(C.SEQUENCE_AXIS, 1) > 1
+        )
+        if seq_parallel:
+            from ..parallel.sequence import sequence_parallel_attention
+
+            kv_valid = additive_mask_to_kv_valid(attention_mask)
+            if attention_mask is not None and kv_valid is None:
+                raise ValueError(
+                    "sequence-parallel attention supports padding-style "
+                    "masks only (broadcast over the query dim)"
+                )
+            ctx = sequence_parallel_attention(
+                split_heads(q), split_heads(k_), split_heads(v),
+                mesh, kv_valid, impl=seq_parallel_impl,
+                use_flash=use_flash, causal=causal,
+                dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
+                dropout_rng=attn_rng,
+            )
+        else:
+            # with a dp/mp mesh the dispatcher runs flash per-shard via
+            # shard_map instead of falling back to O(S^2) attention
+            ctx = attention(
+                split_heads(q), split_heads(k_), split_heads(v),
+                mask=attention_mask, causal=causal,
+                dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
+                dropout_rng=attn_rng, use_flash=use_flash,
+                mesh=mesh,
+            )
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, H)  # transform4d_0213
+        attn_out = ctx @ p["attn_ow"] + p["attn_ob"]
+        attn_out = hid_dropout(attn_out, h1_rng)
+        x = residual + attn_out
+        if not cfg.pre_layer_norm:
+            x = layer_norm(x, p["attn_nw"], p["attn_nb"])
+
+        # ---- feed-forward sublayer --------------------------------
+        residual = x
+        ff_in = (
+            layer_norm(x, p["norm_w"], p["norm_b"])
+            if cfg.pre_layer_norm else x
+        )
+        ffn_aux = None
+        if ffn_fn is not None:
+            h = ffn_fn(ff_in)
+            if isinstance(h, tuple):
+                h, ffn_aux = h
+        else:
+            h = ff_in @ p["inter_w"] + p["inter_b"]
+            h = nn.gelu(h, approximate=True)  # tanh-approx gelu, gelu_kernels.cu:38
+            h = h @ p["output_w"] + p["output_b"]
+        h = hid_dropout(h, h2_rng)
+        x = residual + h
+        if not cfg.pre_layer_norm:
+            x = layer_norm(x, p["norm_w"], p["norm_b"])
+        return x if ffn_aux is None else (x, ffn_aux)
+
+    if cfg.use_remat:
+        if cfg.remat_policy == "full":
+            block = jax.checkpoint(block)
+        else:
+            block = jax.checkpoint(
+                block, policy=resolve_remat_policy(cfg.remat_policy)
+            )
+    return block(hidden_states)
+
+
 class DeepSpeedTransformerLayer(nn.Module):
     """One transformer block. __call__(hidden [B,S,H], attention_mask
     additive [B,1,1,S] or None) -> [B,S,H]."""
@@ -136,121 +296,30 @@ class DeepSpeedTransformerLayer(nn.Module):
     def __call__(self, hidden_states, attention_mask=None, train: bool = True):
         cfg = self.config
         H = cfg.hidden_size
-        heads = cfg.heads
-        head_dim = H // heads
-        assert head_dim * heads == H, "hidden_size must divide heads"
         dtype = hidden_states.dtype
         init = nn.initializers.normal(stddev=cfg.initializer_range)
+        shapes = {"H": H, "3H": 3 * H, "I": cfg.intermediate}
+        makers = {
+            "init": (init, dtype),
+            "zeros": (nn.initializers.zeros, dtype),
+            "ones32": (nn.initializers.ones, jnp.float32),
+            "zeros32": (nn.initializers.zeros, jnp.float32),
+        }
+        p = {
+            name: self.param(
+                name, makers[kind][0],
+                tuple(shapes[d] for d in dims), makers[kind][1],
+            )
+            for name, dims, kind in TRANSFORMER_PARAM_LAYOUT
+        }
 
-        # 12-parameter layout matching the reference's naming
-        attn_qkvw = self.param("attn_qkvw", init, (H, 3 * H), dtype)
-        attn_qkvb = self.param("attn_qkvb", nn.initializers.zeros, (3 * H,), dtype)
-        attn_ow = self.param("attn_ow", init, (H, H), dtype)
-        attn_ob = self.param("attn_ob", nn.initializers.zeros, (H,), dtype)
-        attn_nw = self.param("attn_nw", nn.initializers.ones, (H,), jnp.float32)
-        attn_nb = self.param("attn_nb", nn.initializers.zeros, (H,), jnp.float32)
-        inter_w = self.param("inter_w", init, (H, cfg.intermediate), dtype)
-        inter_b = self.param("inter_b", nn.initializers.zeros, (cfg.intermediate,), dtype)
-        output_w = self.param("output_w", init, (cfg.intermediate, H), dtype)
-        output_b = self.param("output_b", nn.initializers.zeros, (H,), dtype)
-        norm_w = self.param("norm_w", nn.initializers.ones, (H,), jnp.float32)
-        norm_b = self.param("norm_b", nn.initializers.zeros, (H,), jnp.float32)
-
-        # All RNG keys are drawn BEFORE the (optionally remat'd) block so the
-        # closure is a pure array function — safe under jax.checkpoint, and
-        # recompute regenerates identical dropout masks (the semantics the
-        # reference gets from its saved byte masks / RNG tracker).
         need_rng = train and (
             cfg.attn_dropout_ratio > 0 or cfg.hidden_dropout_ratio > 0
         )
-        if need_rng:
-            rng = self.make_rng("dropout")
-            attn_rng, h1_rng, h2_rng = jax.random.split(rng, 3)
-        else:
-            attn_rng = h1_rng = h2_rng = None
-
-        def hid_dropout(x, drop_rng):
-            rate = cfg.hidden_dropout_ratio
-            if not train or rate <= 0 or drop_rng is None:
-                return x
-            keep = jax.random.bernoulli(drop_rng, 1.0 - rate, x.shape)
-            return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
-
-        def layer_norm(x, scale, bias):
-            x32 = x.astype(jnp.float32)
-            mean = jnp.mean(x32, axis=-1, keepdims=True)
-            var = jnp.var(x32, axis=-1, keepdims=True)
-            y = (x32 - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps)
-            return (y * scale + bias).astype(x.dtype)
-
-        def block(x):
-            b, s, _ = x.shape
-            # ---- attention sublayer -----------------------------------
-            residual = x
-            attn_in = layer_norm(x, attn_nw, attn_nb) if cfg.pre_layer_norm else x
-            qkv = attn_in @ attn_qkvw + attn_qkvb
-            q, k_, v = jnp.split(qkv, 3, axis=-1)
-            # [B,S,H] -> [B,heads,S,hd]  (the reference's
-            # bias_add_transform_0213, transform_kernels.cu:149)
-            def split_heads(t):
-                return t.reshape(b, s, heads, head_dim).transpose(0, 2, 1, 3)
-
-            from ..config import constants as C
-
-            seq_parallel = (
-                self.mesh is not None
-                and dict(self.mesh.shape).get(C.SEQUENCE_AXIS, 1) > 1
-            )
-            if seq_parallel:
-                from ..parallel.sequence import sequence_parallel_attention
-
-                kv_valid = additive_mask_to_kv_valid(attention_mask)
-                if attention_mask is not None and kv_valid is None:
-                    raise ValueError(
-                        "sequence-parallel attention supports padding-style "
-                        "masks only (broadcast over the query dim)"
-                    )
-                ctx = sequence_parallel_attention(
-                    split_heads(q), split_heads(k_), split_heads(v),
-                    self.mesh, kv_valid, impl=self.seq_parallel_impl,
-                    use_flash=self.use_flash, causal=self.causal,
-                    dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
-                    dropout_rng=attn_rng,
-                )
-            else:
-                # with a dp/mp mesh the dispatcher runs flash per-shard via
-                # shard_map instead of falling back to O(S^2) attention
-                ctx = attention(
-                    split_heads(q), split_heads(k_), split_heads(v),
-                    mask=attention_mask, causal=self.causal,
-                    dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
-                    dropout_rng=attn_rng, use_flash=self.use_flash,
-                    mesh=self.mesh,
-                )
-            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, H)  # transform4d_0213
-            attn_out = ctx @ attn_ow + attn_ob
-            attn_out = hid_dropout(attn_out, h1_rng)
-            x = residual + attn_out
-            if not cfg.pre_layer_norm:
-                x = layer_norm(x, attn_nw, attn_nb)
-
-            # ---- feed-forward sublayer --------------------------------
-            residual = x
-            ff_in = layer_norm(x, norm_w, norm_b) if cfg.pre_layer_norm else x
-            h = ff_in @ inter_w + inter_b
-            h = nn.gelu(h, approximate=True)  # tanh-approx gelu, gelu_kernels.cu:38
-            h = h @ output_w + output_b
-            h = hid_dropout(h, h2_rng)
-            x = residual + h
-            if not cfg.pre_layer_norm:
-                x = layer_norm(x, norm_w, norm_b)
-            return x
-
-        if cfg.use_remat:
-            if cfg.remat_policy == "full":
-                block = jax.checkpoint(block)
-            else:
-                block = jax.checkpoint(
-                    block, policy=resolve_remat_policy(cfg.remat_policy)
-                )
-        return block(hidden_states)
+        rng = self.make_rng("dropout") if need_rng else None
+        return transformer_block_apply(
+            cfg, p, hidden_states, attention_mask,
+            causal=self.causal, use_flash=self.use_flash, mesh=self.mesh,
+            seq_parallel_impl=self.seq_parallel_impl, train=train,
+            dropout_rng=rng,
+        )

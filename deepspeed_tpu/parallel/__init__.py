@@ -1,8 +1,10 @@
-"""Parallelism: device mesh topology, mpu protocol, sequence parallelism.
+"""Parallelism: device mesh topology, mpu protocol, sequence parallelism,
+pipeline parallelism.
 
 The mesh replaces the reference's NCCL process groups (SURVEY.md §2.4);
-``sequence`` adds ring attention / Ulysses all-to-all context parallelism,
-which the reference lacks entirely.
+``sequence`` adds ring attention / Ulysses all-to-all context parallelism
+and ``pipeline`` an SPMD GPipe schedule over the ``pipe`` axis — both
+beyond the reference, which has neither.
 """
 
 from .mesh import (
@@ -17,6 +19,7 @@ from .mesh import (
     resolve_topology,
 )
 from .mpu import ExternalMpuAdapter, TPUMpu, as_mpu
+from .pipeline import gpipe_spmd, pipeline_stages
 from .sequence import (
     ring_attention,
     ring_attention_local,
@@ -38,6 +41,8 @@ __all__ = [
     "ExternalMpuAdapter",
     "TPUMpu",
     "as_mpu",
+    "gpipe_spmd",
+    "pipeline_stages",
     "ring_attention",
     "ring_attention_local",
     "sequence_parallel_attention",

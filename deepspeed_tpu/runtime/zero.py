@@ -45,6 +45,14 @@ def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=Non
     existing = existing + (None,) * (len(shape) - len(existing))
     if dp_size <= 1:
         return PartitionSpec(*existing) if existing_spec is not None else PartitionSpec()
+    if any(
+        axis_name == e or (isinstance(e, tuple) and axis_name in e)
+        for e in existing
+    ):
+        # already sharded over this axis (e.g. MoE expert weights over the
+        # data axis): a spec may not repeat a mesh axis — the leaf is
+        # already dp_size-way partitioned, which is what ZeRO wants
+        return PartitionSpec(*existing)
     best_dim, best_size = None, 0
     for i, d in enumerate(shape):
         if existing[i] is not None:

@@ -27,7 +27,7 @@ SEQ = 64
 RTOL = 1e-2  # reference uses 0.01 on LM loss (run_func_test.py)
 
 
-def _cfg(mesh=None):
+def _cfg(mesh=None, pp=1):
     return GPT2Config(
         vocab_size=512,
         n_positions=SEQ,
@@ -36,6 +36,8 @@ def _cfg(mesh=None):
         n_head=4,
         dropout=0.0,  # parity runs compare exact trajectories
         mesh=mesh,
+        pipeline_stages=pp,
+        pipeline_microbatches=2 * pp if pp > 1 else 0,
     )
 
 
@@ -49,19 +51,22 @@ def _data():
     return [fixed[i % 2] for i in range(STEPS)]
 
 
-def _train(mesh, zero_stage, use_mp=False):
-    cfg = _cfg(mesh=mesh)
+def _train(mesh, zero_stage, use_mp=False, pp=1):
+    cfg = _cfg(mesh=mesh, pp=pp)
     model = GPT2LMHeadModel(cfg)
     ids0 = jax.numpy.asarray(_data()[0])
     params = model.init(
         {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
         ids0, ids0,
     )["params"]
+    specs = None
+    if use_mp or pp > 1:
+        specs = partition_specs(params, pipeline=pp > 1)
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         model_parameters=params,
         mesh=mesh,
-        param_specs=partition_specs(params) if use_mp else None,
+        param_specs=specs,
         config_params={
             "train_batch_size": BATCH,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
@@ -90,10 +95,11 @@ def baseline_losses():
 
 
 PARALLEL_LAYOUTS = {
-    "zero1_dp8": dict(dp=8, mp=1, sp=1, stage=1),
-    "zero2_dp8": dict(dp=8, mp=1, sp=1, stage=2),
-    "zero2_dp4_mp2": dict(dp=4, mp=2, sp=1, stage=2),
-    "zero2_dp4_sp2": dict(dp=4, mp=1, sp=2, stage=2),
+    "zero1_dp8": dict(dp=8, mp=1, sp=1, pp=1, stage=1),
+    "zero2_dp8": dict(dp=8, mp=1, sp=1, pp=1, stage=2),
+    "zero2_dp4_mp2": dict(dp=4, mp=2, sp=1, pp=1, stage=2),
+    "zero2_dp4_sp2": dict(dp=4, mp=1, sp=2, pp=1, stage=2),
+    "zero2_dp4_pp2": dict(dp=4, mp=1, sp=1, pp=2, stage=2),
 }
 
 
@@ -104,8 +110,11 @@ def test_parallel_layout_matches_baseline(name, baseline_losses):
         data_parallel_size=lay["dp"],
         model_parallel_size=lay["mp"],
         sequence_parallel_size=lay["sp"],
+        pipeline_parallel_size=lay["pp"],
     )
-    losses = _train(mesh, zero_stage=lay["stage"], use_mp=lay["mp"] > 1)
+    losses = _train(
+        mesh, zero_stage=lay["stage"], use_mp=lay["mp"] > 1, pp=lay["pp"]
+    )
     np.testing.assert_allclose(
         losses, baseline_losses, rtol=RTOL,
         err_msg=f"{name} diverged from the single-device baseline",
