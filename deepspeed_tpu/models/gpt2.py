@@ -233,6 +233,14 @@ class GPT2Model(nn.Module):
                 f"'{C.PIPELINE_AXIS}' axis has that size (got "
                 f"{None if cfg.mesh is None else dict(cfg.mesh.shape)})"
             )
+        if dict(cfg.mesh.shape).get(C.SEQUENCE_AXIS, 1) > 1:
+            # attention inside the pipeline runs with mesh=None — a >1
+            # sequence axis would be silently ignored (replicated work),
+            # so reject the combination instead
+            raise ValueError(
+                "pipeline_stages > 1 does not compose with a >1 sequence "
+                "axis yet; use sp or pp for the stack, not both"
+            )
         if cfg.n_layer % n_stages:
             raise ValueError(
                 f"n_layer={cfg.n_layer} must divide into "

@@ -210,25 +210,27 @@ class DeepSpeedMoETransformerLayer(nn.Module):
                 "DeepSpeedMoETransformerLayer does not support the layer "
                 "memory modes; leave remat flags off for MoE layers"
             )
+        from .transformer import TRANSFORMER_PARAM_LAYOUT
+
         H = cfg.hidden_size
         dtype = hidden_states.dtype
         init = nn.initializers.normal(stddev=cfg.initializer_range)
-        # attention + norm params only; the FFN params live in the MoE
+        # attention + norm params from the shared layout; the FFN entries
+        # (inter_*/output_*) are replaced by the MoE's expert weights
+        shapes = {"H": H, "3H": 3 * H, "I": cfg.intermediate}
+        makers = {
+            "init": (init, dtype),
+            "zeros": (nn.initializers.zeros, dtype),
+            "ones32": (nn.initializers.ones, jnp.float32),
+            "zeros32": (nn.initializers.zeros, jnp.float32),
+        }
         p = {
-            "attn_qkvw": self.param("attn_qkvw", init, (H, 3 * H), dtype),
-            "attn_qkvb": self.param(
-                "attn_qkvb", nn.initializers.zeros, (3 * H,), dtype),
-            "attn_ow": self.param("attn_ow", init, (H, H), dtype),
-            "attn_ob": self.param(
-                "attn_ob", nn.initializers.zeros, (H,), dtype),
-            "attn_nw": self.param(
-                "attn_nw", nn.initializers.ones, (H,), jnp.float32),
-            "attn_nb": self.param(
-                "attn_nb", nn.initializers.zeros, (H,), jnp.float32),
-            "norm_w": self.param(
-                "norm_w", nn.initializers.ones, (H,), jnp.float32),
-            "norm_b": self.param(
-                "norm_b", nn.initializers.zeros, (H,), jnp.float32),
+            name: self.param(
+                name, makers[kind][0],
+                tuple(shapes[d] for d in dims), makers[kind][1],
+            )
+            for name, dims, kind in TRANSFORMER_PARAM_LAYOUT
+            if not name.startswith(("inter_", "output_"))
         }
         moe = MoEMLP(
             hidden=H, intermediate=cfg.intermediate, cfg=self.moe,

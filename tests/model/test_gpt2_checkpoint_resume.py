@@ -128,6 +128,49 @@ def test_same_layout_resume_continues_trajectory(
     )
 
 
+def test_cross_stack_resume_scanned_to_pipelined(
+    saved_checkpoint, unbroken_losses
+):
+    """The pipelined stack's param tree is identical to the scanned one, so
+    a checkpoint saved under dp=8 (scanned) resumes under pipe=2 x dp=4
+    (GPipe) and continues the trajectory — elastic across parallelism
+    STRATEGIES, not just sizes."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.models import partition_specs as pspecs
+
+    mesh = build_mesh(data_parallel_size=4, pipeline_parallel_size=2)
+    cfg = dataclasses.replace(
+        _cfg(mesh=mesh), pipeline_stages=2, pipeline_microbatches=4
+    )
+    model = GPT2LMHeadModel(cfg)
+    ids0 = jax.numpy.asarray(_data(1)[0])
+    params = model.init(
+        {"params": jax.random.PRNGKey(9)}, ids0, ids0, train=False
+    )["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        mesh=mesh,
+        param_specs=pspecs(params, pipeline=True),
+        config_params={
+            "train_batch_size": BATCH,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 10_000,
+        },
+        rng_seed=0,
+    )
+    path, _ = engine.load_checkpoint(saved_checkpoint, tag="mid")
+    assert path is not None
+    assert engine.global_steps == STEPS_BEFORE
+    losses = _run(engine, _data(STEPS_AFTER, offset=STEPS_BEFORE))
+    np.testing.assert_allclose(
+        losses, unbroken_losses[STEPS_BEFORE:], rtol=RTOL,
+        err_msg="scanned->pipelined resume diverged from the unbroken run",
+    )
+
+
 def test_elastic_resume_dp8_to_dp4_mp2(saved_checkpoint, unbroken_losses):
     mesh = build_mesh(data_parallel_size=4, model_parallel_size=2)
     engine = _make_engine(mesh, use_mp=True, init_seed=7)

@@ -61,6 +61,8 @@ def _train(mesh, zero_stage, use_mp=False, pp=1):
     )["params"]
     specs = None
     if use_mp or pp > 1:
+        # mp sharding of layer weights stays active inside the pipeline's
+        # shard_map (model is an auto axis there)
         specs = partition_specs(params, pipeline=pp > 1)
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
@@ -100,6 +102,7 @@ PARALLEL_LAYOUTS = {
     "zero2_dp4_mp2": dict(dp=4, mp=2, sp=1, pp=1, stage=2),
     "zero2_dp4_sp2": dict(dp=4, mp=1, sp=2, pp=1, stage=2),
     "zero2_dp4_pp2": dict(dp=4, mp=1, sp=1, pp=2, stage=2),
+    "zero2_dp2_mp2_pp2": dict(dp=2, mp=2, sp=1, pp=2, stage=2),
 }
 
 

@@ -234,3 +234,15 @@ def test_gpt2_pipeline_validation_errors():
         GPT2LMHeadModel(bad3).init(
             {"params": jax.random.PRNGKey(0)}, ids, ids, train=False
         )
+    # pp x sp would silently replicate attention across sequence ranks
+    mesh_sp = build_mesh(
+        data_parallel_size=2, sequence_parallel_size=2,
+        pipeline_parallel_size=2,
+    )
+    bad4 = GPT2Config(
+        **BASE, mesh=mesh_sp, pipeline_stages=2, pipeline_microbatches=4
+    )
+    with pytest.raises(ValueError, match="sequence"):
+        GPT2LMHeadModel(bad4).init(
+            {"params": jax.random.PRNGKey(0)}, ids, ids, train=False
+        )
