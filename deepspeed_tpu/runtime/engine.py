@@ -844,10 +844,59 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------
     def _place_leaf(self, x, batch_axis):
-        """device_put one batch leaf: the batch dim shards over data, the
+        """Place one batch leaf: the batch dim shards over data, the
         following (token) dim over sequence when sizes divide; anything that
-        doesn't fit the mesh is replicated."""
+        doesn't fit the mesh is replicated.
+
+        Single-process: plain device_put. Multi-process (a pod): ``x`` is
+        this HOST'S slice of the batch (the reference's DistributedSampler
+        contract — each rank loads its own rows, deepspeed_dataloader.py:
+        10-78) and the global array is assembled from the per-process
+        slices without any cross-host transfer."""
         from jax.sharding import NamedSharding, PartitionSpec
+
+        pcount = jax.process_count()
+        if pcount > 1:
+            x = np.asarray(x)
+            if x.ndim <= batch_axis:
+                # batch-dim-less leaf (scalar config value etc.): hosts are
+                # expected to pass the same value; replicate it
+                return jax.make_array_from_process_local_data(
+                    mesh_lib.replicated(self._mesh), x
+                )
+            global_rows = x.shape[batch_axis] * pcount
+            if global_rows % self.dp_world_size != 0:
+                # a host-distinct slice cannot be replicated (ranks would
+                # silently hold different data for the "same" array)
+                raise ValueError(
+                    f"per-host batch of {x.shape[batch_axis]} rows x "
+                    f"{pcount} processes = {global_rows} global rows does "
+                    f"not divide dp_world_size={self.dp_world_size}; size "
+                    "the per-host batch so the global batch shards evenly"
+                )
+            spec = [None] * x.ndim
+            spec[batch_axis] = mesh_lib.DATA_AXIS
+            sp = dict(self._mesh.shape).get(mesh_lib.SEQ_AXIS, 1)
+            if (
+                sp > 1
+                and x.ndim > batch_axis + 1
+                and x.shape[batch_axis + 1] % sp == 0
+            ):
+                # mirror the single-process seq sharding when the sequence
+                # shards are host-local (the local slice then matches the
+                # process's shard extents); spanning hosts falls back to a
+                # data-only spec and XLA reshards
+                seq_spec = list(spec)
+                seq_spec[batch_axis + 1] = mesh_lib.SEQ_AXIS
+                try:
+                    return jax.make_array_from_process_local_data(
+                        NamedSharding(self._mesh, PartitionSpec(*seq_spec)), x
+                    )
+                except ValueError:
+                    pass
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self._mesh, PartitionSpec(*spec)), x
+            )
 
         sp = dict(self._mesh.shape).get(mesh_lib.SEQ_AXIS, 1)
         spec = [None] * x.ndim
