@@ -484,25 +484,41 @@ def main():
     # "bert" | "bert512" | "squad" | "gpt2" | unset (= run everything)
     only = os.environ.get("BENCH_ONLY")
 
-    bert = bench_bert() if only in (None, "bert") else None
-    bert512 = bench_bert_seq512() if only in (None, "bert512") else None
-    squad = bench_squad() if only in (None, "squad") else None
-    gpt2 = bench_gpt2() if only in (None, "gpt2") else None
+    results = {"bert": None, "bert_seq512": None, "squad": None, "gpt2": None}
 
-    primary = bert or gpt2 or bert512 or squad
-    if primary is None:
+    def emit():
+        """Print the best-so-far JSON after EVERY section: if the driver
+        kills the run mid-way, the last line still carries a result."""
+        primary = (
+            results["bert"] or results["gpt2"] or results["bert_seq512"]
+            or results["squad"]
+        )
+        if primary is None:
+            return
+        print(json.dumps({
+            "metric": primary["metric"],
+            "value": primary["value"],
+            "unit": primary["unit"],
+            "vs_baseline": primary["vs_baseline"],
+            "extras": dict(results),
+        }), flush=True)
+
+    if only in (None, "bert"):
+        results["bert"] = bench_bert()
+        emit()
+    if only in (None, "bert512"):
+        results["bert_seq512"] = bench_bert_seq512()
+        emit()
+    if only in (None, "squad"):
+        results["squad"] = bench_squad()
+        emit()
+    if only in (None, "gpt2"):
+        results["gpt2"] = bench_gpt2()
+        emit()
+
+    if all(v is None for v in results.values()):
         log("FATAL: no benchmark produced a number")
         sys.exit(1)
-    out = {
-        "metric": primary["metric"],
-        "value": primary["value"],
-        "unit": primary["unit"],
-        "vs_baseline": primary["vs_baseline"],
-        "extras": {
-            "bert": bert, "bert_seq512": bert512, "squad": squad, "gpt2": gpt2,
-        },
-    }
-    print(json.dumps(out))
 
 
 if __name__ == "__main__":

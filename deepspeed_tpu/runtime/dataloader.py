@@ -102,8 +102,26 @@ class DeepSpeedDataLoader:
             # per batch (gather_rows needs C-contiguous input)
             arrays = [np.ascontiguousarray(a) for a in self.dataset]
 
+        # multi-host pods: every host computes the SAME global order (the
+        # shuffle is bit-stable), then loads only its own contiguous slice
+        # of each batch — the reference's DistributedSampler contract
+        # (deepspeed_dataloader.py:10-78); _place reassembles the global
+        # array from the per-process slices without cross-host copies.
+        import jax
+
+        pcount = jax.process_count()
+        if pcount > 1 and self.batch_size % pcount != 0:
+            raise ValueError(
+                f"batch_size={self.batch_size} must divide across "
+                f"{pcount} processes"
+            )
+        rank = jax.process_index()
+        per_host = self.batch_size // pcount
+
         def assemble(b):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+            if pcount > 1:
+                idx = idx[rank * per_host : (rank + 1) * per_host]
             if self._mode == "arrays":
                 return tuple(
                     host_ops.gather_rows(a, idx) if a.ndim >= 1 else a
@@ -169,10 +187,20 @@ class DeepSpeedDataLoader:
 
         sharding = mesh_lib.data_sharding(self.mesh)
         replicated = mesh_lib.replicated(self.mesh)
+        pcount = jax.process_count()
 
         def put(x):
             x = np.asarray(x)
             dp = self.mesh.shape[mesh_lib.DATA_AXIS]
+            if pcount > 1:
+                # x is this host's slice (see assemble); stitch the global
+                # array from per-process slices
+                if x.ndim >= 1 and (x.shape[0] * pcount) % dp == 0:
+                    return jax.make_array_from_process_local_data(sharding, x)
+                raise ValueError(
+                    f"per-host batch leaf of {x.shape} cannot shard over "
+                    f"the {dp}-way data axis"
+                )
             if x.ndim >= 1 and x.shape[0] % dp == 0:
                 return jax.device_put(x, sharding)
             return jax.device_put(x, replicated)

@@ -855,6 +855,12 @@ class DeepSpeedEngine:
         slices without any cross-host transfer."""
         from jax.sharding import NamedSharding, PartitionSpec
 
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # already a global (multi-host) array: the caller chose its
+            # layout — the escape hatch for host-replicated tables etc.
+            return x
+        if not isinstance(x, (jax.Array, np.ndarray)):
+            x = np.asarray(x)  # python scalars / lists
         pcount = jax.process_count()
         if pcount > 1:
             x = np.asarray(x)
@@ -912,11 +918,13 @@ class DeepSpeedEngine:
             return jax.device_put(x, mesh_lib.replicated(self._mesh))
 
     def _shard_batch(self, inputs):
-        def place(x):
-            x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
-            return self._place_leaf(x, 0)
-
-        return tuple(jax.tree_util.tree_map(place, x) for x in inputs)
+        # raw numpy/python leaves go straight into _place_leaf (device_put /
+        # make_array handle host arrays directly — a jnp.asarray here would
+        # add a device round-trip on the input hot path)
+        return tuple(
+            jax.tree_util.tree_map(lambda x: self._place_leaf(x, 0), x)
+            for x in inputs
+        )
 
     def _shard_window_batch(self, stacked):
         """Place a stacked accumulation window: leaves are [accum, micro, ...];

@@ -119,6 +119,18 @@ for _ in range(20):
 assert losses[-1] < 0.5 * losses[0], losses
 print(f"RANK{{rank}} ENGINE OK first={{losses[0]:.4f}} last={{losses[-1]:.4f}}",
       flush=True)
+
+# dataloader path: every host sees the same GLOBAL dataset; the loader
+# gives each host its slice and _place stitches the global batch
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+loader = DeepSpeedDataLoader((X, Y), batch_size=8, mesh=mesh, shuffle=True)
+engine.eval()
+for bx, by in loader:
+    assert bx.shape[0] == 8, bx.shape          # global rows
+    assert not bx.is_fully_addressable          # spans both processes
+    l_eval = engine(bx, by)
+print(f"RANK{{rank}} LOADER OK eval={{float(l_eval):.6f}}", flush=True)
 """
 
 
@@ -166,53 +178,19 @@ def test_two_process_engine_training(tmp_path):
     state across the two hosts; the loss must drop and agree between
     ranks (it is a replicated global mean)."""
     outs = _run_ranks(tmp_path, ENGINE_BODY, "engine")
-    lasts = []
+    lasts, evals = [], []
     for rank, out in enumerate(outs):
         line = [l for l in out.splitlines() if f"RANK{rank} ENGINE OK" in l]
         assert line, out
         lasts.append(line[0].split("last=")[1])
+        lline = [l for l in out.splitlines() if f"RANK{rank} LOADER OK" in l]
+        assert lline, out
+        evals.append(lline[0].split("eval=")[1])
     assert lasts[0] == lasts[1], f"ranks disagree on the loss: {lasts}"
+    assert evals[0] == evals[1], f"ranks disagree on the eval loss: {evals}"
 
 
 def test_two_process_rendezvous_and_collective(tmp_path):
-    port = _free_port()
-    body = RANK_BODY.format(repo=REPO)
-    script = tmp_path / "rank_body.py"
-    script.write_text(textwrap.dedent(body))
-
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ)
-        # CPU backend, one local device per rank; env must be set BEFORE
-        # interpreter start (jax may be preimported by sitecustomize).
-        # Drop any TPU-plugin activation vars so a hardware backend can't
-        # hijack the child (same scrub as __graft_entry__.dryrun_multichip).
-        for var in list(env):
-            if var.startswith(("PALLAS_AXON", "AXON_", "TPU_")):
-                env.pop(var)
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-        env["JAX_PLATFORMS"] = "cpu"
-        env.update({
-            "DS_TPU_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "DS_TPU_NUM_PROCESSES": "2",
-            "DS_TPU_PROCESS_ID": str(rank),
-        })
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, str(script)],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True,
-            )
-        )
-    outs = []
-    for rank, proc in enumerate(procs):
-        try:
-            out, _ = proc.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            pytest.fail(f"rank {rank} hung (rendezvous deadlock?)")
-        outs.append(out)
-    for rank, (proc, out) in enumerate(zip(procs, outs)):
-        assert proc.returncode == 0, f"rank {rank} failed:\n{out}"
+    outs = _run_ranks(tmp_path, RANK_BODY, "collective")
+    for rank, out in enumerate(outs):
         assert f"RANK{rank} OK" in out, out
