@@ -165,6 +165,15 @@ ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT = True
 ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"
 ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT = 500000000
 
+# Store model params in the compute dtype and keep the fp32 master copy
+# inside the (stage>=1 sharded) optimizer state — the reference ZeRO
+# layout (fp16 params replicated, fp32 master partitioned,
+# deepspeed_zero_optimizer.py:256-263). Off => params stored fp32 and
+# cast to the compute dtype each step (numerically identical; ~2x the
+# replicated param bytes under bf16/fp16).
+ZERO_MASTER_WEIGHTS = "master_weights"
+ZERO_MASTER_WEIGHTS_DEFAULT = True
+
 #############################################
 # Activation checkpointing
 #############################################

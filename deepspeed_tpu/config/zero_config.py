@@ -26,6 +26,7 @@ class DeepSpeedZeroConfig:
         self.contiguous_gradients = C.ZERO_CONTIGUOUS_GRADIENTS_DEFAULT
         self.load_from_fp32_weights = C.ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT
         self.max_elements_per_comm = C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT
+        self.master_weights = C.ZERO_MASTER_WEIGHTS_DEFAULT
 
         if param_dict is not None:
             raw = param_dict.get(C.ZERO_OPTIMIZATION)
@@ -76,6 +77,9 @@ class DeepSpeedZeroConfig:
         self.max_elements_per_comm = get_scalar_param(
             zero_dict, C.ZERO_MAX_ELEMENTS_PER_COMM, C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT
         )
+        self.master_weights = get_scalar_param(
+            zero_dict, C.ZERO_MASTER_WEIGHTS, C.ZERO_MASTER_WEIGHTS_DEFAULT
+        )
 
     def repr_dict(self):
         return {
@@ -87,6 +91,7 @@ class DeepSpeedZeroConfig:
             C.ZERO_OVERLAP_COMM: self.overlap_comm,
             C.ZERO_CONTIGUOUS_GRADIENTS: self.contiguous_gradients,
             C.ZERO_LOAD_FROM_FP32_WEIGHTS: self.load_from_fp32_weights,
+            C.ZERO_MASTER_WEIGHTS: self.master_weights,
         }
 
     def __repr__(self):

@@ -75,6 +75,20 @@ def _barrier(name):
         multihost_utils.sync_global_devices(name)
 
 
+def _canonical_opt_state(engine):
+    """The checkpoint's optimizer-state tree: always {"master", "inner"}.
+    Engines storing fp32 params synthesize the master view (it IS the
+    params); master-mode engines already hold this shape."""
+    import jax.numpy as jnp
+
+    if getattr(engine, "master_in_opt", False):
+        return engine.optimizer_state
+    master = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32), engine.params
+    )
+    return {"master": master, "inner": engine.optimizer_state}
+
+
 def save_checkpoint(engine, save_dir, tag=None, client_state=None):
     """Multi-host write discipline (reference deepspeed_light.py:1282-1360):
     process 0 writes the model-states file; optimizer shard files are
@@ -126,7 +140,15 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None):
     # in one place). Production multi-host pods should still prefer
     # addressable-shard streaming writers; process_allgather here is the
     # correct-but-chatty fallback.
-    leaves, _ = _flatten(engine.optimizer_state)
+    #
+    # The on-disk layout is CANONICAL regardless of the engine's in-memory
+    # placement: {"master": fp32 weights, "inner": optimizer moments} —
+    # the reference's fp32-partitions-in-optim-files layout
+    # (deepspeed_light.py:1355-1360, load_from_fp32_weights). Engines
+    # without master_in_opt synthesize the master from their fp32 params,
+    # so a checkpoint saved at dp=1 (no master mode) loads at dp=8 (master
+    # mode) and vice versa.
+    leaves, _ = _flatten(_canonical_opt_state(engine))
     axes = [_data_axis_of(l) for l in leaves]
     dp = engine.dp_world_size if engine.zero_stage >= 1 else 1
     owned_ranks = [r for r in range(dp) if r % n_proc == proc]
@@ -194,10 +216,14 @@ def load_checkpoint(
         jax.tree_util.tree_map(np.asarray, engine.params), state["module"]
     )
     engine.params = jax.device_put(
-        jax.tree_util.tree_map(lambda p: np.asarray(p, np.float32), params_np),
+        jax.tree_util.tree_map(
+            # keep the engine's storage dtype (compute dtype when the fp32
+            # master lives in the optimizer state, fp32 otherwise)
+            lambda p, cur: np.asarray(p, cur.dtype),
+            params_np, engine.params,
+        ),
         engine._param_shardings,
     )
-
     # ---- counters / scaler / scheduler ------------------------------
     engine.global_steps = int(state["global_steps"])
     engine.skipped_steps = int(state["skipped_steps"])
@@ -219,10 +245,25 @@ def load_checkpoint(
         engine.lr_scheduler.load_state_dict(state["lr_scheduler"])
 
     # ---- optimizer state: merge all saved shards, reshard -----------
+    # On-disk layout is the canonical {"master", "inner"} tree (see
+    # save_checkpoint); adapt it to the engine's in-memory placement so
+    # checkpoints cross master/non-master layouts (dp=1 <-> dp>1, bf16 <->
+    # fp32) as well as dp sizes.
+    master_restored = False
     if load_optimizer_states:
-        leaves, treedef = _flatten(engine.optimizer_state)
+        if getattr(engine, "master_in_opt", False):
+            inner_template = engine.optimizer_state["inner"]
+        else:
+            inner_template = engine.optimizer_state
+        canonical_template = {
+            "master": jax.tree_util.tree_map(np.asarray, engine.params),
+            "inner": inner_template,
+        }
+        can_leaves, can_treedef = _flatten(canonical_template)
+        n_inner = len(jax.tree_util.tree_leaves(inner_template))
         saved_dp = int(state["dp_world_size"]) if state["zero_stage"] >= 1 else 1
         rank0_path = os.path.join(ckpt_dir, OPTIM_FILE.format(dp=0, mp=mp_rank))
+        canonical = None
         if os.path.exists(rank0_path):
             shards = []
             for rank in range(saved_dp):
@@ -235,18 +276,86 @@ def load_checkpoint(
             num_shards = int(shards[0]["num_shards"])
             axes = shards[0]["shard_axes"]
             splittable = shards[0]["splittable"]
-            merged = []
-            for i in range(len(leaves)):
+            n_saved = len(shards[0]["leaves"])
+
+            def merge(i):
                 ax, can_split = int(axes[i]), bool(splittable[i])
                 if can_split and num_shards > 1:
                     pieces = [np.asarray(s["leaves"][str(i)]) for s in shards]
-                    merged.append(np.concatenate(pieces, axis=ax))
+                    return np.concatenate(pieces, axis=ax)
+                return np.asarray(shards[0]["leaves"][str(i)])
+
+            if n_saved == len(can_leaves):
+                canonical = jax.tree_util.tree_unflatten(
+                    can_treedef, [merge(i) for i in range(n_saved)]
+                )
+                master_restored = True
+            elif n_saved == n_inner:
+                # legacy layout: bare inner tree, no master partition —
+                # restore moments, master re-derives from module weights
+                inner_flat, inner_def = _flatten(inner_template)
+                del inner_flat
+                canonical = {
+                    "master": None,
+                    "inner": jax.tree_util.tree_unflatten(
+                        inner_def, [merge(i) for i in range(n_saved)]
+                    ),
+                }
+            else:
+                log_dist(
+                    f"optimizer checkpoint has {n_saved} leaves; engine "
+                    f"expects {len(can_leaves)} (or legacy {n_inner}) — "
+                    "skipping optimizer restore",
+                    ranks=[0],
+                )
+        if canonical is not None:
+            if engine.master_in_opt:
+                inner_dev = jax.device_put(
+                    canonical["inner"], engine._opt_shardings["inner"]
+                )
+                if master_restored:
+                    master_dev = jax.device_put(
+                        canonical["master"], engine._opt_shardings["master"]
+                    )
+                    engine.optimizer_state = {
+                        "master": master_dev, "inner": inner_dev,
+                    }
                 else:
-                    merged.append(np.asarray(shards[0]["leaves"][str(i)]))
-            full_state = jax.tree_util.tree_unflatten(treedef, merged)
-            engine.optimizer_state = jax.device_put(
-                full_state, engine._opt_shardings
-            )
+                    engine.optimizer_state = {
+                        "master": engine.optimizer_state["master"],
+                        "inner": inner_dev,
+                    }
+            else:
+                engine.optimizer_state = jax.device_put(
+                    canonical["inner"], engine._opt_shardings
+                )
+                if master_restored:
+                    # exact fp32 resume: the master partition overrides the
+                    # (possibly down-cast) module weights — the reference's
+                    # load_from_fp32_weights=True path
+                    engine.params = jax.device_put(
+                        jax.tree_util.tree_map(
+                            lambda m, cur: np.asarray(m).astype(cur.dtype),
+                            canonical["master"], params_np,
+                        ),
+                        engine._param_shardings,
+                    )
+
+    if getattr(engine, "master_in_opt", False) and not master_restored:
+        # no fp32 master came from disk (model-only checkpoint, legacy
+        # layout, or load_optimizer_states=False): derive it from the
+        # loaded module weights so the next step cannot silently publish
+        # init-time values (reference load_from_fp32_weights=False path,
+        # deepspeed_light.py:1214-1222)
+        engine.optimizer_state = {
+            "master": jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda p: np.asarray(p, np.float32), params_np
+                ),
+                engine._opt_shardings["master"],
+            ),
+            "inner": engine.optimizer_state["inner"],
+        }
 
     log_dist(f"Loaded checkpoint {tag} from {load_dir}", ranks=[0])
     return os.path.join(ckpt_dir, ""), state.get("client_state", {})

@@ -117,6 +117,25 @@ class DeepSpeedDataLoader:
             )
         rank = jax.process_index()
         per_host = self.batch_size // pcount
+        if pcount > 1 and not self.drop_last:
+            # a ragged final batch would give hosts unequal slice sizes
+            # (make_array_from_process_local_data then fails or hangs);
+            # pods always drop the remainder
+            from ..utils.logging import log_dist
+
+            if self._num_samples is not None and (
+                self._num_samples % self.batch_size
+            ):
+                log_dist(
+                    "multi-host loader forces drop_last=True (ragged final "
+                    "batch cannot split evenly across processes)",
+                    ranks=[0],
+                )
+            nb = (
+                self._num_samples // self.batch_size
+                if self._num_samples is not None
+                else nb
+            )
 
         def assemble(b):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
@@ -197,10 +216,10 @@ class DeepSpeedDataLoader:
                 # array from per-process slices
                 if x.ndim >= 1 and (x.shape[0] * pcount) % dp == 0:
                     return jax.make_array_from_process_local_data(sharding, x)
-                raise ValueError(
-                    f"per-host batch leaf of {x.shape} cannot shard over "
-                    f"the {dp}-way data axis"
-                )
+                # batch-dim-less leaf (0-d dataset constants): identical on
+                # every host by construction — replicate, matching the
+                # single-host fallback
+                return jax.make_array_from_process_local_data(replicated, x)
             if x.ndim >= 1 and x.shape[0] % dp == 0:
                 return jax.device_put(x, sharding)
             return jax.device_put(x, replicated)

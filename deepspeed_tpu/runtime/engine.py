@@ -235,7 +235,27 @@ class DeepSpeedEngine:
         self._grad_shardings = zero_lib.specs_to_shardings(
             self._grad_specs, self._mesh
         )
-        self.params = jax.device_put(params_f32, self._param_shardings)
+        # Reference ZeRO layout (deepspeed_zero_optimizer.py:256-263):
+        # model params live in the compute dtype (replicated over dp like
+        # the reference's fp16 params) while the fp32 MASTER copy rides
+        # the stage>=1-sharded optimizer state. Numerically identical to
+        # storing fp32 params and casting each step; halves the
+        # replicated param bytes under bf16/fp16.
+        self.master_in_opt = (
+            self.compute_dtype != jnp.float32
+            and stage >= 1
+            and dp_size > 1  # dp=1: a master copy would only add bytes
+            and getattr(self.config.zero_config, "master_weights", True)
+        )
+        if self.master_in_opt:
+            self.params = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda p: p.astype(self.compute_dtype), params_f32
+                ),
+                self._param_shardings,
+            )
+        else:
+            self.params = jax.device_put(params_f32, self._param_shardings)
 
         # ---- optimizer ------------------------------------------------
         self.optimizer_obj = self._configure_optimizer()
@@ -251,14 +271,27 @@ class DeepSpeedEngine:
                 "cleanly) with ZeRO.",
                 ranks=[0],
             )
-        opt_state = self.optimizer_obj.init(self.params)
-        self._opt_shardings = zero_lib.specs_to_shardings(
+        inner_state = self.optimizer_obj.init(params_f32)
+        inner_shardings = zero_lib.specs_to_shardings(
             zero_lib.optstate_specs_like(
-                opt_state, optstate_param_specs, params_f32
+                inner_state, optstate_param_specs, params_f32
             ),
             self._mesh,
         )
-        self.optimizer_state = jax.device_put(opt_state, self._opt_shardings)
+        if self.master_in_opt:
+            master_shardings = zero_lib.specs_to_shardings(
+                optstate_param_specs, self._mesh
+            )
+            self._opt_shardings = {
+                "master": master_shardings, "inner": inner_shardings,
+            }
+            self.optimizer_state = {
+                "master": jax.device_put(params_f32, master_shardings),
+                "inner": jax.device_put(inner_state, inner_shardings),
+            }
+        else:
+            self._opt_shardings = inner_shardings
+            self.optimizer_state = jax.device_put(inner_state, inner_shardings)
         del params_f32  # don't pin the unsharded fp32 copy beyond init
 
         # ---- grad accumulation buffer ---------------------------------
@@ -456,6 +489,7 @@ class DeepSpeedEngine:
         clip = float(self.config.gradient_clipping or 0.0)
         optimizer = self.optimizer_obj
         param_shardings = self._param_shardings
+        master_in_opt = self.master_in_opt
         opt_shardings = self._opt_shardings
 
         def cast_params(params):
@@ -547,9 +581,22 @@ class DeepSpeedEngine:
                     grad_norm = norm
                 else:
                     grad_norm = global_norm(grads)
-                new_params, new_opt, aux = optimizer.apply(
-                    params, grads, opt_state, lr
-                )
+                if master_in_opt:
+                    # step the fp32 master (sharded), then publish the
+                    # compute-dtype params — the reference's fp32-partition
+                    # step + fp16 copy (deepspeed_zero_optimizer.py:
+                    # 1157-1199), with the all-gather left to GSPMD
+                    new_master, new_inner, aux = optimizer.apply(
+                        opt_state["master"], grads, opt_state["inner"], lr
+                    )
+                    new_opt = {"master": new_master, "inner": new_inner}
+                    new_params = jax.tree_util.tree_map(
+                        lambda m, p: m.astype(p.dtype), new_master, params
+                    )
+                else:
+                    new_params, new_opt, aux = optimizer.apply(
+                        params, grads, opt_state, lr
+                    )
                 coeffs = aux.get("lamb_coeffs", [])
                 coeff_vec = (
                     jnp.stack(coeffs) if coeffs else jnp.zeros((0,), jnp.float32)

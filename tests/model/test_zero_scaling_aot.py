@@ -65,7 +65,7 @@ def _aot_footprint(cfg_kwargs, dp, mp, stage, micro, seq=1024):
         int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params_shape)
     )
     opt = Adam()
-    opt_shape = jax.eval_shape(opt.init, params_shape)
+    inner_shape = jax.eval_shape(opt.init, params_shape)
     mp_specs = partition_specs(params_shape) if mp > 1 else None
     param_sh = zero_lib.specs_to_shardings(
         zero_lib.zero_param_specs(params_shape, dp, stage, model_specs=mp_specs),
@@ -75,22 +75,31 @@ def _aot_footprint(cfg_kwargs, dp, mp, stage, micro, seq=1024):
         zero_lib.zero_grad_specs(params_shape, dp, stage, model_specs=mp_specs),
         mesh,
     )
-    opt_sh = zero_lib.specs_to_shardings(
+    optstate_param_specs = zero_lib.zero_optstate_specs(
+        params_shape, dp, stage, model_specs=mp_specs
+    )
+    inner_sh = zero_lib.specs_to_shardings(
         zero_lib.optstate_specs_like(
-            opt_shape,
-            zero_lib.zero_optstate_specs(
-                params_shape, dp, stage, model_specs=mp_specs
-            ),
-            params_shape,
+            inner_shape, optstate_param_specs, params_shape
         ),
         mesh,
     )
+    # the engine's master-weights layout (runtime/engine.py): params stored
+    # bf16 (replicated over dp like the reference's fp16 params), fp32
+    # master inside the stage>=1-sharded optimizer state
+    bf16_params_shape = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16), params_shape
+    )
+    opt_shape = {"master": params_shape, "inner": inner_shape}
+    opt_sh = {
+        "master": zero_lib.specs_to_shardings(optstate_param_specs, mesh),
+        "inner": inner_sh,
+    }
     data_sh = NamedSharding(mesh, P("data", None))
 
     def train_step(params, opt_state, ids):
         def loss_fn(p):
-            pc = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), p)
-            return model.apply({"params": pc}, ids, ids, train=False)
+            return model.apply({"params": p}, ids, ids, train=False)
 
         grads = jax.grad(loss_fn)(params)
         grads = jax.tree_util.tree_map(
@@ -99,12 +108,16 @@ def _aot_footprint(cfg_kwargs, dp, mp, stage, micro, seq=1024):
             ),
             grads, grad_sh,
         )
-        new_params, new_opt, _ = opt.apply(params, grads, opt_state, 1e-4)
-        new_params = jax.tree_util.tree_map(
-            lambda p, s: jax.lax.with_sharding_constraint(p, s),
-            new_params, param_sh,
+        new_master, new_inner, _ = opt.apply(
+            opt_state["master"], grads, opt_state["inner"], 1e-4
         )
-        return new_params, new_opt
+        new_params = jax.tree_util.tree_map(
+            lambda m, s: jax.lax.with_sharding_constraint(
+                m.astype(jnp.bfloat16), s
+            ),
+            new_master, param_sh,
+        )
+        return new_params, {"master": new_master, "inner": new_inner}
 
     def shaped(tree, sh):
         return jax.tree_util.tree_map(
@@ -117,7 +130,7 @@ def _aot_footprint(cfg_kwargs, dp, mp, stage, micro, seq=1024):
         in_shardings=(param_sh, opt_sh, data_sh),
         out_shardings=(param_sh, opt_sh),
     ).lower(
-        shaped(params_shape, param_sh),
+        shaped(bf16_params_shape, param_sh),
         shaped(opt_shape, opt_sh),
         jax.ShapeDtypeStruct((micro, seq), jnp.int32, sharding=data_sh),
     ).compile()
@@ -150,6 +163,37 @@ def test_gpt2_1_5b_zero3_shards_params_too():
         dict(n_embd=1600, n_layer=48, n_head=25), dp=8, mp=1, stage=3, micro=8,
     )
     assert s3 < 0.65 * s2, (s3 / 1e9, s2 / 1e9)
+
+
+GPT4B_SNIPPET = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {repo!r} + "/tests")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from model.test_zero_scaling_aot import _aot_footprint, HBM_BYTES
+n, per_dev = _aot_footprint(
+    dict(n_embd=2304, n_layer=64, n_head=24), dp=4, mp=4, stage=2, micro=4,
+)
+assert n >= 4e9, n
+assert per_dev < HBM_BYTES, per_dev
+print(f"GPT4B_OK {{n}} {{per_dev}}")
+"""
+
+
+def test_gpt2_4b_zero2_mp4_fits_per_chip_on_16_devices():
+    """The reference perf ladder's 4B config (64L/2304h,
+    run_perf_test.py:36-46) over 16 devices, ZeRO-2 x mp4: measured
+    8.8 GB/chip."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", GPT4B_SNIPPET.format(repo=REPO)],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "GPT4B_OK" in proc.stdout
 
 
 TURING_SNIPPET = r"""

@@ -1,0 +1,223 @@
+"""Master-weights layout (reference ZeRO: fp16/bf16 model params
+replicated, fp32 master partitioned into the optimizer state —
+deepspeed_zero_optimizer.py:256-263).
+
+Under bf16/fp16 + stage>=1 the engine stores params in the compute dtype
+and keeps the fp32 master inside the dp-sharded optimizer state. These
+tests pin: storage dtypes/shardings, exact numerical equivalence with the
+fp32-param storage mode (the math is identical — only placement moves),
+fp16 overflow-skip integrity, and exact checkpoint resume.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, y, train=True):
+        h = nn.relu(nn.Dense(32)(x))
+        logp = jax.nn.log_softmax(nn.Dense(4)(h))
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(16, 8)).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.int32) + 2 * (X[:, 1] > 0).astype(np.int32)
+    return X, Y
+
+
+def _engine(master_weights, stage=2, precision="bf16", dp=8, seed=0):
+    X, Y = _data()
+    model = MLP()
+    params = model.init(
+        {"params": jax.random.PRNGKey(seed)}, jnp.asarray(X), jnp.asarray(Y)
+    )["params"]
+    mesh = build_mesh(
+        devices=jax.devices()[:dp], data_parallel_size=dp
+    )
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, mesh=mesh,
+        config_params={
+            "train_batch_size": 16,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            precision: {"enabled": True},
+            "zero_optimization": {
+                "stage": stage, "master_weights": master_weights,
+            },
+            "steps_per_print": 10_000,
+        },
+        rng_seed=0,
+    )
+    return engine
+
+
+def _train(engine, steps=15):
+    X, Y = _data()
+    losses = []
+    for _ in range(steps):
+        loss = engine(X, Y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return np.asarray(losses)
+
+
+def test_master_layout_dtypes_and_sharding():
+    engine = _engine(master_weights=True)
+    assert engine.master_in_opt
+    # params stored in the compute dtype (the reference's replicated fp16)
+    for leaf in jax.tree_util.tree_leaves(engine.params):
+        assert leaf.dtype == engine.compute_dtype, leaf.dtype
+    # fp32 master rides the optimizer state, dp-sharded where divisible
+    masters = jax.tree_util.tree_leaves(engine.optimizer_state["master"])
+    assert all(m.dtype == jnp.float32 for m in masters)
+    assert any(
+        "data" in str(m.sharding.spec) for m in masters
+    ), [str(m.sharding.spec) for m in masters]
+
+
+def test_master_mode_matches_fp32_param_storage_exactly():
+    """Moving the master into the optimizer state must not change a single
+    step: both modes compute bf16(master) forward + fp32 master update."""
+    on = _train(_engine(master_weights=True))
+    off = _train(_engine(master_weights=False))
+    np.testing.assert_array_equal(on, off)
+    assert on[-1] < 0.5 * on[0], on
+
+
+def test_master_mode_off_keeps_fp32_params():
+    engine = _engine(master_weights=False)
+    assert not engine.master_in_opt
+    for leaf in jax.tree_util.tree_leaves(engine.params):
+        assert leaf.dtype == jnp.float32
+    assert "master" not in engine.optimizer_state
+
+
+def test_fp32_runs_never_use_master_mode():
+    X, Y = _data()
+    model = MLP()
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.asarray(X), jnp.asarray(Y)
+    )["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        mesh=build_mesh(data_parallel_size=8),
+        config_params={
+            "train_batch_size": 16,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 10_000,
+        },
+    )
+    assert not engine.master_in_opt  # fp32 params ARE the master
+
+
+def test_fp16_overflow_skip_with_master(monkeypatch):
+    """Dynamic loss scaling on the fp16 (CPU) path: an overflow must skip
+    the master update and halve the scale, same as without master mode."""
+    engine = _engine(master_weights=True, stage=1, precision="fp16")
+    assert engine.master_in_opt
+    X, Y = _data()
+    # poison one step with an exploding input to force an fp16 overflow
+    loss = engine(X * 1e4, Y)
+    engine.backward(loss)
+    master_before = jax.tree_util.tree_map(
+        np.asarray, engine.optimizer_state["master"]
+    )
+    engine.step()
+    if engine.last_overflow:
+        # (the first overflow may only burn hysteresis, not halve the
+        # scale — reference delayed_shift semantics); the master update
+        # MUST have been skipped either way
+        assert engine.skipped_steps == 1
+        for a, b in zip(
+            jax.tree_util.tree_leaves(master_before),
+            jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(
+                    np.asarray, engine.optimizer_state["master"]
+                )
+            ),
+        ):
+            np.testing.assert_array_equal(a, b)
+    # training continues afterwards
+    losses = _train(engine, steps=10)
+    assert np.isfinite(losses).all()
+
+
+def test_master_mode_checkpoint_resume_exact(tmp_path):
+    engine = _engine(master_weights=True)
+    first = _train(engine, steps=8)
+    engine.save_checkpoint(str(tmp_path), tag="mid")
+    cont = _train(engine, steps=8)
+
+    fresh = _engine(master_weights=True)
+    # different init: only a real restore can match
+    fresh.load_checkpoint(str(tmp_path), tag="mid")
+    resumed = _train(fresh, steps=8)
+    np.testing.assert_allclose(resumed, cont, rtol=1e-6)
+
+
+def test_checkpoint_crosses_master_layouts(tmp_path):
+    """The on-disk optimizer layout is canonical {master, inner}: a bf16
+    checkpoint saved at dp=1 (fp32-param storage, no master mode) must
+    resume at dp=8 (master mode) and vice versa, exactly."""
+    # save at dp=1 (master OFF), resume at dp=8 (master ON)
+    e1 = _engine(master_weights=True, dp=1)  # dp=1 forces master off
+    assert not e1.master_in_opt
+    _train(e1, steps=8)
+    e1.save_checkpoint(str(tmp_path / "a"), tag="t")
+    cont = _train(e1, steps=8)
+
+    e8 = _engine(master_weights=True, dp=8, seed=7)
+    assert e8.master_in_opt
+    e8.load_checkpoint(str(tmp_path / "a"), tag="t")
+    resumed = _train(e8, steps=8)
+    # cross-dp resumes change the gradient-reduction order: bf16-forward
+    # trajectories match to reduction noise, not bit-exactly
+    np.testing.assert_allclose(resumed, cont, rtol=1e-2)
+
+    # save at dp=8 (master ON), resume at dp=1 (master OFF): the fp32
+    # master partition must override the bf16 module weights (the
+    # reference's load_from_fp32_weights=True)
+    e8b = _engine(master_weights=True, dp=8)
+    _train(e8b, steps=8)
+    e8b.save_checkpoint(str(tmp_path / "b"), tag="t")
+    cont_b = _train(e8b, steps=8)
+
+    e1b = _engine(master_weights=True, dp=1, seed=7)
+    assert not e1b.master_in_opt
+    e1b.load_checkpoint(str(tmp_path / "b"), tag="t")
+    resumed_b = _train(e1b, steps=8)
+    np.testing.assert_allclose(resumed_b, cont_b, rtol=1e-2)
+
+
+def test_model_only_checkpoint_does_not_revert_weights(tmp_path):
+    """Loading with load_optimizer_states=False must refresh the fp32
+    master from the loaded weights — otherwise the first step would
+    publish init-time values."""
+    engine = _engine(master_weights=True)
+    _train(engine, steps=8)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    ref = np.asarray(
+        jax.tree_util.tree_leaves(engine.optimizer_state["master"])[0]
+    )
+
+    fresh = _engine(master_weights=True, seed=7)
+    fresh.load_checkpoint(str(tmp_path), tag="t", load_optimizer_states=False)
+    got = np.asarray(
+        jax.tree_util.tree_leaves(fresh.optimizer_state["master"])[0]
+    )
+    # master now mirrors the loaded (bf16) weights, not seed-7 init
+    np.testing.assert_allclose(got, ref, atol=1e-2)
+    loss0 = float(fresh(*_data()[:2]))
+    fresh.backward(loss0)
+    fresh.step()
+    loss1 = float(fresh(*_data()[:2]))
+    assert loss1 < loss0 * 1.5, (loss0, loss1)  # no catastrophic revert
