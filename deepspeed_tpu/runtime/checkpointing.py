@@ -76,13 +76,20 @@ def _barrier(name):
 
 
 def _canonical_opt_state(engine):
-    """The checkpoint's optimizer-state tree: always {"master", "inner"}.
-    Engines storing fp32 params synthesize the master view (it IS the
-    params); master-mode engines already hold this shape."""
+    """The checkpoint's optimizer-state tree: {"master", "inner"} whenever
+    an fp32 master distinct from the module file exists. Master-mode
+    engines hold this shape already; bf16/fp16 engines without master
+    mode (dp=1) synthesize it from their fp32 params so a later
+    master-mode load resumes exactly. Pure-fp32 engines save the bare
+    inner tree — their module file IS the master, and the load path's
+    legacy branch re-derives it, so duplicating ~4 bytes/param into the
+    optim shards would buy nothing."""
     import jax.numpy as jnp
 
     if getattr(engine, "master_in_opt", False):
         return engine.optimizer_state
+    if engine.compute_dtype == jnp.float32:
+        return engine.optimizer_state  # bare inner (legacy layout)
     master = jax.tree_util.tree_map(
         lambda p: p.astype(jnp.float32), engine.params
     )

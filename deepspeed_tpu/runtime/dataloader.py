@@ -58,6 +58,28 @@ class DeepSpeedDataLoader:
         self.prefetch = prefetch
         self._epoch = 0
 
+        import jax
+
+        if jax.process_count() > 1:
+            if batch_size % jax.process_count() != 0:
+                raise ValueError(
+                    f"batch_size={batch_size} must divide across "
+                    f"{jax.process_count()} processes"
+                )
+            if not self.drop_last:
+                # a ragged final batch would give hosts unequal slice
+                # sizes (make_array_from_process_local_data fails or
+                # hangs); pods always drop the remainder — set here so
+                # __len__ agrees with what __iter__ yields
+                from ..utils.logging import log_dist
+
+                log_dist(
+                    "multi-host loader forces drop_last=True (a ragged "
+                    "final batch cannot split evenly across processes)",
+                    ranks=[0],
+                )
+                self.drop_last = True
+
         if isinstance(dataset, (tuple, list)) and all(
             hasattr(a, "shape") for a in dataset
         ):
@@ -110,32 +132,8 @@ class DeepSpeedDataLoader:
         import jax
 
         pcount = jax.process_count()
-        if pcount > 1 and self.batch_size % pcount != 0:
-            raise ValueError(
-                f"batch_size={self.batch_size} must divide across "
-                f"{pcount} processes"
-            )
         rank = jax.process_index()
-        per_host = self.batch_size // pcount
-        if pcount > 1 and not self.drop_last:
-            # a ragged final batch would give hosts unequal slice sizes
-            # (make_array_from_process_local_data then fails or hangs);
-            # pods always drop the remainder
-            from ..utils.logging import log_dist
-
-            if self._num_samples is not None and (
-                self._num_samples % self.batch_size
-            ):
-                log_dist(
-                    "multi-host loader forces drop_last=True (ragged final "
-                    "batch cannot split evenly across processes)",
-                    ranks=[0],
-                )
-            nb = (
-                self._num_samples // self.batch_size
-                if self._num_samples is not None
-                else nb
-            )
+        per_host = self.batch_size // max(pcount, 1)
 
         def assemble(b):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
@@ -216,10 +214,18 @@ class DeepSpeedDataLoader:
                 # array from per-process slices
                 if x.ndim >= 1 and (x.shape[0] * pcount) % dp == 0:
                     return jax.make_array_from_process_local_data(sharding, x)
-                # batch-dim-less leaf (0-d dataset constants): identical on
-                # every host by construction — replicate, matching the
-                # single-host fallback
-                return jax.make_array_from_process_local_data(replicated, x)
+                if x.ndim == 0:
+                    # 0-d dataset constants are identical on every host by
+                    # construction — replicate like the single-host path
+                    return jax.make_array_from_process_local_data(
+                        replicated, x
+                    )
+                # a >=1-d per-host slice that can't shard must NOT be
+                # replicated: each host holds different rows
+                raise ValueError(
+                    f"per-host batch leaf of {x.shape} x {pcount} processes "
+                    f"cannot shard over the {dp}-way data axis"
+                )
             if x.ndim >= 1 and x.shape[0] % dp == 0:
                 return jax.device_put(x, sharding)
             return jax.device_put(x, replicated)
