@@ -138,6 +138,20 @@ class DeepSpeedConfig:
             bf16_dict, C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT
         )
 
+        # data_types block: gradient-accumulation dtype. The reference
+        # accumulates fp16 gradients (param.grad stays fp16 until the
+        # master step); "fp32" (default) accumulates exactly, the
+        # reduced-precision options halve grad-buffer HBM.
+        dt_dict = get_dict_param(pd, C.DATA_TYPES)
+        self.grad_accum_dtype = get_scalar_param(
+            dt_dict, C.GRAD_ACCUM_DTYPE, C.GRAD_ACCUM_DTYPE_DEFAULT
+        )
+        if self.grad_accum_dtype not in ("fp32", "bf16", "fp16"):
+            raise DeepSpeedConfigError(
+                f"{C.GRAD_ACCUM_DTYPE} must be one of fp32/bf16/fp16, got "
+                f"{self.grad_accum_dtype!r}"
+            )
+
         # optimizer / scheduler
         optimizer_dict = get_dict_param(pd, C.OPTIMIZER)
         self.optimizer_name = optimizer_dict.get(C.TYPE)

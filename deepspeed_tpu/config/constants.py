@@ -108,6 +108,13 @@ FP16_MIN_LOSS_SCALE = "min_loss_scale"
 FP16_MIN_LOSS_SCALE_DEFAULT = 1
 
 #############################################
+# data_types block (later-DeepSpeed surface): gradient accumulation dtype.
+# The reference effectively accumulates fp16 grads; fp32 is the exact
+# default here.
+DATA_TYPES = "data_types"
+GRAD_ACCUM_DTYPE = "grad_accum_dtype"
+GRAD_ACCUM_DTYPE_DEFAULT = "fp32"
+
 # BF16 (TPU-native precision; no loss scaling required)
 #############################################
 BF16 = "bf16"

@@ -207,6 +207,20 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.bfloat16
         else:
             self.compute_dtype = jnp.float32
+        # gradient-accumulation dtype (config data_types.grad_accum_dtype):
+        # reduced precision halves grad-buffer HBM (the reference keeps
+        # fp16 grads until the master step); fp32 accumulates exactly
+        if self.config.grad_accum_dtype == "fp32":
+            self.grad_accum_dtype = jnp.float32
+        elif self.compute_dtype == jnp.float32:
+            log_dist(
+                "grad_accum_dtype ignored for fp32 compute (grads are fp32)",
+                ranks=[0],
+            )
+            self.grad_accum_dtype = jnp.float32
+        else:
+            # fp16 request follows the compute dtype rule (bf16 on TPU)
+            self.grad_accum_dtype = self.compute_dtype
         self.loss_scale_state: LossScaleState = loss_scale_state_from_config(
             self.config
         )
@@ -520,13 +534,15 @@ class DeepSpeedEngine:
                 (loss, aux),
             )
 
+        accum_dtype = self.grad_accum_dtype
+
         def fwd_bwd(params, batch, rng, loss_scale):
             grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
                 params, batch, rng, loss_scale
             )
             grads = jax.tree_util.tree_map(
                 lambda g, s: jax.lax.with_sharding_constraint(
-                    g.astype(jnp.float32), s
+                    g.astype(accum_dtype), s
                 ),
                 grads,
                 grad_shardings,
@@ -569,8 +585,9 @@ class DeepSpeedEngine:
 
             def do_update(operands):
                 params, opt_state, grads = operands
+                # unscale in fp32 regardless of the accumulation dtype
                 grads = jax.tree_util.tree_map(
-                    lambda g: g * inv_scale, grads
+                    lambda g: g.astype(jnp.float32) * inv_scale, grads
                 )
                 if clip > 0:
                     norm = global_norm(grads)
@@ -651,7 +668,7 @@ class DeepSpeedEngine:
             else:
                 zeros = jax.tree_util.tree_map(
                     lambda p, s: jax.lax.with_sharding_constraint(
-                        jnp.zeros(p.shape, jnp.float32), s
+                        jnp.zeros(p.shape, accum_dtype), s
                     ),
                     params,
                     grad_shardings,
