@@ -1,0 +1,17 @@
+# Test tiers (the reference splits pytest unit tests from unittest
+# model-scale suites; here the split is a pytest marker — SURVEY.md §4).
+#
+#   make test-fast   fast core (< ~2 min): config, launcher, schedules,
+#                    loss scaling, CSR, ZeRO specs, skip accounting, ...
+#   make test        everything, including compile-heavy model-scale suites
+#                    (~15-20 min on 8 virtual CPU devices)
+
+PYTEST ?= python -m pytest
+
+test-fast:
+	$(PYTEST) tests/ -q -m "not slow"
+
+test:
+	$(PYTEST) tests/ -q
+
+.PHONY: test test-fast

@@ -45,7 +45,8 @@ class GPT2Config:
     # (parallel/pipeline.py). n_layer must divide evenly.
     pipeline_stages: int = 1
     # microbatches per forward through the pipeline (bubble fraction is
-    # (P-1)/(M+P-1)); 0 = default of 2*stages. The batch must divide by it.
+    # (P-1)/(M+P-1)); 0 = default of 4*stages when the batch divides, else
+    # 2*stages (4*stages keeps the bubble under ~20% — parallel/pipeline.py).
     pipeline_microbatches: int = 0
     # Mixture-of-Experts (beyond the reference): >0 replaces every layer's
     # FFN with an expert-parallel MoE of this many experts (ops/moe.py);
@@ -247,8 +248,12 @@ class GPT2Model(nn.Module):
                 f"pipeline_stages={n_stages}"
             )
         layers_per_stage = cfg.n_layer // n_stages
-        n_micro = cfg.pipeline_microbatches or 2 * n_stages
         b, s, H = x.shape
+        n_micro = cfg.pipeline_microbatches
+        if not n_micro:
+            # prefer 4*stages (bubble < ~20%, per parallel/pipeline.py);
+            # fall back to 2*stages when the batch doesn't divide
+            n_micro = 4 * n_stages if b % (4 * n_stages) == 0 else 2 * n_stages
         if b % n_micro:
             raise ValueError(
                 f"batch {b} must divide into pipeline microbatches {n_micro}"

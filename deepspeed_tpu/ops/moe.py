@@ -51,7 +51,10 @@ def top_k_gating(logits, k, capacity):
     Returns:
       dispatch: [G, S, E, C] one-hot dispatch mask (0/1, float32).
       combine: [G, S, E, C] combine weights (gate prob at the dispatched
-        slot, 0 elsewhere).
+        slot, 0 elsewhere).  For k > 1 the selected gates are renormalized
+        by their sum (GShard semantics: the expert branch keeps unit mass
+        instead of being attenuated by the sub-1 top-k softmax mass); k = 1
+        keeps the raw prob (Switch semantics).
       aux_loss: scalar load-balancing loss (mean_gates . mean_dispatch * E).
     """
     G, S, E = logits.shape
@@ -72,6 +75,7 @@ def top_k_gating(logits, k, capacity):
     # running per-expert fill count, carried across the k choices so the
     # second choice respects slots taken by first choices
     fill = jnp.zeros((G, E), jnp.int32)
+    topk_mass = jnp.zeros((G, S), jnp.float32)
     for _ in range(k):
         choice = jnp.argmax(remaining, axis=-1)  # [G,S]
         choice_1h = jax.nn.one_hot(choice, E, dtype=jnp.float32)
@@ -96,7 +100,10 @@ def top_k_gating(logits, k, capacity):
         fill = fill + jnp.sum(
             (choice_1h * keep[..., None]).astype(jnp.int32), axis=1
         )
+        topk_mass = topk_mass + gate_val
         remaining = remaining * (1.0 - choice_1h)  # mask the chosen expert
+    if k > 1:
+        combine = combine / jnp.maximum(topk_mass, 1e-9)[..., None, None]
     return dispatch, combine, aux_loss
 
 

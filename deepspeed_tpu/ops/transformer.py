@@ -56,6 +56,13 @@ class DeepSpeedTransformerConfig:
     gelu_checkpoint: bool = False
     adjust_init_range: bool = True
     attn_dropout_checkpoint: bool = False
+    # Relaxed-precision fast path (the reference builds a second kernel
+    # variant with -D__STOCHASTIC_MODE__, setup.py:44-118, surfaced at
+    # deepspeed_cuda.py:60-79: slightly faster, run-to-run nondeterministic,
+    # "acceptable for pretraining"). TPU analog: LayerNorm statistics stay
+    # in the compute dtype (bf16/fp16) instead of upcasting to fp32 —
+    # trims the widest HBM-bound elementwise chain in the block. No-op
+    # under fp32 compute.
     stochastic_mode: bool = False
     huggingface: bool = False
     layer_norm_eps: float = 1e-12
@@ -116,6 +123,34 @@ def resolve_remat_policy(spec: str):
     if not policies:
         raise ValueError(f"unresolvable remat policy spec: {spec!r}")
     return _ft.reduce(jax.checkpoint_policies.save_from_both_policies, policies)
+
+
+_STOCHASTIC_NOTICED = [False, False]  # [active-path notice, no-op notice]
+
+
+def _notice_stochastic_once(active: bool, dtype=None):
+    idx = 0 if active else 1
+    if _STOCHASTIC_NOTICED[idx]:
+        return
+    _STOCHASTIC_NOTICED[idx] = True
+    from ..utils.logging import log_dist
+
+    if active:
+        log_dist(
+            "stochastic_mode: relaxed-precision transformer path active — "
+            "LayerNorm statistics in bf16 (fp32 upcast skipped). Matches "
+            "the reference's __STOCHASTIC_MODE__ kernel contract: faster, "
+            "pretraining-safe, not bit-deterministic vs the default path.",
+            ranks=[0],
+        )
+    else:
+        log_dist(
+            f"stochastic_mode requested but compute dtype is {dtype}; the "
+            "relaxed LayerNorm path applies only under bf16 (fp16's range "
+            "would overflow the statistics) — running the default "
+            "fp32-statistics path.",
+            ranks=[0],
+        )
 
 
 #: The reference's 12-tensor parameter layout (deepspeed_cuda.py:393-520).
@@ -186,12 +221,30 @@ def transformer_block_apply(
         keep = jax.random.bernoulli(drop_rng, 1.0 - rate, x.shape)
         return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
+    if cfg.stochastic_mode:
+        _notice_stochastic_once(
+            active=hidden_states.dtype == jnp.bfloat16,
+            dtype=hidden_states.dtype,
+        )
+
     def layer_norm(x, scale, bias):
-        x32 = x.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=-1, keepdims=True)
-        var = jnp.var(x32, axis=-1, keepdims=True)
-        y = (x32 - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps)
-        return (y * scale + bias).astype(x.dtype)
+        # stochastic_mode keeps LN statistics in the compute dtype (the
+        # reference's __STOCHASTIC_MODE__ relaxed kernel); default is fp32.
+        # bf16 only: it shares fp32's exponent range, so x^2 cannot
+        # overflow the statistics — fp16 (range to 65504, eps underflow)
+        # always takes the fp32 path.
+        relaxed = cfg.stochastic_mode and x.dtype == jnp.bfloat16
+        xs = x if relaxed else x.astype(jnp.float32)
+        mean = jnp.mean(xs, axis=-1, keepdims=True)
+        var = jnp.var(xs, axis=-1, keepdims=True)
+        # eps joins in fp32 regardless: 1e-12 underflows in bf16/fp16
+        inv = jax.lax.rsqrt(
+            var.astype(jnp.float32) + cfg.layer_norm_eps
+        ).astype(xs.dtype)
+        y = (xs - mean) * inv
+        return (y * scale.astype(xs.dtype) + bias.astype(xs.dtype)).astype(
+            x.dtype
+        )
 
     def block(x):
         b, s, _ = x.shape

@@ -339,11 +339,15 @@ def load_checkpoint(
                 if master_restored:
                     # exact fp32 resume: the master partition overrides the
                     # (possibly down-cast) module weights — the reference's
-                    # load_from_fp32_weights=True path
+                    # load_from_fp32_weights=True path.  Dtype source is the
+                    # ENGINE's storage dtype (engine.params, fp32 for
+                    # non-master engines), NOT the module file's dtype —
+                    # a bf16 module file from a master-mode save must not
+                    # truncate this engine's fp32 storage.
                     engine.params = jax.device_put(
                         jax.tree_util.tree_map(
                             lambda m, cur: np.asarray(m).astype(cur.dtype),
-                            canonical["master"], params_np,
+                            canonical["master"], engine.params,
                         ),
                         engine._param_shardings,
                     )

@@ -342,6 +342,10 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.skipped_steps = 0
         self.last_overflow = False
+        # bf16/fp32 device-side skips reconcile lazily (one window late) —
+        # queued overflow flags still on device; see _finish_step
+        self._deferred_overflows = []
+        self._warned_unrollable_scheduler = False
         self.last_aux = ()  # extra model outputs (multi-output contract)
         self.lamb_coeffs = []
         self._training = True
@@ -581,7 +585,10 @@ class DeepSpeedEngine:
             if check_overflow:
                 overflow = has_overflow(grad_buffer)
             else:
-                overflow = ~jnp.isfinite(global_norm(grad_buffer))
+                # global_norm returns the reference's -1.0 SENTINEL for an
+                # inf/nan norm (deepspeed_utils.py:140-147) — never a
+                # non-finite value, so test the sentinel, not isfinite
+                overflow = global_norm(grad_buffer) < 0.0
 
             def do_update(operands):
                 params, opt_state, grads = operands
@@ -813,10 +820,14 @@ class DeepSpeedEngine:
             self.last_overflow = bool(overflow)
         else:
             # bf16/fp32: the jitted update still skips on a non-finite grad
-            # norm (params stay safe on device), but the loop stays fully
-            # async — the next window dispatches while this one runs, and a
-            # rare device-side skip isn't reflected in host-side counters.
+            # norm (params stay safe on device) and the loop stays fully
+            # async — counters advance OPTIMISTICALLY now and the device
+            # flag is reconciled ONE WINDOW LATE (below), so skipped_steps /
+            # global_steps / the LR schedule end up truthful without a
+            # per-step host sync (reference accounting contract:
+            # deepspeed_light.py:858-869).
             self.last_overflow = False
+            self._deferred_overflows.append(overflow)
         if self.last_overflow:
             self.skipped_steps += 1
             log_dist(
@@ -841,16 +852,74 @@ class DeepSpeedEngine:
                 ranks=[0],
             )
         if self.monitor.enabled and not self.last_overflow:
-            scalars = {
-                "Train/lr": float(self.get_lr()[0] if isinstance(
-                    self.get_lr(), (list, tuple)) else self.get_lr()),
-                "Train/loss_scale": float(self.loss_scale_state.loss_scale),
-            }
-            if window_loss is not None:
-                scalars["Train/loss"] = float(window_loss)
-            if grad_norm is not None:
-                scalars["Train/grad_norm"] = float(grad_norm)
-            self.monitor.write_scalars(scalars, self.global_steps)
+            # the jitted update returns the -1.0 SENTINEL grad norm when it
+            # skipped on device (bf16/fp32 async path) — that window's
+            # optimistic step number gets revoked by the reconcile below,
+            # so don't emit scalars for it
+            gn = float(grad_norm) if grad_norm is not None else None
+            if gn is None or gn >= 0.0:
+                scalars = {
+                    "Train/lr": float(self.get_lr()[0] if isinstance(
+                        self.get_lr(), (list, tuple)) else self.get_lr()),
+                    "Train/loss_scale": float(
+                        self.loss_scale_state.loss_scale
+                    ),
+                }
+                if window_loss is not None:
+                    scalars["Train/loss"] = float(window_loss)
+                if gn is not None:
+                    scalars["Train/grad_norm"] = gn
+                self.monitor.write_scalars(scalars, self.global_steps)
+        # settle overflow flags from windows BEFORE this one: their compute
+        # has finished (or is about to — the current window is already
+        # dispatched, so the device stays busy while we wait). Runs after
+        # the monitor block so a PAST window's skip never suppresses the
+        # current window's scalars.
+        if len(self._deferred_overflows) > 1:
+            self._reconcile_deferred(keep_last=True)
+
+    def _reconcile_deferred(self, keep_last=True):
+        """Settle queued bf16/fp32 device-side overflow flags.
+
+        A window whose global grad norm came out non-finite was skipped ON
+        DEVICE by the jitted update; the host advanced its counters
+        optimistically.  Fetching the flag here (a window late, or forced at
+        a checkpoint/sync point with ``keep_last=False``) corrects
+        ``skipped_steps``/``global_steps`` and rolls the LR scheduler back
+        one tick, so a skipped window never advances the schedule — the
+        reference's semantics (deepspeed_light.py:858-869) without its
+        per-step host sync."""
+        keep = 1 if keep_last else 0
+        while len(self._deferred_overflows) > keep:
+            flag = self._deferred_overflows.pop(0)
+            if not bool(flag):
+                continue
+            # NOTE: last_overflow is deliberately NOT set here — it reports
+            # the CURRENT window (fp16 semantics); a past window's skip
+            # surfaces through skipped_steps/global_steps and the log line.
+            self.skipped_steps += 1
+            self.global_steps -= 1
+            rolled = False
+            if self.lr_scheduler is not None:
+                if hasattr(self.lr_scheduler, "last_batch_iteration"):
+                    self.lr_scheduler.last_batch_iteration -= 1
+                    rolled = True
+                elif not self._warned_unrollable_scheduler:
+                    self._warned_unrollable_scheduler = True
+                    log_dist(
+                        "WARNING: a device-side skipped step could not roll "
+                        "back the client LR scheduler (no "
+                        "last_batch_iteration attribute) — the schedule ran "
+                        "one tick ahead",
+                        ranks=[0],
+                    )
+            log_dist(
+                "SKIP (reconciled): non-finite grad norm skipped the update "
+                f"on device; counters corrected (skipped={self.skipped_steps},"
+                f" step={self.global_steps}"
+                + (", lr schedule rolled back" if rolled else "") + ")",
+                ranks=[0],
+            )
 
     def train_batch(self, batch_iter_or_batches):
         """Native fast path: run a full accumulation window (forward,
@@ -1050,6 +1119,9 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
         from .checkpointing import save_checkpoint as _save
 
+        # persisted counters must be truthful: settle ALL in-flight
+        # device-side skip flags, including the newest window's
+        self._reconcile_deferred(keep_last=False)
         return _save(self, save_dir, tag=tag, client_state=client_state or {})
 
     def load_checkpoint(
@@ -1058,10 +1130,20 @@ class DeepSpeedEngine:
     ):
         from .checkpointing import load_checkpoint as _load
 
-        return _load(
+        # flags queued before the restore belong to the DISCARDED timeline;
+        # reconciling them against the restored counters would corrupt the
+        # resumed run's step count and LR schedule. Stash rather than drop:
+        # a FAILED load leaves the old timeline running, which still owes
+        # its reconciliation.
+        stale_flags = self._deferred_overflows
+        self._deferred_overflows = []
+        result = _load(
             self,
             load_dir,
             tag=tag,
             load_optimizer_states=load_optimizer_states,
             load_lr_scheduler_states=load_lr_scheduler_states,
         )
+        if result[0] is None:
+            self._deferred_overflows = stale_flags
+        return result

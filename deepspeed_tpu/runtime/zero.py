@@ -124,25 +124,55 @@ def constrain(tree, specs):
 def optstate_specs_like(opt_state, param_specs, params):
     """Map param specs onto an optax-style optimizer state pytree.
 
-    Any optimizer-state leaf whose shape matches its corresponding param
-    gets the param's spec; scalar leaves (step counts etc.) are replicated.
+    Optimizer moments (``mu``/``nu``/master copies) are pytrees with the
+    *same structure* as ``params``, so each moment leaf's path ends with the
+    path of the param it belongs to.  Specs are therefore mapped **by tree
+    path** (longest matching path suffix whose shape also matches), which
+    keeps two same-shaped params that carry *different* model-parallel specs
+    (e.g. an attention out-proj vs an FF matrix under TP) on their own
+    layouts — the reference keeps optimizer state strictly per-param too
+    (deepspeed/pt/deepspeed_zero_optimizer.py:256-263).
+
+    A shape-based fallback is used only when it is unambiguous (every param
+    of that shape shares one spec); anything else is replicated.
     """
-    flat_params, _ = jax.tree_util.tree_flatten(params)
-    flat_specs = jax.tree_util.tree_leaves(
+    param_paths = {}
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_s = jax.tree_util.tree_leaves(
         param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
     )
-    shape_to_spec = {}
-    for p, s in zip(flat_params, flat_specs):
-        shape_to_spec.setdefault(tuple(p.shape), s)
+    for (path, p), s in zip(flat_p, flat_s):
+        param_paths[tuple(_key_token(k) for k in path)] = (tuple(p.shape), s)
 
-    def spec_for(leaf):
-        s = shape_to_spec.get(tuple(getattr(leaf, "shape", ())))
-        return s if s is not None else PartitionSpec()
+    shape_to_specs = {}
+    for shape, s in param_paths.values():
+        shape_to_specs.setdefault(shape, set()).add(s)
 
-    return jax.tree_util.tree_map(spec_for, opt_state)
+    def spec_for(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        toks = tuple(_key_token(k) for k in path)
+        for i in range(len(toks)):  # longest suffix first
+            hit = param_paths.get(toks[i:])
+            if hit is not None and hit[0] == shape:
+                return hit[1]
+        cands = shape_to_specs.get(shape)
+        if cands is not None and len(cands) == 1:
+            return next(iter(cands))
+        return PartitionSpec()
+
+    return jax.tree_util.tree_map_with_path(spec_for, opt_state)
 
 
 # ---------------------------------------------------------------------------
+def _key_token(k):
+    """Normalise a tree-path key (DictKey/SequenceKey/GetAttrKey) to a token."""
+    for attr in ("key", "idx", "name"):
+        v = getattr(k, attr, None)
+        if v is not None:
+            return v
+    return str(k)
+
+
 def _tree_map_with_path(fn, tree):
     return jax.tree_util.tree_map_with_path(fn, tree)
 

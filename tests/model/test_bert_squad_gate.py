@@ -9,12 +9,16 @@ tokens the model must locate — so the same train-to-quality contract runs
 in seconds: engine fine-tune -> argmax span -> EM >= 0.9.
 """
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu
 from deepspeed_tpu.models import BertConfig, BertForQuestionAnswering
+
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
 
 VOCAB, SEQ = 64, 64
 START_TOK, END_TOK = 2, 3

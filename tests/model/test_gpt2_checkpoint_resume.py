@@ -25,6 +25,8 @@ import deepspeed_tpu
 from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel, partition_specs
 from deepspeed_tpu.parallel.mesh import build_mesh
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 STEPS_BEFORE = 10
 STEPS_AFTER = 10
 BATCH = 8

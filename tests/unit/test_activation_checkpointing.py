@@ -11,6 +11,8 @@ from deepspeed_tpu import checkpointing as ckpt
 from deepspeed_tpu.parallel.mesh import build_mesh
 from deepspeed_tpu.parallel.mpu import TPUMpu
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 
 @pytest.fixture(autouse=True)
 def _reset_flags():

@@ -15,6 +15,8 @@ import pytest
 
 import importlib
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 attn_lib = importlib.import_module("deepspeed_tpu.ops.attention")
 from deepspeed_tpu.ops.attention import (
     attention,

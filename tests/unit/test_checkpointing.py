@@ -15,6 +15,8 @@ import deepspeed_tpu
 from deepspeed_tpu.parallel.mesh import build_mesh
 from tests.unit.simple_model import SimpleModel, config_dict, init_model, random_dataset
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 INPUT_DIM = 16
 
 

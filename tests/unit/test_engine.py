@@ -19,6 +19,8 @@ from tests.unit.simple_model import (
     random_dataset,
 )
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 INPUT_DIM = 16
 
 

@@ -9,6 +9,8 @@ fp32-param storage mode (the math is identical — only placement moves),
 fp16 overflow-skip integrity, and exact checkpoint resume.
 """
 
+import pytest
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -16,6 +18,8 @@ import numpy as np
 
 import deepspeed_tpu
 from deepspeed_tpu.parallel.mesh import build_mesh
+
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
 
 
 class MLP(nn.Module):
@@ -189,11 +193,24 @@ def test_checkpoint_crosses_master_layouts(tmp_path):
     e8b = _engine(master_weights=True, dp=8)
     _train(e8b, steps=8)
     e8b.save_checkpoint(str(tmp_path / "b"), tag="t")
+    master_saved = jax.tree_util.tree_map(
+        np.asarray, e8b.optimizer_state["master"]
+    )
     cont_b = _train(e8b, steps=8)
 
     e1b = _engine(master_weights=True, dp=1, seed=7)
     assert not e1b.master_in_opt
     e1b.load_checkpoint(str(tmp_path / "b"), tag="t")
+    # the engine's fp32 storage dtype must survive the bf16 module file:
+    # params come from the fp32 master partition BIT-EXACTLY, never
+    # truncated through the module file's bf16
+    for leaf in jax.tree_util.tree_leaves(e1b.params):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+    for saved, restored in zip(
+        jax.tree_util.tree_leaves(master_saved),
+        jax.tree_util.tree_leaves(e1b.params),
+    ):
+        np.testing.assert_array_equal(saved, np.asarray(restored))
     resumed_b = _train(e1b, steps=8)
     np.testing.assert_allclose(resumed_b, cont_b, rtol=1e-2)
 

@@ -17,6 +17,8 @@ from deepspeed_tpu.models import (
 )
 from jax.sharding import PartitionSpec as P
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 
 def tiny_gpt2():
     return GPT2Config(

@@ -18,6 +18,8 @@ from deepspeed_tpu.models.gpt2 import partition_specs
 from deepspeed_tpu.ops.moe import MoEConfig, MoEMLP, top_k_gating
 from deepspeed_tpu.parallel.mesh import build_mesh
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 
 def test_gating_respects_capacity_and_k():
     logits = jnp.asarray(

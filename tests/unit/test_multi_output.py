@@ -9,12 +9,16 @@ last_aux holds the window's aux [accum]-stacked; between forward() and
 step() it shows the latest micro-step's raw aux; in eval mode it is the
 raw aux of the last forward."""
 
+import pytest
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu
+
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
 
 
 class TwoHeadModel(nn.Module):

@@ -12,6 +12,8 @@ import pytest
 from deepspeed_tpu.ops.optimizers import Lamb
 from deepspeed_tpu.ops.pallas import BLOCK, FusedLamb
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 
 def _tree(seed=0):
     rng = np.random.default_rng(seed)

@@ -21,6 +21,8 @@ from deepspeed_tpu.models.gpt2 import partition_specs
 from deepspeed_tpu.parallel.mesh import build_mesh
 from deepspeed_tpu.parallel.pipeline import gpipe_spmd
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 
 def _toy_setup(n_stages=2, layers_per_stage=3, n_micro=4, mb=2, s=8, h=16):
     rng = np.random.default_rng(0)

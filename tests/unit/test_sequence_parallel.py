@@ -19,6 +19,8 @@ from deepspeed_tpu.parallel.sequence import (
     ulysses_attention,
 )
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 
 def _mesh(sp=4, dp=2, mp=1):
     return build_mesh(

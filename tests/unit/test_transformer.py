@@ -17,6 +17,8 @@ from deepspeed_tpu.ops.transformer import (
     DeepSpeedTransformerLayer,
 )
 
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
+
 
 def naive_layer_forward(params, x, cfg, causal=False, mask=None):
     """Hand-written baseline of the same block (the 'vendored BertEncoder'
@@ -98,6 +100,72 @@ def test_layer_parity_backward(pre_ln):
             np.asarray(g1[k]), np.asarray(g2[k]), rtol=2e-3, atol=2e-3,
             err_msg=f"grad mismatch for {k}",
         )
+
+
+def test_stochastic_mode_changes_bf16_path_and_warns():
+    """stochastic_mode must be a real behavior change (reference builds a
+    distinct relaxed kernel, setup.py:44-118), announced at rank 0 — never
+    a silent no-op: under bf16 the LN statistics stay in bf16, so outputs
+    differ from the default fp32-stat path while remaining close."""
+    from deepspeed_tpu.ops import transformer as tr
+
+    base = dict(
+        hidden_size=64, heads=4, attn_dropout_ratio=0.0,
+        hidden_dropout_ratio=0.0,
+    )
+    layer_d = DeepSpeedTransformerLayer(
+        config=DeepSpeedTransformerConfig(**base)
+    )
+    layer_s = DeepSpeedTransformerLayer(
+        config=DeepSpeedTransformerConfig(stochastic_mode=True, **base)
+    )
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 64, 64)), jnp.bfloat16)
+    params = layer_d.init(jax.random.PRNGKey(0), x, train=False)["params"]
+
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    ds_logger.addHandler(handler)
+    tr._STOCHASTIC_NOTICED[0] = False
+    try:
+        out_s = layer_s.apply({"params": params}, x, train=False)
+    finally:
+        ds_logger.removeHandler(handler)
+    assert any("stochastic_mode" in m for m in records)
+    out_d = layer_d.apply({"params": params}, x, train=False)
+    a, b = np.asarray(out_d, np.float32), np.asarray(out_s, np.float32)
+    assert not np.array_equal(a, b), "stochastic_mode must not be a no-op"
+    np.testing.assert_allclose(a, b, rtol=0.1, atol=0.1)
+
+
+def test_stochastic_mode_fp16_keeps_fp32_statistics():
+    """fp16's narrow range (max 65504; eps underflow) must NOT take the
+    relaxed path: outputs stay bit-identical to the default, and large
+    activations don't overflow the variance."""
+    base = dict(
+        hidden_size=64, heads=4, attn_dropout_ratio=0.0,
+        hidden_dropout_ratio=0.0,
+    )
+    layer_d = DeepSpeedTransformerLayer(
+        config=DeepSpeedTransformerConfig(**base)
+    )
+    layer_s = DeepSpeedTransformerLayer(
+        config=DeepSpeedTransformerConfig(stochastic_mode=True, **base)
+    )
+    rng = np.random.default_rng(4)
+    # scale drives |x - mean| past fp16's sqrt(max) so a relaxed fp16 var
+    # would overflow to inf
+    x = jnp.asarray(rng.normal(size=(2, 64, 64)) * 500.0, jnp.float16)
+    params = layer_d.init(jax.random.PRNGKey(0), x, train=False)["params"]
+    out_d = layer_d.apply({"params": params}, x, train=False)
+    out_s = layer_s.apply({"params": params}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(out_d), np.asarray(out_s))
+    assert np.isfinite(np.asarray(out_s, np.float32)).all()
 
 
 def test_remat_modes_same_output():

@@ -1,0 +1,115 @@
+"""ZeRO partition-spec derivation, including the path-vs-shape mapping fix.
+
+The subtle case: two params with the SAME shape but DIFFERENT model-parallel
+specs (common under TP — an attention out-proj [H, H] sharded on dim 0 vs a
+square FF matrix [H, H] sharded on dim 1).  Optimizer moments must inherit
+each param's own spec, keyed by tree path, never by shape (reference keeps
+optimizer state strictly per-param: deepspeed_zero_optimizer.py:256-263).
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.runtime import zero as zero_lib
+
+
+def _params_same_shape():
+    return {
+        "attn_out": jnp.zeros((8, 8), jnp.float32),
+        "ff_in": jnp.zeros((8, 8), jnp.float32),
+        "bias": jnp.zeros((8,), jnp.float32),
+    }
+
+
+MODEL_SPECS = {
+    "attn_out": P("model", None),
+    "ff_in": P(None, "model"),
+    "bias": P(),
+}
+
+
+def test_optstate_specs_map_by_path_not_shape():
+    params = _params_same_shape()
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+    # param specs as the engine would derive them at stage 1 with TP specs
+    pspecs = zero_lib.zero_optstate_specs(
+        params, dp_size=2, stage=1, model_specs=MODEL_SPECS
+    )
+    # the two same-shaped params must carry different specs already
+    assert pspecs["attn_out"] != pspecs["ff_in"]
+    ospecs = zero_lib.optstate_specs_like(state, pspecs, params)
+    flat = jax.tree_util.tree_flatten_with_path(ospecs)[0]
+    seen = {}
+    for path, spec in flat:
+        toks = tuple(getattr(k, "key", getattr(k, "idx", None)) for k in path)
+        for name in ("attn_out", "ff_in", "bias"):
+            if toks and toks[-1] == name:
+                seen.setdefault(name, set()).add(spec)
+    # every moment leaf for a param carries exactly that param's spec
+    assert seen["attn_out"] == {pspecs["attn_out"]}
+    assert seen["ff_in"] == {pspecs["ff_in"]}
+    assert seen["bias"] == {pspecs["bias"]}
+
+
+def test_optstate_scalar_leaves_replicated():
+    params = _params_same_shape()
+    state = optax.adam(1e-3).init(params)
+    pspecs = zero_lib.zero_optstate_specs(params, dp_size=2, stage=1)
+    ospecs = zero_lib.optstate_specs_like(state, pspecs, params)
+    # adam's count is a scalar — must be replicated
+    counts = [
+        s
+        for path, s in jax.tree_util.tree_flatten_with_path(ospecs)[0]
+        if any("count" in str(k) for k in path)
+    ]
+    assert counts and all(s == P() for s in counts)
+
+
+def test_optstate_shape_fallback_when_unambiguous():
+    # a leaf whose path does not suffix-match any param (e.g. an optimizer
+    # with renamed inner trees) still gets the spec when the shape is unique
+    params = {"w": jnp.zeros((8, 4), jnp.float32)}
+    pspecs = zero_lib.zero_optstate_specs(params, dp_size=2, stage=1)
+    odd_state = {"momentum_buf": jnp.zeros((8, 4), jnp.float32)}
+    ospecs = zero_lib.optstate_specs_like(odd_state, pspecs, params)
+    assert ospecs["momentum_buf"] == pspecs["w"]
+
+
+def test_optstate_ambiguous_shape_without_path_is_replicated():
+    # same shape, different specs, and a path that matches neither param:
+    # replication is the only safe answer
+    params = _params_same_shape()
+    pspecs = zero_lib.zero_optstate_specs(
+        params, dp_size=2, stage=1, model_specs=MODEL_SPECS
+    )
+    odd_state = {"mystery": jnp.zeros((8, 8), jnp.float32)}
+    ospecs = zero_lib.optstate_specs_like(odd_state, pspecs, params)
+    assert ospecs["mystery"] == P()
+
+
+@pytest.mark.parametrize("stage", [1, 2])
+def test_engine_moments_follow_param_tp_specs(stage):
+    """End-to-end: engine-derived moment shardings equal each param's own."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    params = _params_same_shape()
+    pspecs = zero_lib.zero_optstate_specs(
+        params, dp_size=2, stage=stage, model_specs=MODEL_SPECS
+    )
+    state = optax.adam(1e-3).init(params)
+    ospecs = zero_lib.optstate_specs_like(state, pspecs, params)
+    shardings = zero_lib.specs_to_shardings(ospecs, mesh)
+    placed = jax.device_put(state, shardings)
+    mu = placed[0].mu
+    assert mu["attn_out"].sharding == NamedSharding(mesh, pspecs["attn_out"])
+    assert mu["ff_in"].sharding == NamedSharding(mesh, pspecs["ff_in"])
+    assert (
+        mu["attn_out"].sharding.spec != mu["ff_in"].sharding.spec
+    ), "same-shaped params must keep distinct moment layouts"
