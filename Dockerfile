@@ -1,0 +1,32 @@
+# deepspeed_tpu container image (reference analog: /root/reference/Dockerfile,
+# which provisions CUDA + apex + DeepSpeed; a TPU VM image needs only the
+# JAX TPU stack + the host-ops C++ extension).
+#
+#   docker build -t deepspeed_tpu .
+#   docker run --privileged deepspeed_tpu python basic_install_test.py
+#
+# On real TPU VMs, --privileged (or the TPU device mounts) exposes the
+# accelerator; the image also works CPU-only for CI (JAX_PLATFORMS=cpu).
+
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        build-essential g++ openssh-client pdsh \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/deepspeed_tpu
+
+# JAX for TPU; the extra index serves libtpu wheels. CPU-only CI images can
+# build with --build-arg JAX_TARGET=jax (no TPU extras).
+ARG JAX_TARGET="jax[tpu] -f https://storage.googleapis.com/jax-releases/libtpu_releases.html"
+RUN pip install --no-cache-dir ${JAX_TARGET} flax optax numpy pytest
+
+COPY . .
+RUN pip install --no-cache-dir -e . \
+    && python setup.py build_ext --inplace
+
+# import + one-step CPU train smoke test at build time keeps broken images
+# from shipping (reference basic_install_test.py analog)
+RUN JAX_PLATFORMS=cpu python basic_install_test.py
+
+CMD ["python", "basic_install_test.py"]

@@ -1,0 +1,97 @@
+"""Install smoke test (reference analog: basic_install_test.py — import the
+package, check the native extension, run one training step).
+
+Run after `pip install` / inside the Docker image:
+
+    python basic_install_test.py
+
+Exits non-zero on any failure; prints PASS lines as it goes.
+"""
+
+import sys
+
+
+def check(label, fn):
+    try:
+        fn()
+    except Exception as e:  # noqa: BLE001
+        print(f"FAIL {label}: {type(e).__name__}: {e}")
+        sys.exit(1)
+    print(f"PASS {label}")
+
+
+def test_import():
+    import deepspeed_tpu
+
+    assert hasattr(deepspeed_tpu, "initialize")
+    assert deepspeed_tpu.__version__
+
+
+def test_native_extension():
+    # best-effort: the host-ops extension accelerates the dataloader but the
+    # package must work (with the Python fallback) when it isn't built
+    from deepspeed_tpu.runtime import host_ops
+
+    if host_ops.HAVE_NATIVE:
+        print("  (native host-ops extension loaded)")
+    else:
+        print("  (native host-ops extension not built; Python fallback OK)")
+
+
+def test_one_train_step():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, y, train=True):
+            logp = jax.nn.log_softmax(nn.Dense(4)(nn.relu(nn.Dense(16)(x))))
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(8, 8)).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.int32)
+    model = MLP()
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.asarray(X), jnp.asarray(Y)
+    )["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 1000,
+        },
+    )
+    first = None
+    for _ in range(5):
+        loss = engine(X, Y)
+        engine.backward(loss)
+        engine.step()
+        first = float(loss) if first is None else first
+    assert float(loss) <= first, (first, float(loss))
+    print(f"  (loss {first:.4f} -> {float(loss):.4f} on "
+          f"{jax.devices()[0].platform})")
+
+
+def test_launcher_entrypoints():
+    from deepspeed_tpu.launcher import launch, runner
+
+    assert callable(runner.main) and callable(launch.main)
+    pool = runner.parse_resource_filter(
+        {"worker-0": [0, 1, 2, 3]}, include_str="worker-0:0,1"
+    )
+    assert pool == {"worker-0": [0, 1]}
+
+
+if __name__ == "__main__":
+    check("import deepspeed_tpu", test_import)
+    check("native host-ops extension", test_native_extension)
+    check("one training step", test_one_train_step)
+    check("launcher entrypoints", test_launcher_entrypoints)
+    print("basic install test: ALL PASS")

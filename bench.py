@@ -58,13 +58,24 @@ GPT2_MODELS = ["gpt2_1.5b", "gpt2_large_774m", "gpt2_medium_355m"]
 # backward: measured 8.0k -> 13.1k tokens/s together with the 512-block
 # kernel defaults on gpt2-large.
 GPT2_POLICY = "dots_with_no_batch_dims_saveable+flash_out+flash_lse"
+# (policy, micro, optimizer_state_dtype) ladder; fp32 state first (exact
+# reference semantics), reduced-state rungs unlock models whose fp32 state
+# alone exceeds HBM (selected per-model in bench_gpt2).
 GPT2_ATTEMPTS = [
-    (GPT2_POLICY, 8),
-    (GPT2_POLICY, 4),
-    ("dots_with_no_batch_dims_saveable", 4),
-    ("full", 4),
-    ("full", 2),
-    ("full", 1),
+    (GPT2_POLICY, 8, "fp32"),
+    (GPT2_POLICY, 4, "fp32"),
+    ("dots_with_no_batch_dims_saveable", 4, "fp32"),
+    ("full", 4, "fp32"),
+    ("full", 2, "fp32"),
+    ("full", 1, "fp32"),
+]
+# ladder when fp32 optimizer state cannot fit (e.g. 1.5B on 16 GB):
+# fp32 params-as-master + int8 mu + bf16 nu = 9 bytes/param of state
+GPT2_REDUCED_ATTEMPTS = [
+    (GPT2_POLICY, 4, "int8"),
+    (GPT2_POLICY, 2, "int8"),
+    (GPT2_POLICY, 1, "int8"),
+    ("full", 1, "int8"),
 ]
 
 
@@ -260,7 +271,7 @@ def squad_attempt(policy, micro):
     }
 
 
-def gpt2_attempt(model_name, policy, micro):
+def gpt2_attempt(model_name, policy, micro, state_dtype="fp32"):
     import dataclasses
 
     import jax
@@ -293,9 +304,25 @@ def gpt2_attempt(model_name, policy, micro):
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
             "bf16": {"enabled": True},
             "zero_optimization": {"stage": 2},
+            # reduced-precision Adam moments (ops/quant.py) put 1.5B's
+            # state on one 16 GB chip — the single-chip "train models that
+            # don't fit" capability (ZeRO-Offload's role in the reference
+            # family; 8-bit-optimizer formulation on TPU). bf16 grad
+            # accumulation matches the reference's fp16-grads-until-the-
+            # master-step layout and halves the grad tree.
+            "data_types": {
+                "optimizer_state_dtype": state_dtype,
+                "grad_accum_dtype": "bf16" if state_dtype != "fp32" else "fp32",
+                # compensated masters: bf16 params + int8 Kahan codes — no
+                # fp32 param bytes and no bf16 cast copies through backward
+                "master_dtype": (
+                    "compensated" if state_dtype != "fp32" else "fp32"
+                ),
+            },
             "steps_per_print": 10_000,
         },
     )
+    del params
     sec_per_window = _measure_engine(
         engine, [(ids, ids)], 1, warmup_windows=2, measure_windows=6,
     )
@@ -311,6 +338,7 @@ def gpt2_attempt(model_name, policy, micro):
         "baseline_tokens_per_sec": round(baseline_tps, 1),
         "micro_batch": micro,
         "remat_policy": policy,
+        "optimizer_state_dtype": state_dtype,
         "model_tflops": round(tflops, 1),
         "n_params_m": round(n_params / 1e6),
     }
@@ -327,7 +355,10 @@ def _worker_main():
         elif spec["kind"] == "squad":
             result = squad_attempt(spec["policy"], spec["micro"])
         else:
-            result = gpt2_attempt(spec["model"], spec["policy"], spec["micro"])
+            result = gpt2_attempt(
+                spec["model"], spec["policy"], spec["micro"],
+                state_dtype=spec.get("state_dtype", "fp32"),
+            )
     except Exception as e:  # noqa: BLE001
         if _is_oom(e):
             log(f"worker OOM: {type(e).__name__}")
@@ -449,6 +480,16 @@ def bench_squad():
     return None
 
 
+STATE_BYTES_PER_PARAM = {
+    # fp32 ladder: fp32 params(4) + fp32 grads(4) + fp32 m+v(8)
+    # reduced ladders: bf16 params(2) + int8 comp(1) + bf16 grads(2) +
+    # moments bf16 m+v(4) / int8 mu + bf16 nu(3)
+    "fp32": 16,
+    "bf16": 9,
+    "int8": 8,
+}
+
+
 def bench_gpt2():
     models = GPT2_MODELS
     name_env = os.environ.get("BENCH_GPT2")
@@ -456,20 +497,35 @@ def bench_gpt2():
         models = [m for m in models if m == name_env]
     hbm_bytes = float(os.environ.get("BENCH_HBM_GB", "16")) * 1e9
     for name in models:
-        # fp32 params + grads + Adam m + v = 16 bytes/param of pure state;
-        # if that alone exceeds HBM, no micro-batch can save the attempt.
-        state_bytes = 16 * _gpt2_params_estimate(name)
-        if state_bytes > 0.95 * hbm_bytes:
+        n = _gpt2_params_estimate(name)
+        fits = lambda sd: STATE_BYTES_PER_PARAM[sd] * n <= 0.92 * hbm_bytes
+        if fits("fp32"):
+            attempts = GPT2_ATTEMPTS
+        elif fits("int8"):
+            # fp32 Adam state alone exceeds HBM: reduced-precision moment
+            # storage (data_types.optimizer_state_dtype) is the single-chip
+            # path for this model
             log(
-                f"GPT-2 {name}: optimizer+grad state alone needs "
-                f"{state_bytes / 1e9:.1f} GB > {hbm_bytes / 1e9:.1f} GB HBM; "
+                f"GPT-2 {name}: fp32 optimizer state needs "
+                f"{14 * n / 1e9:.1f} GB > {hbm_bytes / 1e9:.1f} GB HBM; "
+                "using reduced-precision moment storage (int8 mu/bf16 nu)"
+            )
+            attempts = GPT2_REDUCED_ATTEMPTS
+        else:
+            log(
+                f"GPT-2 {name}: even int8-moment state needs "
+                f"{9 * n / 1e9:.1f} GB > {hbm_bytes / 1e9:.1f} GB HBM; "
                 "skipping (this is the model ZeRO shards across chips)"
             )
             continue
-        for policy, micro in GPT2_ATTEMPTS:
-            log(f"GPT-2 {name} attempt: micro={micro} policy={policy}")
+        for policy, micro, sd in attempts:
+            log(
+                f"GPT-2 {name} attempt: micro={micro} policy={policy} "
+                f"state={sd}"
+            )
             result = _run_attempt(
-                {"kind": "gpt2", "model": name, "policy": policy, "micro": micro}
+                {"kind": "gpt2", "model": name, "policy": policy,
+                 "micro": micro, "state_dtype": sd}
             )
             if result is not None:
                 return result

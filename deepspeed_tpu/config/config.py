@@ -151,6 +151,22 @@ class DeepSpeedConfig:
                 f"{C.GRAD_ACCUM_DTYPE} must be one of fp32/bf16/fp16, got "
                 f"{self.grad_accum_dtype!r}"
             )
+        self.optimizer_state_dtype = get_scalar_param(
+            dt_dict, C.OPTIMIZER_STATE_DTYPE, C.OPTIMIZER_STATE_DTYPE_DEFAULT
+        )
+        if self.optimizer_state_dtype not in ("fp32", "bf16", "int8"):
+            raise DeepSpeedConfigError(
+                f"{C.OPTIMIZER_STATE_DTYPE} must be one of fp32/bf16/int8, "
+                f"got {self.optimizer_state_dtype!r}"
+            )
+        self.master_dtype = get_scalar_param(
+            dt_dict, C.MASTER_DTYPE, C.MASTER_DTYPE_DEFAULT
+        )
+        if self.master_dtype not in ("fp32", "compensated"):
+            raise DeepSpeedConfigError(
+                f"{C.MASTER_DTYPE} must be 'fp32' or 'compensated', got "
+                f"{self.master_dtype!r}"
+            )
 
         # optimizer / scheduler
         optimizer_dict = get_dict_param(pd, C.OPTIMIZER)

@@ -114,6 +114,22 @@ FP16_MIN_LOSS_SCALE_DEFAULT = 1
 DATA_TYPES = "data_types"
 GRAD_ACCUM_DTYPE = "grad_accum_dtype"
 GRAD_ACCUM_DTYPE_DEFAULT = "fp32"
+# Optimizer-moment STORAGE format: fp32 (exact default), bf16, or int8
+# (blockwise-quantized, ops/quant.py). Reduced formats shrink persistent
+# optimizer HBM ~2x/4x so billion-param models fit a single chip — the
+# TPU-native counterpart of the reference family's ZeRO-Offload memory
+# relief (update math stays fp32 either way).
+OPTIMIZER_STATE_DTYPE = "optimizer_state_dtype"
+OPTIMIZER_STATE_DTYPE_DEFAULT = "fp32"
+# Master-weight storage: "fp32" (exact fp32 master — as params when
+# replicated, inside the sharded optimizer state under ZeRO master mode) or
+# "compensated" (params stay in the compute dtype and an int8 Kahan error
+# code in the optimizer state carries the rounding residue — ops/quant.py).
+# Compensated masters remove both the fp32 param bytes AND the bf16 cast
+# copies backward keeps alive, the final enabler for GPT-2 1.5B on one
+# 16 GB chip.
+MASTER_DTYPE = "master_dtype"
+MASTER_DTYPE_DEFAULT = "fp32"
 
 # BF16 (TPU-native precision; no loss scaling required)
 #############################################

@@ -56,6 +56,11 @@ class GPT2Config:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 1e-2
+    # Blocked LM-head cross-entropy (ops/cross_entropy.py): stream the
+    # [B*S, vocab] logits through the tied head in ce_block_rows chunks so
+    # neither the bf16 logits plane nor its fp32 softmax copy is ever
+    # materialized (the biggest GPT-2 transient). 0 disables (naive path).
+    ce_block_rows: int = 512
     # Device mesh forwarded to the transformer layers: enables the
     # sequence-parallel (ring/Ulysses) path when the mesh has a >1
     # ``sequence`` axis, and per-shard flash via shard_map under dp/mp.
@@ -329,11 +334,20 @@ class GPT2LMHeadModel(nn.Module):
         out = GPT2Model(self.config, name="transformer")(input_ids, train=train)
         x, wte = out[0], out[1]
         moe_aux = out[2] if len(out) == 3 else None
-        logits = x @ wte.T  # tied lm head
         if labels is None:
-            return logits
+            return x @ wte.T  # tied lm head
         # next-token prediction: logits[:, :-1] vs labels[:, 1:]
-        lm_loss = cross_entropy_ignore_index(logits[:, :-1], labels[:, 1:])
+        if self.config.ce_block_rows > 0:
+            from ..ops.cross_entropy import blocked_lm_head_loss
+
+            lm_loss = blocked_lm_head_loss(
+                x[:, :-1], wte, labels[:, 1:],
+                block_rows=self.config.ce_block_rows,
+            )
+        else:
+            lm_loss = cross_entropy_ignore_index(
+                x[:, :-1] @ wte.T, labels[:, 1:]
+            )
         if moe_aux is None:
             return lm_loss
         return lm_loss + moe_aux, lm_loss, moe_aux

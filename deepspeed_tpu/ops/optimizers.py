@@ -37,13 +37,96 @@ def _tree_f32(tree):
     return jax.tree_util.tree_map(_f32, tree)
 
 
+# Leaves bigger than this (elements) update via lax.scan over their leading
+# axis: the fp32 working copies of a [48, 1600, 6400] stacked-layer leaf
+# are ~2 GB of HLO temps if the whole leaf updates at once — enough to OOM
+# a 16 GB chip that is already carrying GPT-2 1.5B state. Chunking bounds
+# the temp to one slice; the leading dim of nn.scan-stacked params is the
+# layer axis, so slices are whole layers.
+_CHUNK_ELEMENTS = 1 << 25  # 33.5M
+
+
+def _leaf_slices(p, m_st, v_st):
+    """Reshape a leaf's moment state so index [i] selects one leading-axis
+    slice; quantized {'q','scale'} state slices stay block-aligned (leaf
+    row-major order means slice i owns a contiguous run of blocks)."""
+    from .quant import BLOCK, is_quantized
+
+    L = p.shape[0]
+    per = p.size // L
+
+    def split(st):
+        if is_quantized(st):
+            if per % BLOCK:
+                return None  # slice boundary would split a block
+            return {
+                "q": st["q"].reshape(L, per),
+                "scale": st["scale"].reshape(L, per // BLOCK),
+            }
+        return st.reshape(L, *p.shape[1:])
+
+    m_sl, v_sl = split(m_st), split(v_st)
+    if m_sl is None or v_sl is None:
+        return None
+    return m_sl, v_sl
+
+
+def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None):
+    """Run ``leaf_fn`` slice-by-slice over the leading axis via lax.scan,
+    reassembling full-shape outputs; returns None when the leaf doesn't
+    decompose (callers fall back to the whole-leaf path). ``comp`` is an
+    optional param-shaped int8 compensation leaf (sliced alongside)."""
+    from .quant import is_quantized
+
+    if p.ndim < 2 or p.shape[0] <= 1 or p.size < _CHUNK_ELEMENTS:
+        return None
+    sl = _leaf_slices(p, m_st, v_st)
+    if sl is None:
+        return None
+    m_sl, v_sl = sl
+
+    if comp is None:
+
+        def body(_, xs):
+            pi, gi, mi, vi = xs
+            return None, leaf_fn(pi, gi, mi, vi)
+
+        _, outs = jax.lax.scan(body, None, (p, g, m_sl, v_sl))
+    else:
+
+        def body(_, xs):
+            pi, gi, mi, vi, ci = xs
+            return None, leaf_fn(pi, gi, mi, vi, ci)
+
+        _, outs = jax.lax.scan(body, None, (p, g, m_sl, v_sl, comp))
+    p_new, m_new, v_new = outs[0], outs[1], outs[2]
+    if is_quantized(m_st):
+        m_new = {
+            "q": m_new["q"].reshape(-1), "scale": m_new["scale"].reshape(-1)
+        }
+    if is_quantized(v_st):
+        v_new = {
+            "q": v_new["q"].reshape(-1), "scale": v_new["scale"].reshape(-1)
+        }
+    return (p_new, m_new, v_new) + ((outs[3],) if comp is not None else ())
+
+
 class Optimizer:
-    """Base class; subclasses implement leaf-wise update math."""
+    """Base class; subclasses implement leaf-wise update math.
+
+    ``grad_scale``: optional scalar folded into each leaf's fp32 grad cast
+    (g32 = f32(g) * grad_scale). The engine passes its combined
+    loss-unscale x clip factor here so gradients stay in the accumulation
+    dtype end-to-end — materializing a pre-scaled fp32 copy of a
+    billion-param grad tree (~6 GB) is what OOMed GPT-2 1.5B on one chip.
+    """
 
     def init(self, params) -> Dict[str, Any]:
         raise NotImplementedError
 
-    def apply(self, params, grads, state, lr) -> Tuple[Any, Dict[str, Any], Dict]:
+    def apply(
+        self, params, grads, state, lr, grad_scale=None
+    ) -> Tuple[Any, Dict[str, Any], Dict]:
         raise NotImplementedError
 
 
@@ -51,7 +134,13 @@ class Optimizer:
 class Adam(Optimizer):
     """Adam / AdamW. ``adam_w_mode=True`` decouples weight decay (AdamW);
     False applies L2-style decay added to the gradient (classic Adam+wd),
-    matching apex FusedAdam's two modes."""
+    matching apex FusedAdam's two modes.
+
+    ``state_dtype`` selects the moment STORAGE format ("fp32" default,
+    "bf16", or "int8" blockwise — ops/quant.py): the update math always
+    runs in fp32 transiently; reduced formats shrink persistent HBM so
+    models like GPT-2 1.5B fit a single 16 GB chip (the memory relief the
+    reference family later shipped as ZeRO-Offload)."""
 
     b1: float = 0.9
     b2: float = 0.999
@@ -59,18 +148,36 @@ class Adam(Optimizer):
     weight_decay: float = 0.0
     bias_correction: bool = True
     adam_w_mode: bool = True
+    state_dtype: str = "fp32"
+    # Kahan-style compensated masters (ops/quant.py): params stay in the
+    # compute dtype (bf16) and an int8 per-element error code carries the
+    # rounding residue, replacing fp32 master storage AND the bf16 cast
+    # copies that fp32 storage forces through backward. Enabled by the
+    # engine for single-chip billion-param runs (data_types.master_dtype
+    # = "compensated").
+    master_compensation: bool = False
 
     def init(self, params):
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params
-        )
-        return {
-            "step": jnp.zeros((), jnp.int32),
-            "mu": zeros,
-            "nu": jax.tree_util.tree_map(jnp.copy, zeros),
-        }
+        from .quant import comp_zeros_like, moments_zeros_like
 
-    def apply(self, params, grads, state, lr):
+        state = {
+            "step": jnp.zeros((), jnp.int32),
+            "mu": moments_zeros_like(params, self.state_dtype, "mu"),
+            "nu": moments_zeros_like(params, self.state_dtype, "nu"),
+        }
+        if self.master_compensation:
+            state["comp"] = comp_zeros_like(params)
+        return state
+
+    def apply(self, params, grads, state, lr, grad_scale=None):
+        from .quant import (
+            decode_master,
+            decode_moment,
+            encode_master,
+            encode_moment,
+            moment_is_leaf,
+        )
+
         step = state["step"] + 1
         b1, b2 = self.b1, self.b2
         if self.bias_correction:
@@ -78,10 +185,15 @@ class Adam(Optimizer):
             c2 = 1.0 - b2 ** step.astype(jnp.float32)
         else:
             c1 = c2 = jnp.float32(1.0)
+        comped = self.master_compensation
 
-        def leaf(p, g, m, v):
+        def leaf(p, g, m_st, v_st, comp=None):
             g32 = _f32(g)
-            p32 = _f32(p)
+            if grad_scale is not None:
+                g32 = g32 * grad_scale
+            p32 = decode_master(p, comp) if comped else _f32(p)
+            m = decode_moment(m_st, p.shape)
+            v = decode_moment(v_st, p.shape)
             if self.weight_decay and not self.adam_w_mode:
                 g32 = g32 + self.weight_decay * p32
             m_new = b1 * m + (1.0 - b1) * g32
@@ -89,20 +201,38 @@ class Adam(Optimizer):
             update = (m_new / c1) / (jnp.sqrt(v_new / c2) + self.eps)
             if self.weight_decay and self.adam_w_mode:
                 update = update + self.weight_decay * p32
-            p_new = p32 - lr * update
-            return p_new.astype(p.dtype), m_new, v_new
+            master_new = p32 - lr * update
+            if comped:
+                p_new, comp_new = encode_master(master_new, p.dtype)
+            else:
+                p_new, comp_new = master_new.astype(p.dtype), None
+            out = (
+                p_new,
+                encode_moment(m_new, m_st),
+                encode_moment(v_new, v_st),
+            )
+            return out + ((comp_new,) if comped else ())
 
-        out = jax.tree_util.tree_map(leaf, params, grads, state["mu"], state["nu"])
-        new_params = jax.tree_util.tree_map(
-            lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple)
+        def leaf_outer(p, g, m_st, v_st, comp=None):
+            chunked = _chunked_leaf_update(leaf, p, g, m_st, v_st, comp)
+            return chunked if chunked is not None else leaf(p, g, m_st, v_st, comp)
+
+        trees = [params, grads, state["mu"], state["nu"]]
+        if comped:
+            trees.append(state["comp"])
+        out = jax.tree_util.tree_map(
+            leaf_outer, *trees, is_leaf=moment_is_leaf,
         )
-        new_mu = jax.tree_util.tree_map(
-            lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple)
-        )
-        new_nu = jax.tree_util.tree_map(
-            lambda t: t[2], out, is_leaf=lambda x: isinstance(x, tuple)
-        )
-        return new_params, {"step": step, "mu": new_mu, "nu": new_nu}, {}
+        is_tup = lambda x: isinstance(x, tuple)
+        new_params = jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=is_tup)
+        new_mu = jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=is_tup)
+        new_nu = jax.tree_util.tree_map(lambda t: t[2], out, is_leaf=is_tup)
+        new_state = {"step": step, "mu": new_mu, "nu": new_nu}
+        if comped:
+            new_state["comp"] = jax.tree_util.tree_map(
+                lambda t: t[3], out, is_leaf=is_tup
+            )
+        return new_params, new_state, {}
 
 
 @dataclasses.dataclass
@@ -125,18 +255,20 @@ class Lamb(Optimizer):
     max_coeff: float = 10.0
     min_coeff: float = 0.01
     eps_inside_sqrt: bool = False
+    state_dtype: str = "fp32"  # moment storage; see Adam.state_dtype
 
     def init(self, params):
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params
-        )
+        from .quant import moments_zeros_like
+
         return {
             "step": jnp.zeros((), jnp.int32),
-            "mu": zeros,
-            "nu": jax.tree_util.tree_map(jnp.copy, zeros),
+            "mu": moments_zeros_like(params, self.state_dtype, "mu"),
+            "nu": moments_zeros_like(params, self.state_dtype, "nu"),
         }
 
-    def apply(self, params, grads, state, lr):
+    def apply(self, params, grads, state, lr, grad_scale=None):
+        from .quant import decode_moment, encode_moment
+
         step = state["step"] + 1
         b1, b2 = self.b1, self.b2
         if self.bias_correction:
@@ -147,8 +279,12 @@ class Lamb(Optimizer):
 
         coeffs = []
 
-        def leaf(p, g, m, v):
+        def leaf(p, g, m_st, v_st):
             g32, p32 = _f32(g), _f32(p)
+            if grad_scale is not None:
+                g32 = g32 * grad_scale
+            m = decode_moment(m_st, p.shape)
+            v = decode_moment(v_st, p.shape)
             m_new = b1 * m + (1.0 - b1) * g32
             v_new = b2 * v + (1.0 - b2) * g32 * g32
             if self.eps_inside_sqrt:
@@ -167,7 +303,11 @@ class Lamb(Optimizer):
             )
             coeffs.append(ratio)
             p_new = p32 - lr * ratio * update
-            return p_new.astype(p.dtype), m_new, v_new
+            return (
+                p_new.astype(p.dtype),
+                encode_moment(m_new, m_st),
+                encode_moment(v_new, v_st),
+            )
 
         out = jax.tree_util.tree_map(leaf, params, grads, state["mu"], state["nu"])
         is_tup = lambda x: isinstance(x, tuple)
@@ -194,13 +334,15 @@ class SGD(Optimizer):
             }
         return {"step": jnp.zeros((), jnp.int32), "mom": None}
 
-    def apply(self, params, grads, state, lr):
+    def apply(self, params, grads, state, lr, grad_scale=None):
         step = state["step"] + 1
 
         if self.momentum:
 
             def leaf(p, g, m):
                 g32, p32 = _f32(g), _f32(p)
+                if grad_scale is not None:
+                    g32 = g32 * grad_scale
                 if self.weight_decay:
                     g32 = g32 + self.weight_decay * p32
                 m_new = self.momentum * m + g32
@@ -215,6 +357,8 @@ class SGD(Optimizer):
 
         def leaf_plain(p, g):
             g32, p32 = _f32(g), _f32(p)
+            if grad_scale is not None:
+                g32 = g32 * grad_scale
             if self.weight_decay:
                 g32 = g32 + self.weight_decay * p32
             return (p32 - lr * g32).astype(p.dtype)
@@ -240,11 +384,13 @@ class Lion(Optimizer):
             ),
         }
 
-    def apply(self, params, grads, state, lr):
+    def apply(self, params, grads, state, lr, grad_scale=None):
         step = state["step"] + 1
 
         def leaf(p, g, m):
             g32, p32 = _f32(g), _f32(p)
+            if grad_scale is not None:
+                g32 = g32 * grad_scale
             update = jnp.sign(self.b1 * m + (1.0 - self.b1) * g32)
             if self.weight_decay:
                 update = update + self.weight_decay * p32

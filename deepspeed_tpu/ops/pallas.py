@@ -167,13 +167,25 @@ class FusedLamb(Lamb):
     """LAMB backed by the Pallas phase-1 kernel; numerics identical to the
     pure-JAX `Lamb` (same trust-ratio clamp, same ``lamb_coeffs`` aux)."""
 
-    def apply(self, params, grads, state, lr):
+    def apply(self, params, grads, state, lr, grad_scale=None):
+        if self.state_dtype != "fp32":
+            raise ValueError(
+                "FusedLamb's Pallas kernel reads fp32 moments; use "
+                "optimizer type 'Lamb' for reduced state_dtype storage"
+            )
         step = state["step"] + 1
         if self.bias_correction:
             c1 = 1.0 - self.b1 ** step.astype(jnp.float32)
             c2 = 1.0 - self.b2 ** step.astype(jnp.float32)
         else:
             c1 = c2 = jnp.float32(1.0)
+        if grad_scale is not None:
+            # pre-scale per-leaf (the kernel takes raw grads); FusedLamb
+            # targets BERT-sized models where a scaled copy is cheap
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) * grad_scale).astype(g.dtype),
+                grads,
+            )
 
         coeffs = []
 

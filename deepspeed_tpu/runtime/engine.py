@@ -43,7 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import constants as C
-from ..config.config import DeepSpeedConfig
+from ..config.config import DeepSpeedConfig, DeepSpeedConfigError
 from ..ops.optimizers import Optimizer, build_optimizer
 from ..parallel import mesh as mesh_lib
 from ..parallel.mpu import TPUMpu
@@ -255,13 +255,23 @@ class DeepSpeedEngine:
         # the stage>=1-sharded optimizer state. Numerically identical to
         # storing fp32 params and casting each step; halves the
         # replicated param bytes under bf16/fp16.
+        # Compensated masters (data_types.master_dtype = "compensated"):
+        # params stored IN the compute dtype with an int8 Kahan error code
+        # in the optimizer state (ops/quant.py) — no fp32 master bytes and
+        # no bf16 cast copies through backward. Mutually exclusive with the
+        # fp32-master-in-opt layout below.
+        self.compensated_master = (
+            self.config.master_dtype == "compensated"
+            and self.compute_dtype != jnp.float32
+        )
         self.master_in_opt = (
-            self.compute_dtype != jnp.float32
+            not self.compensated_master
+            and self.compute_dtype != jnp.float32
             and stage >= 1
             and dp_size > 1  # dp=1: a master copy would only add bytes
             and getattr(self.config.zero_config, "master_weights", True)
         )
-        if self.master_in_opt:
+        if self.master_in_opt or self.compensated_master:
             self.params = jax.device_put(
                 jax.tree_util.tree_map(
                     lambda p: p.astype(self.compute_dtype), params_f32
@@ -474,7 +484,53 @@ class DeepSpeedEngine:
         name = self.config.optimizer_name
         if name is None:
             name = C.ADAM_OPTIMIZER
-        return build_optimizer(name, self.config.optimizer_params)
+        opt = build_optimizer(name, self.config.optimizer_params)
+        sd = self.config.optimizer_state_dtype
+        if sd == "int8" and self.zero_stage >= 1 and self.dp_world_size > 1:
+            # quantized {'q','scale'} moment leaves don't carry the param's
+            # partition layout, so under ZeRO they would silently REPLICATE
+            # — undoing the stage>=1 sharding. ZeRO already divides moment
+            # memory by dp; bf16 moments shard cleanly and keep the 2x.
+            log_dist(
+                "optimizer_state_dtype=int8 does not shard under ZeRO "
+                "stage>=1 (quantized leaves would replicate); storing "
+                "moments as bf16 instead (dp-sharded)",
+                ranks=[0],
+            )
+            sd = "bf16"
+        if sd != "fp32":
+            if not hasattr(opt, "state_dtype"):
+                raise DeepSpeedConfigError(
+                    f"optimizer {name!r} does not support "
+                    f"{C.OPTIMIZER_STATE_DTYPE}={sd!r} (Adam/AdamW/Lamb do)"
+                )
+            if type(opt).__name__ == "FusedLamb":
+                # surface at init, not at the first step's jit trace
+                raise DeepSpeedConfigError(
+                    "FusedLamb's Pallas kernel reads fp32 moments; use "
+                    "optimizer type 'Lamb' with reduced "
+                    f"{C.OPTIMIZER_STATE_DTYPE}"
+                )
+            opt.state_dtype = sd
+            log_dist(
+                f"optimizer moments stored as {sd} "
+                "(fp32 update math; ops/quant.py)",
+                ranks=[0],
+            )
+        if getattr(self, "compensated_master", False):
+            if not hasattr(opt, "master_compensation"):
+                raise DeepSpeedConfigError(
+                    f"optimizer {name!r} does not support "
+                    f"{C.MASTER_DTYPE}='compensated' (Adam/AdamW do)"
+                )
+            opt.master_compensation = True
+            log_dist(
+                "compensated master weights: params stored in the compute "
+                "dtype + int8 Kahan error codes in the optimizer state "
+                "(ops/quant.py)",
+                ranks=[0],
+            )
+        return opt
 
     def _configure_lr_scheduler(self):
         if self.client_lr_scheduler is not None:
@@ -530,19 +586,27 @@ class DeepSpeedEngine:
                 batch,
             )
 
-        def scaled_loss_fn(params, batch, rng, loss_scale):
-            out = loss_fn(cast_params(params), cast_batch(batch), rng)
-            loss, aux = _split_model_output(out)
-            return (
-                loss.astype(jnp.float32) * loss_scale / accum,
-                (loss, aux),
-            )
-
         accum_dtype = self.grad_accum_dtype
 
         def fwd_bwd(params, batch, rng, loss_scale):
+            # Differentiate w.r.t. the COMPUTE-dtype params (cast applied
+            # OUTSIDE jax.grad): the cast's derivative is 1, so grads are
+            # identical, but cotangents stay bf16 end-to-end instead of
+            # being up-converted to match fp32 param storage — at GPT-2
+            # 1.5B those fp32 cotangent temps are several GB of HLO temp
+            # that decide whether one 16 GB chip fits the model.
+            params_c = cast_params(params)
+
+            def scaled_loss_fn(pc):
+                out = loss_fn(pc, cast_batch(batch), rng)
+                loss, aux = _split_model_output(out)
+                return (
+                    loss.astype(jnp.float32) * loss_scale / accum,
+                    (loss, aux),
+                )
+
             grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
-                params, batch, rng, loss_scale
+                params_c
             )
             grads = jax.tree_util.tree_map(
                 lambda g, s: jax.lax.with_sharding_constraint(
@@ -582,36 +646,37 @@ class DeepSpeedEngine:
 
         def update_body(params, opt_state, grad_buffer, scaler_state, lr):
             inv_scale = 1.0 / scaler_state.loss_scale
+            # ONE fp32 reduction over the accumulation-dtype buffer; the
+            # scalar unscale factors out of the norm (||g/s|| = ||g||/s) so
+            # no fp32 copy of the grad tree is ever materialized — at
+            # GPT-2 1.5B that copy is ~6 GB, the difference between fitting
+            # one 16 GB chip and OOM.
+            raw_norm = global_norm(grad_buffer)  # -1.0 sentinel if inf/nan
             if check_overflow:
                 overflow = has_overflow(grad_buffer)
             else:
                 # global_norm returns the reference's -1.0 SENTINEL for an
                 # inf/nan norm (deepspeed_utils.py:140-147) — never a
                 # non-finite value, so test the sentinel, not isfinite
-                overflow = global_norm(grad_buffer) < 0.0
+                overflow = raw_norm < 0.0
 
             def do_update(operands):
                 params, opt_state, grads = operands
-                # unscale in fp32 regardless of the accumulation dtype
-                grads = jax.tree_util.tree_map(
-                    lambda g: g.astype(jnp.float32) * inv_scale, grads
-                )
+                grad_norm = raw_norm * inv_scale  # post-unscale norm
+                gscale = inv_scale
                 if clip > 0:
-                    norm = global_norm(grads)
-                    scale = jnp.where(
-                        (norm > clip) & (norm > 0), clip / norm, jnp.float32(1.0)
+                    gscale = gscale * jnp.where(
+                        (grad_norm > clip) & (grad_norm > 0),
+                        clip / grad_norm, jnp.float32(1.0),
                     )
-                    grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-                    grad_norm = norm
-                else:
-                    grad_norm = global_norm(grads)
                 if master_in_opt:
                     # step the fp32 master (sharded), then publish the
                     # compute-dtype params — the reference's fp32-partition
                     # step + fp16 copy (deepspeed_zero_optimizer.py:
                     # 1157-1199), with the all-gather left to GSPMD
                     new_master, new_inner, aux = optimizer.apply(
-                        opt_state["master"], grads, opt_state["inner"], lr
+                        opt_state["master"], grads, opt_state["inner"], lr,
+                        grad_scale=gscale,
                     )
                     new_opt = {"master": new_master, "inner": new_inner}
                     new_params = jax.tree_util.tree_map(
@@ -619,7 +684,7 @@ class DeepSpeedEngine:
                     )
                 else:
                     new_params, new_opt, aux = optimizer.apply(
-                        params, grads, opt_state, lr
+                        params, grads, opt_state, lr, grad_scale=gscale
                     )
                 coeffs = aux.get("lamb_coeffs", [])
                 coeff_vec = (

@@ -1,0 +1,184 @@
+"""Real-data SQuAD v1.1 fine-tune harness (opt-in).
+
+The reference's true quality gate fine-tunes BERT on SQuAD v1.1 and asserts
+EM 83.98 / F1 90.71 (reference: tests/model/BingBertSquad/test_e2e_squad.py:
+53-58, evaluate-v1.1 metric semantics).  This module reproduces that
+pipeline — wordpiece feature conversion with doc-stride windows, engine
+fine-tune, span extraction, official normalization for EM/F1 — against
+local data, since the environment has no network egress.
+
+Expected layout under ``$SQUAD_DATA_DIR``:
+    train-v1.1.json   dev-v1.1.json   vocab.txt
+and optionally pretrained weights the caller loads into the engine before
+fine-tuning (a from-scratch BERT cannot reach the gate).
+"""
+
+import collections
+import json
+import os
+import re
+import string
+
+
+# ----------------------------------------------------------- official metric
+def normalize_answer(s):
+    """Official SQuAD v1.1 normalization: lower, strip punct/articles/ws."""
+
+    def remove_articles(text):
+        return re.sub(r"\b(a|an|the)\b", " ", text)
+
+    def white_space_fix(text):
+        return " ".join(text.split())
+
+    def remove_punc(text):
+        exclude = set(string.punctuation)
+        return "".join(ch for ch in text if ch not in exclude)
+
+    return white_space_fix(remove_articles(remove_punc(s.lower())))
+
+
+def f1_score(prediction, ground_truth):
+    pred_tokens = normalize_answer(prediction).split()
+    gt_tokens = normalize_answer(ground_truth).split()
+    common = collections.Counter(pred_tokens) & collections.Counter(gt_tokens)
+    num_same = sum(common.values())
+    if num_same == 0:
+        return 0.0
+    precision = num_same / len(pred_tokens)
+    recall = num_same / len(gt_tokens)
+    return 2 * precision * recall / (precision + recall)
+
+
+def exact_match_score(prediction, ground_truth):
+    return float(normalize_answer(prediction) == normalize_answer(ground_truth))
+
+
+def metric_max_over_ground_truths(metric_fn, prediction, ground_truths):
+    return max(metric_fn(prediction, gt) for gt in ground_truths)
+
+
+def evaluate_squad(dataset, predictions):
+    """dataset: parsed dev-v1.1.json["data"]; predictions: {qid: text}.
+    Returns {"exact_match": pct, "f1": pct} (evaluate-v1.1.py semantics)."""
+    f1 = em = total = 0
+    for article in dataset:
+        for paragraph in article["paragraphs"]:
+            for qa in paragraph["qas"]:
+                total += 1
+                if qa["id"] not in predictions:
+                    continue
+                gts = [a["text"] for a in qa["answers"]]
+                pred = predictions[qa["id"]]
+                em += metric_max_over_ground_truths(exact_match_score, pred, gts)
+                f1 += metric_max_over_ground_truths(f1_score, pred, gts)
+    return {"exact_match": 100.0 * em / total, "f1": 100.0 * f1 / total}
+
+
+# -------------------------------------------------------- feature conversion
+def load_tokenizer(data_dir):
+    from transformers import BertTokenizerFast
+
+    return BertTokenizerFast(
+        vocab_file=os.path.join(data_dir, "vocab.txt"), do_lower_case=True
+    )
+
+
+def read_squad(path, training):
+    with open(path) as f:
+        data = json.load(f)["data"]
+    examples = []
+    for article in data:
+        for paragraph in article["paragraphs"]:
+            context = paragraph["context"]
+            for qa in paragraph["qas"]:
+                ex = {
+                    "qid": qa["id"],
+                    "question": qa["question"],
+                    "context": context,
+                }
+                if training:
+                    a = qa["answers"][0]
+                    ex["answer_start"] = a["answer_start"]
+                    ex["answer_text"] = a["text"]
+                examples.append(ex)
+    return examples, data
+
+
+def convert_examples(examples, tokenizer, max_seq=384, doc_stride=128,
+                     max_query=64, training=True):
+    """Tokenize question+context into windows (the reference harness's
+    convert_examples_to_features contract): returns a list of feature
+    dicts with input_ids/token_type_ids/start/end positions and, for eval,
+    offset mappings back into the context string."""
+    feats = []
+    for ex_idx, ex in enumerate(examples):
+        enc = tokenizer(
+            ex["question"][:512],
+            ex["context"],
+            truncation="only_second",
+            max_length=max_seq,
+            stride=doc_stride,
+            return_overflowing_tokens=True,
+            return_offsets_mapping=True,
+            padding="max_length",
+        )
+        for i in range(len(enc["input_ids"])):
+            offsets = enc["offset_mapping"][i]
+            type_ids = enc["token_type_ids"][i]
+            feat = {
+                "ex_idx": ex_idx,
+                "qid": ex["qid"],
+                "input_ids": enc["input_ids"][i],
+                "token_type_ids": type_ids,
+                "attention_mask": enc["attention_mask"][i],
+                "offsets": offsets,
+            }
+            if training:
+                a0 = ex["answer_start"]
+                a1 = a0 + len(ex["answer_text"])
+                start = end = 0  # [CLS] = "no answer in this window"
+                for t, (o0, o1) in enumerate(offsets):
+                    if type_ids[t] != 1:
+                        continue
+                    if o0 <= a0 < o1:
+                        start = t
+                    if o0 < a1 <= o1:
+                        end = t
+                if start == 0 or end == 0 or end < start:
+                    start = end = 0
+                feat["start_position"] = start
+                feat["end_position"] = end
+            feats.append(feat)
+    return feats
+
+
+def extract_predictions(examples, feats, all_start_logits, all_end_logits,
+                        n_best=20, max_answer_len=30):
+    """Argmax-span extraction with the reference's n-best window search."""
+    import numpy as np
+
+    by_qid = collections.defaultdict(list)
+    for fi, feat in enumerate(feats):
+        by_qid[feat["qid"]].append(fi)
+    predictions = {}
+    for ex in examples:
+        best_text, best_score = "", -1e9
+        for fi in by_qid[ex["qid"]]:
+            feat = feats[fi]
+            s_log, e_log = all_start_logits[fi], all_end_logits[fi]
+            s_idx = np.argsort(s_log)[-n_best:][::-1]
+            e_idx = np.argsort(e_log)[-n_best:][::-1]
+            for s in s_idx:
+                for e in e_idx:
+                    if e < s or e - s + 1 > max_answer_len:
+                        continue
+                    if feat["token_type_ids"][s] != 1 or feat["token_type_ids"][e] != 1:
+                        continue
+                    score = s_log[s] + e_log[e]
+                    if score > best_score:
+                        o0 = feat["offsets"][s][0]
+                        o1 = feat["offsets"][e][1]
+                        best_score = score
+                        best_text = ex["context"][o0:o1]
+        predictions[ex["qid"]] = best_text
+    return predictions

@@ -1,0 +1,162 @@
+"""Opt-in real-data SQuAD v1.1 gate (reference:
+tests/model/BingBertSquad/test_e2e_squad.py:53-58 asserts EM 83.98 /
+F1 90.71 after fine-tuning from a pretrained checkpoint, ~5 GPU-hours).
+
+Runs only when $SQUAD_DATA_DIR holds train-v1.1.json / dev-v1.1.json /
+vocab.txt (no network egress in CI, so this cannot be always-on); the
+synthetic distractor gate in test_bert_squad_gate.py is the fallback.
+Pretrained weights load from $BERT_CKPT_MSGPACK when provided — the full
+EM/F1 thresholds apply only then (a from-scratch BERT cannot reach them;
+without a checkpoint the test asserts the pipeline itself: loss decreases
+and the extraction produces non-degenerate spans).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+DATA_DIR = os.environ.get("SQUAD_DATA_DIR")
+needs_data = pytest.mark.skipif(
+    not (
+        DATA_DIR
+        and os.path.exists(os.path.join(DATA_DIR, "train-v1.1.json"))
+        and os.path.exists(os.path.join(DATA_DIR, "dev-v1.1.json"))
+        and os.path.exists(os.path.join(DATA_DIR, "vocab.txt"))
+    ),
+    reason="SQUAD_DATA_DIR with train/dev/vocab not provided",
+)
+
+
+@needs_data
+def test_squad_v11_real_data_gate():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import BertConfig, BertForQuestionAnswering
+    from tests.model import squad_harness as H
+
+    tok = H.load_tokenizer(DATA_DIR)
+    train_ex, _ = H.read_squad(
+        os.path.join(DATA_DIR, "train-v1.1.json"), training=True
+    )
+    dev_ex, dev_raw = H.read_squad(
+        os.path.join(DATA_DIR, "dev-v1.1.json"), training=False
+    )
+    max_train = int(os.environ.get("SQUAD_MAX_TRAIN", "0")) or len(train_ex)
+    max_dev = int(os.environ.get("SQUAD_MAX_DEV", "0")) or len(dev_ex)
+    train_feats = H.convert_examples(train_ex[:max_train], tok, training=True)
+    dev_feats = H.convert_examples(dev_ex[:max_dev], tok, training=False)
+
+    cfg = BertConfig(
+        vocab_size=tok.vocab_size, hidden_size=1024, num_hidden_layers=24,
+        num_attention_heads=16, intermediate_size=4096,
+        max_position_embeddings=512,
+    )
+    model = BertForQuestionAnswering(cfg)
+    f0 = train_feats[0]
+    ids0 = jnp.asarray([f0["input_ids"]], jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids0, None, None, jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+    )["params"]
+
+    ckpt = os.environ.get("BERT_CKPT_MSGPACK")
+    pretrained = bool(ckpt and os.path.exists(ckpt))
+    if pretrained:
+        from flax import serialization
+
+        with open(ckpt, "rb") as f:
+            params = serialization.from_bytes(params, f.read())
+
+    micro = int(os.environ.get("SQUAD_MICRO", "8"))
+    epochs = float(os.environ.get("SQUAD_EPOCHS", "2"))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": micro,
+            "optimizer": {"type": "Adam", "params": {"lr": 3e-5}},
+            "bf16": {"enabled": True},
+            "steps_per_print": 200,
+        },
+    )
+
+    rng = np.random.default_rng(0)
+    steps = int(epochs * len(train_feats) / micro)
+    first_loss = last_loss = None
+    for step in range(steps):
+        idx = rng.integers(0, len(train_feats), micro)
+        batch = [train_feats[i] for i in idx]
+        ids = np.array([f["input_ids"] for f in batch], np.int32)
+        tt = np.array([f["token_type_ids"] for f in batch], np.int32)
+        am = np.array([f["attention_mask"] for f in batch], np.int32)
+        st = np.array([f["start_position"] for f in batch], np.int32)
+        en = np.array([f["end_position"] for f in batch], np.int32)
+        # BertForQuestionAnswering signature: (input_ids, attention_mask,
+        # token_type_ids, start, end) — models/bert.py:219-222
+        loss = engine(ids, am, tt, st, en)
+        engine.backward(loss)
+        engine.step()
+        if step == 0:
+            first_loss = float(loss)
+    last_loss = float(loss)
+    assert last_loss < first_loss, (first_loss, last_loss)
+
+    # dev evaluation
+    all_s, all_e = [], []
+    for i in range(0, len(dev_feats), micro):
+        batch = dev_feats[i : i + micro]
+        ids = np.array([f["input_ids"] for f in batch], np.int32)
+        am = np.array([f["attention_mask"] for f in batch], np.int32)
+        tt = np.array([f["token_type_ids"] for f in batch], np.int32)
+        s_log, e_log = model.apply(
+            {"params": engine.params}, jnp.asarray(ids), jnp.asarray(am),
+            jnp.asarray(tt), train=False,
+        )
+        all_s.extend(np.asarray(s_log, np.float32))
+        all_e.extend(np.asarray(e_log, np.float32))
+    preds = H.extract_predictions(dev_ex[:max_dev], dev_feats, all_s, all_e)
+    scores = H.evaluate_squad(
+        [
+            {
+                "paragraphs": [
+                    {"qas": [qa for qa in p["qas"]
+                             if qa["id"] in preds]}
+                    for p in a["paragraphs"]
+                ]
+            }
+            for a in dev_raw
+        ],
+        preds,
+    )
+    print("SQuAD v1.1:", scores)
+    if pretrained and not os.environ.get("SQUAD_MAX_TRAIN"):
+        # the reference's full gate (test_e2e_squad.py:53-58)
+        assert scores["exact_match"] >= 83.98, scores
+        assert scores["f1"] >= 90.71, scores
+    else:
+        # pipeline sanity: extraction must produce real spans
+        assert any(p.strip() for p in preds.values())
+
+
+def test_squad_metric_functions_exact_values():
+    """The official-normalization metric math is always tested (no data
+    needed): known strings produce known EM/F1."""
+    from tests.model import squad_harness as H
+
+    assert H.exact_match_score("The  Cat!", "cat") == 1.0
+    assert H.exact_match_score("a dog", "cat") == 0.0
+    assert H.f1_score("the big cat", "big cat") == 1.0
+    f1 = H.f1_score("big red cat", "big cat")
+    assert abs(f1 - 0.8) < 1e-9  # 2*(2/3)*(2/2)/((2/3)+1)
+    dataset = [{"paragraphs": [{"qas": [
+        {"id": "q1", "answers": [{"text": "big cat"}]},
+        {"id": "q2", "answers": [{"text": "dog"}, {"text": "the dog"}]},
+    ]}]}]
+    scores = H.evaluate_squad(dataset, {"q1": "big cat", "q2": "a dog"})
+    assert scores["exact_match"] == 100.0
+    assert scores["f1"] == 100.0

@@ -142,3 +142,58 @@ def test_single_node_end_to_end(tmp_path):
     assert out.returncode == 0, out.stderr
     assert "RANK=0" in out.stdout
     assert "WS=1" in out.stdout
+
+
+# ------------------------------------------------------- TPU pod discovery
+def test_discover_tpu_pod_from_metadata():
+    """On-pod path: worker-network-endpoints + accelerator-type attributes
+    become the resource pool (no hostfile — VERDICT r02 item 8)."""
+    meta = {
+        "worker-network-endpoints": "w0:10.0.0.2:8470,w1:10.0.0.3:8470",
+        "accelerator-type": "v5litepod-8",
+    }
+    pool = dsr.discover_tpu_pod(
+        "mypod", metadata_get=meta.get, gcloud_describe=lambda n: None
+    )
+    assert list(pool.items()) == [("10.0.0.2", 4), ("10.0.0.3", 4)]
+
+
+def test_discover_tpu_pod_bare_ip_endpoints():
+    meta = {"worker-network-endpoints": "10.0.0.2, 10.0.0.3 ,10.0.0.4",
+            "accelerator-type": "v5litepod-4"}
+    pool = dsr.discover_tpu_pod(
+        "p", metadata_get=meta.get, gcloud_describe=lambda n: None
+    )
+    # 4 chips over 3 hosts -> 1 slot each (floor), never 0
+    assert list(pool.items()) == [
+        ("10.0.0.2", 1), ("10.0.0.3", 1), ("10.0.0.4", 1)
+    ]
+
+
+def test_discover_tpu_pod_via_gcloud():
+    """Off-pod fallback: gcloud describe JSON supplies the endpoints."""
+    desc = {
+        "acceleratorType": "v4-16",
+        "networkEndpoints": [
+            {"ipAddress": "10.1.0.2"}, {"ipAddress": "10.1.0.3"},
+        ],
+    }
+    pool = dsr.discover_tpu_pod(
+        "mypod", metadata_get=lambda a: None, gcloud_describe=lambda n: desc
+    )
+    assert list(pool.keys()) == ["10.1.0.2", "10.1.0.3"]
+    assert all(s == 4 for s in pool.values())
+
+
+def test_discover_tpu_pod_unresolvable_raises():
+    with pytest.raises(RuntimeError, match="could not discover"):
+        dsr.discover_tpu_pod(
+            "nope", metadata_get=lambda a: None, gcloud_describe=lambda n: None
+        )
+
+
+def test_parse_worker_endpoints_formats():
+    assert dsr._parse_worker_endpoints("uid:1.2.3.4:8470") == ["1.2.3.4"]
+    assert dsr._parse_worker_endpoints("1.2.3.4;5.6.7.8") == [
+        "1.2.3.4", "5.6.7.8"
+    ]

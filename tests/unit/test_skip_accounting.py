@@ -9,12 +9,16 @@ as truthful as the fp16 path's (reference deepspeed_light.py:858-869).
 """
 
 import flax.linen as nn
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu
 from deepspeed_tpu.parallel.mesh import build_mesh
+
+pytestmark = pytest.mark.slow  # compile-heavy; excluded from `make test-fast`
 
 
 class MLP(nn.Module):
