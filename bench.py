@@ -482,10 +482,9 @@ def bench_squad():
 
 STATE_BYTES_PER_PARAM = {
     # fp32 ladder: fp32 params(4) + fp32 grads(4) + fp32 m+v(8)
-    # reduced ladders: bf16 params(2) + int8 comp(1) + bf16 grads(2) +
-    # moments bf16 m+v(4) / int8 mu + bf16 nu(3)
+    # int8 ladder (compensated master): bf16 params(2) + int8 comp(1) +
+    # bf16 grads(2) + int8 mu(1) + bf16 nu(2)
     "fp32": 16,
-    "bf16": 9,
     "int8": 8,
 }
 
@@ -507,14 +506,16 @@ def bench_gpt2():
             # path for this model
             log(
                 f"GPT-2 {name}: fp32 optimizer state needs "
-                f"{14 * n / 1e9:.1f} GB > {hbm_bytes / 1e9:.1f} GB HBM; "
-                "using reduced-precision moment storage (int8 mu/bf16 nu)"
+                f"{STATE_BYTES_PER_PARAM['fp32'] * n / 1e9:.1f} GB > "
+                f"{hbm_bytes / 1e9:.1f} GB HBM; using compensated masters "
+                "+ reduced-precision moments (int8 mu/bf16 nu)"
             )
             attempts = GPT2_REDUCED_ATTEMPTS
         else:
             log(
-                f"GPT-2 {name}: even int8-moment state needs "
-                f"{9 * n / 1e9:.1f} GB > {hbm_bytes / 1e9:.1f} GB HBM; "
+                f"GPT-2 {name}: even compensated int8-moment state needs "
+                f"{STATE_BYTES_PER_PARAM['int8'] * n / 1e9:.1f} GB > "
+                f"{hbm_bytes / 1e9:.1f} GB HBM; "
                 "skipping (this is the model ZeRO shards across chips)"
             )
             continue

@@ -46,69 +46,78 @@ def _tree_f32(tree):
 _CHUNK_ELEMENTS = 1 << 25  # 33.5M
 
 
-def _leaf_slices(p, m_st, v_st):
-    """Reshape a leaf's moment state so index [i] selects one leading-axis
-    slice; quantized {'q','scale'} state slices stay block-aligned (leaf
-    row-major order means slice i owns a contiguous run of blocks)."""
+def _slice_count(L, size):
+    """Fewest slices n (dividing the leading axis L) that bound each
+    slice's working set to ~_CHUNK_ELEMENTS. Scanning single rows would
+    turn an embedding table into a ~50k-iteration device loop; grouping
+    rows keeps the scan a handful of big fused steps."""
+    want = max(1, -(-size // _CHUNK_ELEMENTS))
+    if want >= L:
+        return L
+    for n in range(want, L + 1):
+        if L % n == 0:
+            return n
+    return L
+
+
+def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None):
+    """Run ``leaf_fn`` over leading-axis row groups via lax.scan,
+    reassembling full-shape outputs; returns None when the leaf doesn't
+    decompose (callers fall back to the whole-leaf path). ``comp`` is an
+    optional param-shaped int8 compensation leaf (sliced alongside)."""
     from .quant import BLOCK, is_quantized
 
+    if p.ndim < 2 or p.shape[0] <= 1 or p.size < _CHUNK_ELEMENTS:
+        return None
     L = p.shape[0]
-    per = p.size // L
+    n = _slice_count(L, p.size)
+    if n <= 1:
+        return None
+    rows = L // n  # rows per slice
+    per_slice = p.size // n
+    rest = p.shape[1:]
 
     def split(st):
         if is_quantized(st):
-            if per % BLOCK:
+            if per_slice % BLOCK:
                 return None  # slice boundary would split a block
             return {
-                "q": st["q"].reshape(L, per),
-                "scale": st["scale"].reshape(L, per // BLOCK),
+                "q": st["q"].reshape(n, per_slice),
+                "scale": st["scale"].reshape(n, per_slice // BLOCK),
             }
-        return st.reshape(L, *p.shape[1:])
+        return st.reshape(n, rows, *rest)
 
     m_sl, v_sl = split(m_st), split(v_st)
     if m_sl is None or v_sl is None:
         return None
-    return m_sl, v_sl
+    p_sl = p.reshape(n, rows, *rest)
+    g_sl = g.reshape(n, rows, *rest)
+    xs = (p_sl, g_sl, m_sl, v_sl)
+    if comp is not None:
+        xs = xs + (comp.reshape(n, rows, *rest),)
 
+    def body(_, args):
+        return None, leaf_fn(*args)
 
-def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None):
-    """Run ``leaf_fn`` slice-by-slice over the leading axis via lax.scan,
-    reassembling full-shape outputs; returns None when the leaf doesn't
-    decompose (callers fall back to the whole-leaf path). ``comp`` is an
-    optional param-shaped int8 compensation leaf (sliced alongside)."""
-    from .quant import is_quantized
-
-    if p.ndim < 2 or p.shape[0] <= 1 or p.size < _CHUNK_ELEMENTS:
-        return None
-    sl = _leaf_slices(p, m_st, v_st)
-    if sl is None:
-        return None
-    m_sl, v_sl = sl
-
-    if comp is None:
-
-        def body(_, xs):
-            pi, gi, mi, vi = xs
-            return None, leaf_fn(pi, gi, mi, vi)
-
-        _, outs = jax.lax.scan(body, None, (p, g, m_sl, v_sl))
-    else:
-
-        def body(_, xs):
-            pi, gi, mi, vi, ci = xs
-            return None, leaf_fn(pi, gi, mi, vi, ci)
-
-        _, outs = jax.lax.scan(body, None, (p, g, m_sl, v_sl, comp))
-    p_new, m_new, v_new = outs[0], outs[1], outs[2]
+    _, outs = jax.lax.scan(body, None, xs)
+    p_new = outs[0].reshape(p.shape)
+    m_new, v_new = outs[1], outs[2]
     if is_quantized(m_st):
         m_new = {
             "q": m_new["q"].reshape(-1), "scale": m_new["scale"].reshape(-1)
         }
+    else:
+        m_new = m_new.reshape(m_st.shape)
     if is_quantized(v_st):
         v_new = {
             "q": v_new["q"].reshape(-1), "scale": v_new["scale"].reshape(-1)
         }
-    return (p_new, m_new, v_new) + ((outs[3],) if comp is not None else ())
+    else:
+        v_new = v_new.reshape(v_st.shape)
+    out = (p_new, m_new, v_new)
+    if comp is not None:
+        out = out + (outs[3].reshape(p.shape),)
+    return out
 
 
 class Optimizer:

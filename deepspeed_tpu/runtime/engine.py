@@ -714,10 +714,15 @@ class DeepSpeedEngine:
                 param_shardings,
             )
             new_scaler = update_scale(scaler_state, overflow)
-            zero_buffer = jax.tree_util.tree_map(jnp.zeros_like, grad_buffer)
-            return new_params, new_opt, zero_buffer, new_scaler, overflow, grad_norm, coeffs
+            return new_params, new_opt, new_scaler, overflow, grad_norm, coeffs
 
-        self._jit_apply_update = jax.jit(update_body, donate_argnums=(0, 1, 2))
+        # No zeroed replacement buffer comes back from the update: the next
+        # window's backward() lazily re-seeds the accumulator from its first
+        # micro-step's grads, so a multi-GB tree of zeros would be pure HLO
+        # temp (it alone pushed GPT-2 1.5B past 16 GB).
+        self._jit_apply_update = jax.jit(
+            update_body, donate_argnums=(0, 1, 2)
+        )
 
         def train_window(params, opt_state, scaler_state, batches, rng_keys, lr):
             """One full accumulation window in a single compiled program:
@@ -762,7 +767,7 @@ class DeepSpeedEngine:
                 grads, (losses, aux) = jax.lax.scan(
                     body, zeros, (batches, rng_keys)
                 )
-            new_params, new_opt, _, new_scaler, overflow, grad_norm, coeffs = (
+            new_params, new_opt, new_scaler, overflow, grad_norm, coeffs = (
                 update_body(params, opt_state, grads, scaler_state, lr)
             )
             return (
@@ -841,7 +846,6 @@ class DeepSpeedEngine:
         (
             self.params,
             self.optimizer_state,
-            self._grad_buffer,
             self.loss_scale_state,
             overflow,
             grad_norm,
@@ -853,6 +857,8 @@ class DeepSpeedEngine:
             self.loss_scale_state,
             lr,
         )
+        # donated; backward() lazily re-seeds from the next micro-step
+        self._grad_buffer = None
         window_loss = None
         if self._window_losses:
             # mean UNSCALED loss over the whole accumulation window
