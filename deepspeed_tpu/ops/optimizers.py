@@ -37,20 +37,20 @@ def _tree_f32(tree):
     return jax.tree_util.tree_map(_f32, tree)
 
 
-# Leaves bigger than this (elements) update via lax.scan over their leading
-# axis: the fp32 working copies of a [48, 1600, 6400] stacked-layer leaf
-# are ~2 GB of HLO temps if the whole leaf updates at once — enough to OOM
-# a 16 GB chip that is already carrying GPT-2 1.5B state. Chunking bounds
-# the temp to one slice; the leading dim of nn.scan-stacked params is the
-# layer axis, so slices are whole layers.
+# Leaves bigger than this (elements) update slice-by-slice over their
+# leading axis (in-place fori_loop, _chunked_leaf_update): the fp32 working
+# copies of a [48, 1600, 6400] stacked-layer leaf are ~2 GB of HLO temps if
+# the whole leaf updates at once — enough to OOM a 16 GB chip that is
+# already carrying GPT-2 1.5B state. Chunking bounds the temp to one slice
+# group; the leading dim of nn.scan-stacked params is the layer axis.
 _CHUNK_ELEMENTS = 1 << 25  # 33.5M
 
 
 def _slice_count(L, size):
     """Fewest slices n (dividing the leading axis L) that bound each
-    slice's working set to ~_CHUNK_ELEMENTS. Scanning single rows would
+    slice's working set to ~_CHUNK_ELEMENTS. Looping single rows would
     turn an embedding table into a ~50k-iteration device loop; grouping
-    rows keeps the scan a handful of big fused steps."""
+    rows keeps the loop a handful of big fused steps."""
     want = max(1, -(-size // _CHUNK_ELEMENTS))
     if want >= L:
         return L
@@ -61,10 +61,16 @@ def _slice_count(L, size):
 
 
 def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None):
-    """Run ``leaf_fn`` over leading-axis row groups via lax.scan,
-    reassembling full-shape outputs; returns None when the leaf doesn't
-    decompose (callers fall back to the whole-leaf path). ``comp`` is an
-    optional param-shaped int8 compensation leaf (sliced alongside)."""
+    """Run ``leaf_fn`` over leading-axis row groups IN PLACE via
+    lax.fori_loop + dynamic_slice/dynamic_update_slice; returns None when
+    the leaf doesn't decompose (callers fall back to the whole-leaf path).
+
+    The loop carries the output arrays and each iteration overwrites only
+    the slice it just read, so XLA performs true in-place updates on the
+    DONATED inputs — no reshapes (which flip layouts and void donation, a
+    param-sized copy at billion-param scale) and working temps bounded to
+    one slice. ``comp`` is an optional param-shaped int8 compensation
+    leaf (sliced alongside)."""
     from .quant import BLOCK, is_quantized
 
     if p.ndim < 2 or p.shape[0] <= 1 or p.size < _CHUNK_ELEMENTS:
@@ -75,48 +81,60 @@ def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None):
         return None
     rows = L // n  # rows per slice
     per_slice = p.size // n
-    rest = p.shape[1:]
+    mq, vq = is_quantized(m_st), is_quantized(v_st)
+    if (mq or vq) and per_slice % BLOCK:
+        return None  # slice boundary would split a quant block
 
-    def split(st):
+    def sl_moment(st, i):
         if is_quantized(st):
-            if per_slice % BLOCK:
-                return None  # slice boundary would split a block
             return {
-                "q": st["q"].reshape(n, per_slice),
-                "scale": st["scale"].reshape(n, per_slice // BLOCK),
+                "q": jax.lax.dynamic_slice_in_dim(
+                    st["q"], i * per_slice, per_slice, 0
+                ),
+                "scale": jax.lax.dynamic_slice_in_dim(
+                    st["scale"], i * (per_slice // BLOCK),
+                    per_slice // BLOCK, 0,
+                ),
             }
-        return st.reshape(n, rows, *rest)
+        return jax.lax.dynamic_slice_in_dim(st, i * rows, rows, 0)
 
-    m_sl, v_sl = split(m_st), split(v_st)
-    if m_sl is None or v_sl is None:
-        return None
-    p_sl = p.reshape(n, rows, *rest)
-    g_sl = g.reshape(n, rows, *rest)
-    xs = (p_sl, g_sl, m_sl, v_sl)
-    if comp is not None:
-        xs = xs + (comp.reshape(n, rows, *rest),)
+    def up_moment(st, new, i):
+        if is_quantized(st):
+            return {
+                "q": jax.lax.dynamic_update_slice_in_dim(
+                    st["q"], new["q"], i * per_slice, 0
+                ),
+                "scale": jax.lax.dynamic_update_slice_in_dim(
+                    st["scale"], new["scale"], i * (per_slice // BLOCK), 0
+                ),
+            }
+        return jax.lax.dynamic_update_slice_in_dim(st, new, i * rows, 0)
 
-    def body(_, args):
-        return None, leaf_fn(*args)
+    def body(i, carry):
+        p_a, m_a, v_a, c_a = carry
+        pi = jax.lax.dynamic_slice_in_dim(p_a, i * rows, rows, 0)
+        gi = jax.lax.dynamic_slice_in_dim(g, i * rows, rows, 0)
+        mi = sl_moment(m_a, i)
+        vi = sl_moment(v_a, i)
+        if comp is not None:
+            ci = jax.lax.dynamic_slice_in_dim(c_a, i * rows, rows, 0)
+            outs = leaf_fn(pi, gi, mi, vi, ci)
+        else:
+            outs = leaf_fn(pi, gi, mi, vi)
+        p_a = jax.lax.dynamic_update_slice_in_dim(p_a, outs[0], i * rows, 0)
+        m_a = up_moment(m_a, outs[1], i)
+        v_a = up_moment(v_a, outs[2], i)
+        if comp is not None:
+            c_a = jax.lax.dynamic_update_slice_in_dim(
+                c_a, outs[3], i * rows, 0
+            )
+        return p_a, m_a, v_a, c_a
 
-    _, outs = jax.lax.scan(body, None, xs)
-    p_new = outs[0].reshape(p.shape)
-    m_new, v_new = outs[1], outs[2]
-    if is_quantized(m_st):
-        m_new = {
-            "q": m_new["q"].reshape(-1), "scale": m_new["scale"].reshape(-1)
-        }
-    else:
-        m_new = m_new.reshape(m_st.shape)
-    if is_quantized(v_st):
-        v_new = {
-            "q": v_new["q"].reshape(-1), "scale": v_new["scale"].reshape(-1)
-        }
-    else:
-        v_new = v_new.reshape(v_st.shape)
+    init = (p, m_st, v_st, comp if comp is not None else jnp.zeros((), jnp.int8))
+    p_new, m_new, v_new, c_new = jax.lax.fori_loop(0, n, body, init)
     out = (p_new, m_new, v_new)
     if comp is not None:
-        out = out + (outs[3].reshape(p.shape),)
+        out = out + (c_new,)
     return out
 
 

@@ -147,9 +147,19 @@ def decode_master(p, comp_code):
 
 
 def encode_master(master_f32, p_dtype):
-    """fp32 master -> (stored param, int8 error code)."""
-    p_new = master_f32.astype(p_dtype)
-    p32 = p_new.astype(jnp.float32)
+    """fp32 master -> (stored param, int8 error code).
+
+    The rounding residue is computed against ``lax.reduce_precision`` —
+    NOT an ``astype`` roundtrip, which XLA's excess-precision
+    simplification folds away under jit (the residue would silently
+    become 0 and compensation a no-op in every compiled training step).
+    reduce_precision is defined as the rounding itself, so it survives.
+    """
+    if p_dtype == jnp.bfloat16 or jnp.dtype(p_dtype) == jnp.dtype("bfloat16"):
+        p32 = jax.lax.reduce_precision(master_f32, 8, 7)  # bf16 grid
+    else:
+        p32 = jax.lax.reduce_precision(master_f32, 5, 10)  # fp16 grid
+    p_new = p32.astype(p_dtype)  # exact: p32 already on the target grid
     err = master_f32 - p32
     code = jnp.clip(
         jnp.round(err / (_ulp_of(p32) / _CODE_MAX)), -_CODE_MAX, _CODE_MAX
