@@ -58,10 +58,13 @@ GPT2_MODELS = ["gpt2_1.5b", "gpt2_large_774m", "gpt2_medium_355m"]
 # backward: measured 8.0k -> 13.1k tokens/s together with the 512-block
 # kernel defaults on gpt2-large.
 GPT2_POLICY = "dots_with_no_batch_dims_saveable+flash_out+flash_lse"
-# (policy, micro, optimizer_state_dtype) ladder; fp32 state first (exact
-# reference semantics), reduced-state rungs unlock models whose fp32 state
-# alone exceeds HBM (selected per-model in bench_gpt2).
+# (policy, micro, optimizer_state_dtype) ladder. The reduced-state rung
+# leads even when fp32 fits: the freed HBM buys a bigger micro-batch
+# (774M measured: int8@micro8 13.3k tok/s / 61.6 TFLOPS vs fp32@micro4
+# 12.5k / 57.9; micro=12 and 16 OOM). fp32 rungs keep the
+# reference-exact-state fallback.
 GPT2_ATTEMPTS = [
+    (GPT2_POLICY, 8, "int8"),
     (GPT2_POLICY, 8, "fp32"),
     (GPT2_POLICY, 4, "fp32"),
     ("dots_with_no_batch_dims_saveable", 4, "fp32"),
@@ -431,26 +434,34 @@ def _run_attempt(spec, timeout=1500):
     return None
 
 
-def bench_bert():
-    total = int(os.environ.get("BENCH_BATCH", "256"))
+def _env_ladder(default_attempts, default_policy, total, label):
+    """Shared BENCH_MICRO/BENCH_POLICY override handling for the BERT-style
+    ladders: micro pinned -> single attempt; policy pinned -> that policy
+    over the ladder's micros LARGEST first (first non-OOM attempt wins, so
+    ascending order would understate the pinned policy); and always the
+    total%micro divisibility guard with a clear message."""
     micro_env = os.environ.get("BENCH_MICRO")
     policy_env = os.environ.get("BENCH_POLICY")
     if micro_env:
-        attempts = [(policy_env or "dots_saveable", int(micro_env))]
+        attempts = [(policy_env or default_policy, int(micro_env))]
     elif policy_env:
-        # policy pinned, micro free: try the ladder's micros LARGEST first
-        # (first non-OOM attempt wins, so ascending order would stop at the
-        # smallest micro and understate the pinned policy)
-        micros = sorted({m for _, m in BERT_ATTEMPTS}, reverse=True)
+        micros = sorted({m for _, m in default_attempts}, reverse=True)
         attempts = [(policy_env, m) for m in micros]
     else:
-        attempts = BERT_ATTEMPTS
+        attempts = default_attempts
     runnable = [(p, m) for p, m in attempts if total % m == 0]
     if not runnable:
         log(
-            f"BERT: no micro-batch candidate divides BENCH_BATCH={total}; "
+            f"{label}: no micro-batch candidate divides total={total}; "
             f"tried {[m for _, m in attempts]}"
         )
+    return runnable
+
+
+def bench_bert():
+    total = int(os.environ.get("BENCH_BATCH", "256"))
+    runnable = _env_ladder(BERT_ATTEMPTS, "dots_saveable", total, "BERT")
+    if not runnable:
         return None
     for policy, micro in runnable:
         log(f"BERT attempt: micro={micro} total={total} policy={policy}")
@@ -479,15 +490,21 @@ def _gpt2_params_estimate(name):
 def bench_bert_seq512():
     """BASELINE.md row 2: BERT-large seq 512, 52 samples/s on 1x V100."""
     attempts = [
-        # flash engages at seq 512; keep all matmul outputs + its residuals
-        # (measured 75.1/s vs 74.5 for the no-batch-dims variant)
+        # flash engages at seq 512; keep all matmul outputs + its
+        # residuals (measured 75.1/s vs 74.5 no-batch-dims variant;
+        # micro=32 OOMs under both save policies)
         ("dots_saveable+flash_out+flash_lse", 16),
         (GPT2_POLICY, 16),
         ("dots_with_no_batch_dims_saveable", 16),
         ("full", 16),
         ("full", 8),
     ]
-    for policy, micro in attempts:
+    runnable = _env_ladder(
+        attempts, "dots_saveable+flash_out+flash_lse", 64, "BERT seq512"
+    )
+    if not runnable:
+        return None
+    for policy, micro in runnable:
         log(f"BERT seq512 attempt: micro={micro} total=64 policy={policy}")
         result = _run_attempt(
             {"kind": "bert", "policy": policy, "micro": micro, "total": 64,
