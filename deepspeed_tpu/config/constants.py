@@ -196,6 +196,15 @@ ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT = 500000000
 # replicated param bytes under bf16/fp16).
 ZERO_MASTER_WEIGHTS = "master_weights"
 ZERO_MASTER_WEIGHTS_DEFAULT = True
+# ZeRO-Offload analog (later-DeepSpeed surface): keep fp32 master +
+# moments on the HOST; the accelerator holds compute-dtype params and
+# grads only. On tunneled TPU setups host<->device bandwidth makes this
+# slow (prefer data_types.master_dtype="compensated" — docs/memory.md);
+# on locally-attached hosts it trades step time for ~12 bytes/param of
+# HBM. {"device": "cpu"} enables; {"device": "none"} (default) disables.
+ZERO_OFFLOAD_OPTIMIZER = "offload_optimizer"
+ZERO_OFFLOAD_DEVICE = "device"
+ZERO_OFFLOAD_DEVICE_DEFAULT = "none"
 
 #############################################
 # Activation checkpointing

@@ -27,6 +27,7 @@ class DeepSpeedZeroConfig:
         self.load_from_fp32_weights = C.ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT
         self.max_elements_per_comm = C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT
         self.master_weights = C.ZERO_MASTER_WEIGHTS_DEFAULT
+        self.offload_optimizer_device = C.ZERO_OFFLOAD_DEVICE_DEFAULT
 
         if param_dict is not None:
             raw = param_dict.get(C.ZERO_OPTIMIZATION)
@@ -80,6 +81,20 @@ class DeepSpeedZeroConfig:
         self.master_weights = get_scalar_param(
             zero_dict, C.ZERO_MASTER_WEIGHTS, C.ZERO_MASTER_WEIGHTS_DEFAULT
         )
+        off = zero_dict.get(C.ZERO_OFFLOAD_OPTIMIZER)
+        if off is not None:
+            if not isinstance(off, dict):
+                raise TypeError(
+                    f"'{C.ZERO_OFFLOAD_OPTIMIZER}' must be an object, got "
+                    f"{type(off).__name__}"
+                )
+            device = off.get(C.ZERO_OFFLOAD_DEVICE, "cpu")
+            if device not in ("none", "cpu"):
+                raise ValueError(
+                    f"{C.ZERO_OFFLOAD_OPTIMIZER}.{C.ZERO_OFFLOAD_DEVICE} "
+                    f"must be 'none' or 'cpu', got {device!r}"
+                )
+            self.offload_optimizer_device = device
 
     def repr_dict(self):
         return {
@@ -92,6 +107,9 @@ class DeepSpeedZeroConfig:
             C.ZERO_CONTIGUOUS_GRADIENTS: self.contiguous_gradients,
             C.ZERO_LOAD_FROM_FP32_WEIGHTS: self.load_from_fp32_weights,
             C.ZERO_MASTER_WEIGHTS: self.master_weights,
+            C.ZERO_OFFLOAD_OPTIMIZER: {
+                C.ZERO_OFFLOAD_DEVICE: self.offload_optimizer_device
+            },
         }
 
     def __repr__(self):

@@ -264,12 +264,39 @@ class DeepSpeedEngine:
             self.config.master_dtype == "compensated"
             and self.compute_dtype != jnp.float32
         )
+        # ZeRO-Offload analog (zero_optimization.offload_optimizer): fp32
+        # master + moments live on the HOST cpu device; the accelerator
+        # keeps compute-dtype params and grads. The update runs as a
+        # cpu-jitted program fed by an explicit d2h grad transfer.
+        self.host_offload = (
+            getattr(
+                self.config.zero_config, "offload_optimizer_device", "none"
+            ) == "cpu"
+        )
+        if self.host_offload and self.compensated_master:
+            raise DeepSpeedConfigError(
+                "offload_optimizer and master_dtype='compensated' are "
+                "alternative memory strategies — pick one (docs/memory.md)"
+            )
+        if self.host_offload and jax.process_count() > 1:
+            # mesh-sharded grads are not fully addressable from one
+            # process, so the per-step d2h/h2d transfers would crash
+            # mid-training; fail at init with the actionable message
+            raise DeepSpeedConfigError(
+                "offload_optimizer requires a single-process mesh; on "
+                "multi-host pods use ZeRO sharding (stage>=1 divides "
+                "optimizer state by dp) or "
+                "data_types.master_dtype='compensated' instead"
+            )
         self.master_in_opt = (
-            not self.compensated_master
-            and self.compute_dtype != jnp.float32
-            and stage >= 1
-            and dp_size > 1  # dp=1: a master copy would only add bytes
-            and getattr(self.config.zero_config, "master_weights", True)
+            self.host_offload
+            or (
+                not self.compensated_master
+                and self.compute_dtype != jnp.float32
+                and stage >= 1
+                and dp_size > 1  # dp=1: a master copy would only add bytes
+                and getattr(self.config.zero_config, "master_weights", True)
+            )
         )
         if self.master_in_opt or self.compensated_master:
             self.params = jax.device_put(
@@ -302,7 +329,29 @@ class DeepSpeedEngine:
             ),
             self._mesh,
         )
-        if self.master_in_opt:
+        if self.host_offload:
+            cpu = jax.devices("cpu")[0]
+            self._cpu_device = cpu
+            from jax.sharding import SingleDeviceSharding
+
+            cpu_sh = SingleDeviceSharding(cpu)
+            self._opt_shardings = {
+                "master": jax.tree_util.tree_map(lambda _: cpu_sh, params_f32),
+                "inner": jax.tree_util.tree_map(
+                    lambda _: cpu_sh, inner_state
+                ),
+            }
+            self.optimizer_state = {
+                "master": jax.device_put(params_f32, cpu),
+                "inner": jax.device_put(inner_state, cpu),
+            }
+            log_dist(
+                "ZeRO-Offload: fp32 master + optimizer moments on host "
+                "cpu; accelerator holds compute-dtype params/grads "
+                "(per-step d2h grads + h2d params)",
+                ranks=[0],
+            )
+        elif self.master_in_opt:
             master_shardings = zero_lib.specs_to_shardings(
                 optstate_param_specs, self._mesh
             )
@@ -644,8 +693,7 @@ class DeepSpeedEngine:
         # that fp16's skipped-step accounting needs.
         check_overflow = self.config.fp16_enabled
 
-        def update_body(params, opt_state, grad_buffer, scaler_state, lr):
-            inv_scale = 1.0 / scaler_state.loss_scale
+        def detect_overflow(grad_buffer):
             # ONE fp32 reduction over the accumulation-dtype buffer; the
             # scalar unscale factors out of the norm (||g/s|| = ||g||/s) so
             # no fp32 copy of the grad tree is ever materialized — at
@@ -659,6 +707,14 @@ class DeepSpeedEngine:
                 # inf/nan norm (deepspeed_utils.py:140-147) — never a
                 # non-finite value, so test the sentinel, not isfinite
                 overflow = raw_norm < 0.0
+            return raw_norm, overflow
+
+        def cond_update(params, opt_state, grads, raw_norm, overflow,
+                        inv_scale, lr, layout):
+            """Shared overflow-gated update core: unscale+clip as one
+            scalar grad_scale into the optimizer; layout 'master' steps
+            opt_state['master'] and publishes compute-dtype params,
+            'plain' steps params directly."""
 
             def do_update(operands):
                 params, opt_state, grads = operands
@@ -669,11 +725,11 @@ class DeepSpeedEngine:
                         (grad_norm > clip) & (grad_norm > 0),
                         clip / grad_norm, jnp.float32(1.0),
                     )
-                if master_in_opt:
-                    # step the fp32 master (sharded), then publish the
-                    # compute-dtype params — the reference's fp32-partition
-                    # step + fp16 copy (deepspeed_zero_optimizer.py:
-                    # 1157-1199), with the all-gather left to GSPMD
+                if layout == "master":
+                    # step the fp32 master, then publish the compute-dtype
+                    # params — the reference's fp32-partition step + fp16
+                    # copy (deepspeed_zero_optimizer.py:1157-1199); under
+                    # GSPMD the all-gather is XLA's
                     new_master, new_inner, aux = optimizer.apply(
                         opt_state["master"], grads, opt_state["inner"], lr,
                         grad_scale=gscale,
@@ -704,9 +760,16 @@ class DeepSpeedEngine:
                     jnp.zeros((n_coeffs,), jnp.float32),
                 )
 
-            new_params, new_opt, grad_norm, coeffs = jax.lax.cond(
-                overflow, skip_update, do_update,
-                (params, opt_state, grad_buffer),
+            return jax.lax.cond(
+                overflow, skip_update, do_update, (params, opt_state, grads)
+            )
+
+        def update_body(params, opt_state, grad_buffer, scaler_state, lr):
+            inv_scale = 1.0 / scaler_state.loss_scale
+            raw_norm, overflow = detect_overflow(grad_buffer)
+            new_params, new_opt, grad_norm, coeffs = cond_update(
+                params, opt_state, grad_buffer, raw_norm, overflow,
+                inv_scale, lr, "master" if master_in_opt else "plain",
             )
             new_params = jax.tree_util.tree_map(
                 lambda p, s: jax.lax.with_sharding_constraint(p, s),
@@ -723,6 +786,34 @@ class DeepSpeedEngine:
         self._jit_apply_update = jax.jit(
             update_body, donate_argnums=(0, 1, 2)
         )
+
+        if self.host_offload:
+
+            def update_body_offload(master, inner, grads, scaler_state, lr):
+                """Host-side (cpu-jitted) master update: all inputs live on
+                the cpu device, so XLA compiles this for the host backend.
+                Same cond_update core as the on-device path ('master'
+                layout, params role played by the master itself since the
+                fresh compute-dtype params derive from it); returns those
+                params for the h2d push."""
+                inv_scale = 1.0 / scaler_state.loss_scale
+                raw_norm, overflow = detect_overflow(grads)
+                params_like = jax.tree_util.tree_map(
+                    lambda m: m.astype(compute_dtype), master
+                )
+                new_params, new_opt, grad_norm, coeffs = cond_update(
+                    params_like, {"master": master, "inner": inner}, grads,
+                    raw_norm, overflow, inv_scale, lr, "master",
+                )
+                new_scaler = update_scale(scaler_state, overflow)
+                return (
+                    new_params, new_opt["master"], new_opt["inner"],
+                    new_scaler, overflow, grad_norm, coeffs,
+                )
+
+            self._jit_apply_update_offload = jax.jit(
+                update_body_offload, donate_argnums=(0, 1, 2)
+            )
 
         def train_window(params, opt_state, scaler_state, batches, rng_keys, lr):
             """One full accumulation window in a single compiled program:
@@ -843,20 +934,49 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(STEP_TIMER).start()
         lr = jnp.float32(self._current_lr())
-        (
-            self.params,
-            self.optimizer_state,
-            self.loss_scale_state,
-            overflow,
-            grad_norm,
-            coeffs,
-        ) = self._jit_apply_update(
-            self.params,
-            self.optimizer_state,
-            self._grad_buffer,
-            self.loss_scale_state,
-            lr,
-        )
+        if self.host_offload:
+            grads_host = jax.device_put(self._grad_buffer, self._cpu_device)
+            (
+                params_c,
+                new_master,
+                new_inner,
+                self.loss_scale_state,
+                overflow,
+                grad_norm,
+                coeffs,
+            ) = self._jit_apply_update_offload(
+                self.optimizer_state["master"],
+                self.optimizer_state["inner"],
+                grads_host,
+                jax.device_put(self.loss_scale_state, self._cpu_device),
+                jax.device_put(lr, self._cpu_device),
+            )
+            self.optimizer_state = {"master": new_master, "inner": new_inner}
+            self.params = jax.device_put(params_c, self._param_shardings)
+            # the scaler feeds the next accelerator-side fwd_bwd: move it
+            # back off the host (replicated over the mesh) so the mesh jit
+            # doesn't see a committed cpu input
+            self.loss_scale_state = jax.device_put(
+                self.loss_scale_state,
+                jax.sharding.NamedSharding(
+                    self._mesh, jax.sharding.PartitionSpec()
+                ),
+            )
+        else:
+            (
+                self.params,
+                self.optimizer_state,
+                self.loss_scale_state,
+                overflow,
+                grad_norm,
+                coeffs,
+            ) = self._jit_apply_update(
+                self.params,
+                self.optimizer_state,
+                self._grad_buffer,
+                self.loss_scale_state,
+                lr,
+            )
         # donated; backward() lazily re-seeds from the next micro-step
         self._grad_buffer = None
         window_loss = None
@@ -998,6 +1118,20 @@ class DeepSpeedEngine:
         unscaled loss. Semantically equivalent to
         gradient_accumulation_steps x (forward()+backward()) + step()."""
         accum = self.gradient_accumulation_steps()
+        if self.host_offload:
+            # the fused window would jit the update INTO the mesh program;
+            # offload runs it host-side instead — loop the micro-steps
+            it = iter(batch_iter_or_batches)
+            losses = []
+            for _ in range(accum):
+                batch = next(it)
+                if not isinstance(batch, (tuple, list)):
+                    batch = (batch,)
+                loss = self.forward(*batch)
+                self.backward(loss)
+                losses.append(loss.astype(jnp.float32))
+            self.step()
+            return jnp.mean(jnp.stack(losses))
         it = iter(batch_iter_or_batches)
         batches = []
         for _ in range(accum):
