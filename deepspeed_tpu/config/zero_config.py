@@ -88,7 +88,12 @@ class DeepSpeedZeroConfig:
                     f"'{C.ZERO_OFFLOAD_OPTIMIZER}' must be an object, got "
                     f"{type(off).__name__}"
                 )
-            device = off.get(C.ZERO_OFFLOAD_DEVICE, "cpu")
+            # default 'none' (upstream semantics): an offload block without
+            # an explicit device — e.g. ported configs carrying only
+            # pin_memory — must not silently enable host offload
+            device = off.get(
+                C.ZERO_OFFLOAD_DEVICE, C.ZERO_OFFLOAD_DEVICE_DEFAULT
+            )
             if device not in ("none", "cpu"):
                 raise ValueError(
                     f"{C.ZERO_OFFLOAD_OPTIMIZER}.{C.ZERO_OFFLOAD_DEVICE} "

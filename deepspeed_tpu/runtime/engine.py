@@ -952,7 +952,12 @@ class DeepSpeedEngine:
                 jax.device_put(lr, self._cpu_device),
             )
             self.optimizer_state = {"master": new_master, "inner": new_inner}
-            self.params = jax.device_put(params_c, self._param_shardings)
+            # the offload path is inherently synchronous (transfers bound
+            # it), so checking the flag costs nothing extra — and on a
+            # skipped step the master is untouched, making the full-model
+            # h2d push (~3 GB at 1.5B) pure waste
+            if not bool(overflow):
+                self.params = jax.device_put(params_c, self._param_shardings)
             # the scaler feeds the next accelerator-side fwd_bwd: move it
             # back off the host (replicated over the mesh) so the mesh jit
             # doesn't see a committed cpu input
@@ -1118,20 +1123,6 @@ class DeepSpeedEngine:
         unscaled loss. Semantically equivalent to
         gradient_accumulation_steps x (forward()+backward()) + step()."""
         accum = self.gradient_accumulation_steps()
-        if self.host_offload:
-            # the fused window would jit the update INTO the mesh program;
-            # offload runs it host-side instead — loop the micro-steps
-            it = iter(batch_iter_or_batches)
-            losses = []
-            for _ in range(accum):
-                batch = next(it)
-                if not isinstance(batch, (tuple, list)):
-                    batch = (batch,)
-                loss = self.forward(*batch)
-                self.backward(loss)
-                losses.append(loss.astype(jnp.float32))
-            self.step()
-            return jnp.mean(jnp.stack(losses))
         it = iter(batch_iter_or_batches)
         batches = []
         for _ in range(accum):
@@ -1139,6 +1130,16 @@ class DeepSpeedEngine:
             if not isinstance(batch, (tuple, list)):
                 batch = (batch,)
             batches.append(tuple(batch))
+        if self.host_offload:
+            # the fused window would jit the update INTO the mesh program;
+            # offload runs it host-side instead — loop the micro-steps
+            losses = []
+            for batch in batches:
+                loss = self.forward(*batch)
+                self.backward(loss)
+                losses.append(loss.astype(jnp.float32))
+            self.step()
+            return jnp.mean(jnp.stack(losses))
 
         def stack_leaf(*xs):
             # Stack host leaves on host so the window goes to devices ONCE,

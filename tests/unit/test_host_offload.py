@@ -157,3 +157,9 @@ def test_offload_config_validation():
         DeepSpeedZeroConfig(
             {"zero_optimization": {"offload_optimizer": {"device": "nvme"}}}
         )
+    # a block WITHOUT an explicit device (e.g. a ported config carrying
+    # only pin_memory) must not silently enable offload — upstream's
+    # device default is 'none'
+    assert DeepSpeedZeroConfig(
+        {"zero_optimization": {"offload_optimizer": {"pin_memory": True}}}
+    ).offload_optimizer_device == "none"
