@@ -782,7 +782,12 @@ class DeepSpeedEngine:
         # No zeroed replacement buffer comes back from the update: the next
         # window's backward() lazily re-seeds the accumulator from its first
         # micro-step's grads, so a multi-GB tree of zeros would be pure HLO
-        # temp (it alone pushed GPT-2 1.5B past 16 GB).
+        # temp (it alone pushed GPT-2 1.5B past 16 GB). The grad buffer is
+        # still DONATED — with no aliasable output XLA reuses it as scratch
+        # and frees it early; jax's "donated buffers were not usable"
+        # warning at first compile is EXPECTED for the grad argnum and left
+        # unsuppressed (a global filter would also hide genuine donation
+        # regressions on params/opt state).
         self._jit_apply_update = jax.jit(
             update_body, donate_argnums=(0, 1, 2)
         )
