@@ -103,6 +103,11 @@ class DeepSpeedConfig:
         self.zero_config = DeepSpeedZeroConfig(pd)
         self.zero_optimization_stage = self.zero_config.stage
         self.zero_enabled = self.zero_optimization_stage > 0
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            pd,
+            C.ZERO_ALLOW_UNTESTED_OPTIMIZER,
+            C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT,
+        )
 
         self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(pd)
 
@@ -294,6 +299,16 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
         if self.loss_scale < 0:
             raise DeepSpeedConfigError(f"loss_scale must be >= 0, got {self.loss_scale}")
+        amp_dict = get_dict_param(self._param_dict, C.AMP)
+        if amp_dict.get(C.AMP_ENABLED, bool(amp_dict)):
+            # apex amp (reference deepspeed_light.py:516-521) has no TPU
+            # path; silently dropping it would change the training numerics
+            # the config asked for, so fail with the native alternative.
+            raise DeepSpeedConfigError(
+                'the "amp" block has no TPU equivalent (apex amp is '
+                "CUDA-only); use {'bf16': {'enabled': true}} — bf16 is the "
+                "native mixed-precision path and needs no loss scaler"
+            )
 
     def _do_warning_check(self):
         if self.zero_enabled and not (self.fp16_enabled or self.bf16_enabled):

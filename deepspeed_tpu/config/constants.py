@@ -206,6 +206,22 @@ ZERO_OFFLOAD_OPTIMIZER = "offload_optimizer"
 ZERO_OFFLOAD_DEVICE = "device"
 ZERO_OFFLOAD_DEVICE_DEFAULT = "none"
 
+# ZeRO wrapping an optimizer outside the tested set (Adam family / Lamb)
+# needs an explicit opt-in, mirroring the reference's guard
+# (deepspeed_constants.py:37-38, deepspeed_light.py:506-515): sharded
+# state specs are derived per optimizer, so an arbitrary client optimizer
+# under ZeRO is an untested combination the user must consciously accept.
+ZERO_ALLOW_UNTESTED_OPTIMIZER = "zero_allow_untested_optimizer"
+ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT = False
+ZERO_TESTED_OPTIMIZERS = [ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER]
+
+# apex amp mode (reference deepspeed_light.py:516-521) has no TPU
+# equivalent: bf16 is the native mixed-precision path and needs neither
+# amp's cast insertion nor a loss scaler. A config carrying an enabled
+# "amp" block is rejected loudly rather than silently ignored.
+AMP = "amp"
+AMP_ENABLED = "enabled"
+
 #############################################
 # Activation checkpointing
 #############################################

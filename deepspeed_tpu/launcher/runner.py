@@ -98,8 +98,8 @@ def fetch_hostfile(hostfile_path):
     Returns None when the file is absent (single-host local run)."""
     if not os.path.isfile(hostfile_path):
         logger.warning(
-            "Unable to find hostfile, will proceed with training "
-            "with local resources only."
+            "no hostfile at %s — falling back to a single-host run on "
+            "local devices", hostfile_path,
         )
         return None
     resource_pool = collections.OrderedDict()
@@ -113,7 +113,9 @@ def fetch_hostfile(hostfile_path):
                 _, slot_count = slots.split("=")
                 slot_count = int(slot_count)
             except ValueError:
-                logger.error("Hostfile is not formatted correctly: %r", line)
+                logger.error(
+                    "bad hostfile line %r (expected 'hostname slots=N')", line
+                )
                 raise
             if hostname in resource_pool:
                 raise ValueError(f"host {hostname} is already defined")
@@ -156,7 +158,7 @@ def parse_resource_filter(host_info, include_str="", exclude_str=""):
                 filtered_hosts[hostname] = slots
             else:
                 for s in slots:
-                    logger.info("removing %s from %s", s, hostname)
+                    logger.info("excluding slot %s on host %s", s, hostname)
                     filtered_hosts[hostname].remove(s)
         else:
             hostname = node_config

@@ -152,11 +152,17 @@ def _fwd_kernel(
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        # keep matmul operands in their storage dtype (bf16 in bf16
+        # training): the MXU consumes bf16 pairs natively and accumulates
+        # f32 via preferred_element_type — an explicit f32 upcast before
+        # the dot forces the much slower f32 MXU path (measured: the bulk
+        # of the round-3 flash MFU gap). Softmax bookkeeping stays f32.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [BQ, BK]
+        ) * sm_scale  # [BQ, BK] f32
         s = _masked_scores(
             s, kvm_ref, iq, ik, causal=causal, block_q=block_q,
             block_k=block_k, diag_offset=diag_offset, use_mask=use_mask,
@@ -179,7 +185,7 @@ def _fwd_kernel(
             p_use = p
 
         pv = jax.lax.dot_general(
-            p_use, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p_use.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         acc_scr[:] = acc_scr[:] * alpha + pv
@@ -214,8 +220,10 @@ def _bwd_dq_kernel(
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        # operands stay in storage dtype for every dot (MXU-native bf16
+        # with f32 accumulation); only softmax/ds arithmetic runs f32
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
@@ -226,9 +234,9 @@ def _bwd_dq_kernel(
         p = jnp.exp(s - lse_ref[0, :, :1])  # true softmax probs
         p = jnp.where(s > NEG_INF / 2, p, 0.0)  # fully-masked rows
 
-        do = do_ref[0].astype(jnp.float32)
+        do = do_ref[0]
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         if dropout_rate > 0.0:
@@ -237,7 +245,8 @@ def _bwd_dq_kernel(
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta_ref[0, :, :1])
         dq_scr[:] += sm_scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(ik == nk - 1)
@@ -264,8 +273,9 @@ def _bwd_dkv_kernel(
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        # storage-dtype matmul operands (MXU-native bf16, f32 accumulate)
+        q = q_ref[0]
+        k = k_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
@@ -276,9 +286,9 @@ def _bwd_dkv_kernel(
         p = jnp.exp(s - lse_ref[0, :, :1])  # [BQ, BK]
         p = jnp.where(s > NEG_INF / 2, p, 0.0)  # fully-masked rows
 
-        do = do_ref[0].astype(jnp.float32)
+        do = do_ref[0]
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         if dropout_rate > 0.0:
@@ -290,12 +300,14 @@ def _bwd_dkv_kernel(
             p_drop = p
         # dv += P^T dO
         dv_scr[:] += jax.lax.dot_general(
-            p_drop, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[0, :, :1])
         # dk += dS^T q
         dk_scr[:] += sm_scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(iq == nq - 1)

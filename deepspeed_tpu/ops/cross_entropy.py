@@ -51,21 +51,28 @@ def blocked_lm_head_loss(
     nb = -(-T // block)
     pad = nb * block - T
     if pad:
+        # pad positions are masked BY INDEX in the chunk body (pos >= T),
+        # not by a sentinel label value — so an explicit ignore_values=()
+        # (count every real label) stays correct and label-0 padding is
+        # never mistaken for a real target
         hidden = jnp.concatenate(
             [hidden, jnp.zeros((B, pad, H), hidden.dtype)], axis=1
         )
         labels = jnp.concatenate(
-            [labels,
-             jnp.full((B, pad), ignore_values[0], labels.dtype)], axis=1
+            [labels, jnp.zeros((B, pad), labels.dtype)], axis=1
         )
     # [nb, B, block, ...] so lax.scan walks sequence chunks
     xs = hidden.reshape(B, nb, block, H).transpose(1, 0, 2, 3)
     ls = labels.reshape(B, nb, block).transpose(1, 0, 2)
+    pos = jnp.broadcast_to(
+        jnp.arange(nb * block, dtype=jnp.int32).reshape(nb, 1, block),
+        (nb, B, block),
+    )
 
     def chunk(carry, inputs):
         num, den = carry
-        x, l = inputs
-        valid = jnp.ones(l.shape, bool)
+        x, l, p_idx = inputs
+        valid = p_idx < T
         for iv in ignore_values:
             valid &= l != iv
         safe = jnp.where(valid, l, 0)
@@ -90,6 +97,6 @@ def blocked_lm_head_loss(
     # instead of saving nb x [B, block, V] planes
     chunk = jax.checkpoint(chunk)
     (num, den), _ = jax.lax.scan(
-        chunk, (jnp.float32(0.0), jnp.int32(0)), (xs, ls)
+        chunk, (jnp.float32(0.0), jnp.int32(0)), (xs, ls, pos)
     )
     return num / jnp.maximum(den, 1).astype(jnp.float32)

@@ -167,6 +167,10 @@ class FusedLamb(Lamb):
     """LAMB backed by the Pallas phase-1 kernel; numerics identical to the
     pure-JAX `Lamb` (same trust-ratio clamp, same ``lamb_coeffs`` aux)."""
 
+    # the opaque pallas_call cannot fold a skip-gate select into its
+    # update pass — overflow skips go through the engine's lax.cond path
+    supports_gate = False
+
     def apply(self, params, grads, state, lr, grad_scale=None):
         if self.state_dtype != "fp32":
             raise ValueError(

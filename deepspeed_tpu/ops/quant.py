@@ -26,9 +26,15 @@ import jax.numpy as jnp
 BLOCK = 2048  # absmax granularity (the 8-bit-optimizer default)
 
 
-def quantized_zeros_like(p):
+def quantized_zeros_like(p, pad_blocks=1):
+    """Zeros quantized leaf for ``p``. ``pad_blocks`` rounds the block
+    count up to a multiple (ZeRO: pad to the dp size so the flat ``q`` and
+    ``scale`` arrays split evenly across the data axis with shard
+    boundaries on block boundaries — the padded tail decodes to zero and
+    never receives updates)."""
     n = p.size
     nb = max(1, math.ceil(n / BLOCK))
+    nb = -(-nb // pad_blocks) * pad_blocks
     return {
         "q": jnp.zeros((nb * BLOCK,), jnp.int8),
         "scale": jnp.zeros((nb,), jnp.float32),
@@ -49,10 +55,13 @@ def dequantize(state_leaf, shape):
     return x.reshape(-1)[:n].reshape(shape)
 
 
-def quantize(x):
-    """Symmetric blockwise int8: scale = absmax/127 per BLOCK elements."""
+def quantize(x, nb=None):
+    """Symmetric blockwise int8: scale = absmax/127 per BLOCK elements.
+    ``nb`` pins the output block count (>= the minimum) so re-encoding a
+    padded leaf keeps its (ZeRO-aligned) storage shape."""
     n = x.size
-    nb = max(1, math.ceil(n / BLOCK))
+    if nb is None:
+        nb = max(1, math.ceil(n / BLOCK))
     flat = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, nb * BLOCK - n))
     blocks = flat.reshape(nb, BLOCK)
     absmax = jnp.max(jnp.abs(blocks), axis=1)
@@ -62,7 +71,7 @@ def quantize(x):
     return {"q": q.reshape(-1), "scale": scale}
 
 
-def moments_zeros_like(params, state_dtype: str, role: str = "mu"):
+def moments_zeros_like(params, state_dtype: str, role: str = "mu", pad_blocks=1):
     """A zeros moment tree in the requested storage format.
 
     ``state_dtype="int8"`` applies blockwise int8 only to the FIRST moment
@@ -71,6 +80,9 @@ def moments_zeros_like(params, state_dtype: str, role: str = "mu"):
     decodes small-v elements of a large-absmax block to exactly 0, turning
     the update into m/eps and diverging. bf16 keeps fp32's exponent, so
     relative error stays 2^-8 across v's wide dynamic range.
+
+    ``pad_blocks``: block-count alignment for quantized leaves (ZeRO dp
+    sharding; see quantized_zeros_like).
     """
     if state_dtype == "fp32":
         return jax.tree_util.tree_map(
@@ -81,7 +93,9 @@ def moments_zeros_like(params, state_dtype: str, role: str = "mu"):
             lambda p: jnp.zeros(p.shape, jnp.bfloat16), params
         )
     if state_dtype == "int8":
-        return jax.tree_util.tree_map(quantized_zeros_like, params)
+        return jax.tree_util.tree_map(
+            lambda p: quantized_zeros_like(p, pad_blocks=pad_blocks), params
+        )
     raise ValueError(f"unknown optimizer state_dtype {state_dtype!r}")
 
 
@@ -94,9 +108,11 @@ def decode_moment(state_leaf, shape):
 
 
 def encode_moment(value_f32, like_leaf):
-    """fp32 working value -> the same storage format as ``like_leaf``."""
+    """fp32 working value -> the same storage format as ``like_leaf``
+    (including its padded block count, so ZeRO-aligned leaves re-encode
+    into the same sharded shape)."""
     if is_quantized(like_leaf):
-        return quantize(value_f32)
+        return quantize(value_f32, nb=like_leaf["scale"].shape[0])
     return value_f32.astype(like_leaf.dtype)
 
 

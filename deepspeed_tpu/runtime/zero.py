@@ -121,7 +121,9 @@ def constrain(tree, specs):
     )
 
 
-def optstate_specs_like(opt_state, param_specs, params):
+def optstate_specs_like(
+    opt_state, param_specs, params, dp_size=1, data_axis=C.DATA_AXIS
+):
     """Map param specs onto an optax-style optimizer state pytree.
 
     Optimizer moments (``mu``/``nu``/master copies) are pytrees with the
@@ -132,6 +134,12 @@ def optstate_specs_like(opt_state, param_specs, params):
     (e.g. an attention out-proj vs an FF matrix under TP) on their own
     layouts — the reference keeps optimizer state strictly per-param too
     (deepspeed/pt/deepspeed_zero_optimizer.py:256-263).
+
+    Blockwise-quantized moments (``{'q','scale'}`` flat leaves, ops/quant)
+    shard over the data axis on their single flat dimension when
+    ``dp_size`` divides them (the engine pads the block count so it does);
+    block boundaries align with shard boundaries, keeping the decode
+    shard-local in memory.
 
     A shape-based fallback is used only when it is unambiguous (every param
     of that shape shares one spec); anything else is replicated.
@@ -148,9 +156,49 @@ def optstate_specs_like(opt_state, param_specs, params):
     for shape, s in param_paths.values():
         shape_to_specs.setdefault(shape, set()).add(s)
 
+    # do any params shard over the data axis at all? (stage >= 1 signal —
+    # quantized leaves should only dp-shard when the param specs do)
+    any_dp_sharded = any(
+        any(
+            data_axis == e or (isinstance(e, tuple) and data_axis in e)
+            for e in s
+        )
+        for _, s in param_paths.values()
+    )
+
     def spec_for(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()))
         toks = tuple(_key_token(k) for k in path)
+        if (
+            dp_size > 1
+            and any_dp_sharded
+            and len(toks) >= 2
+            and toks[-1] in ("q", "scale")
+            and len(shape) == 1
+        ):
+            # quantized flat leaf: the PARENT path (without 'q'/'scale')
+            # suffix-matches a param the usual way. (A real param that
+            # happens to be NAMED 'q' never lands here: its parent prefix
+            # is a subtree, not a param path, so this falls through to
+            # the normal shape-checked matching below.)
+            for i in range(len(toks) - 1):
+                hit = param_paths.get(toks[i:-1])
+                if hit is not None:
+                    # shard only when the BLOCK COUNT divides dp (true for
+                    # engine-padded state): q then splits on quant-block
+                    # boundaries and scale splits alongside. An unpadded
+                    # client leaf (nb % dp != 0) replicates BOTH leaves —
+                    # never q-sharded with a replicated scale, which would
+                    # put shard boundaries mid-block and force cross-shard
+                    # gathers on every decode.
+                    nb = shape[0] if toks[-1] == "scale" else None
+                    if toks[-1] == "q":
+                        from ..ops.quant import BLOCK
+
+                        nb = shape[0] // BLOCK
+                    if nb is not None and nb % dp_size == 0:
+                        return PartitionSpec(data_axis)
+                    return PartitionSpec()
         for i in range(len(toks)):  # longest suffix first
             hit = param_paths.get(toks[i:])
             if hit is not None and hit[0] == shape:

@@ -5,8 +5,10 @@ F1 90.71 after fine-tuning from a pretrained checkpoint, ~5 GPU-hours).
 Runs only when $SQUAD_DATA_DIR holds train-v1.1.json / dev-v1.1.json /
 vocab.txt (no network egress in CI, so this cannot be always-on); the
 synthetic distractor gate in test_bert_squad_gate.py is the fallback.
-Pretrained weights load from $BERT_CKPT_MSGPACK when provided — the full
-EM/F1 thresholds apply only then (a from-scratch BERT cannot reach them;
+Pretrained weights load from $BERT_CKPT_MSGPACK (this repo's layout) or
+$BERT_CKPT_TORCH (a public torch/HF pytorch_model.bin, converted
+in-process via tools/import_bert_checkpoint.py) — the full EM/F1
+thresholds apply only then (a from-scratch BERT cannot reach them;
 without a checkpoint the test asserts the pipeline itself: loss decreases
 and the extraction produces non-degenerate spans).
 """
@@ -65,12 +67,28 @@ def test_squad_v11_real_data_gate():
     )["params"]
 
     ckpt = os.environ.get("BERT_CKPT_MSGPACK")
+    torch_ckpt = os.environ.get("BERT_CKPT_TORCH")
     pretrained = bool(ckpt and os.path.exists(ckpt))
     if pretrained:
         from flax import serialization
 
         with open(ckpt, "rb") as f:
             params = serialization.from_bytes(params, f.read())
+    elif torch_ckpt and os.path.exists(torch_ckpt):
+        # public-artifact path: a raw torch/HF BERT checkpoint converts
+        # in-process (tools/import_bert_checkpoint.py), so the gate needs
+        # nothing beyond the published pytorch_model.bin
+        from tools.import_bert_checkpoint import (
+            convert_state_dict, load_torch_state_dict,
+        )
+
+        imported, _ = convert_state_dict(
+            load_torch_state_dict(torch_ckpt), head="qa"
+        )
+        if "qa_outputs" not in imported:
+            imported["qa_outputs"] = params["qa_outputs"]
+        params = imported
+        pretrained = True
 
     micro = int(os.environ.get("SQUAD_MICRO", "8"))
     epochs = float(os.environ.get("SQUAD_EPOCHS", "2"))

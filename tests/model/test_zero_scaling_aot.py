@@ -157,6 +157,122 @@ def test_gpt2_1_5b_zero2_fits_per_chip():
     assert per_dev < HBM_BYTES, f"{per_dev / 1e9:.1f} GB"
 
 
+def test_gpt2_1_5b_int8_state_shards_over_dp():
+    """int8 moment storage composes with ZeRO (round-3 verdict #4): at
+    1.5B over dp8 the quantized+compensated optimizer state must occupy
+    ~1/8 of its total bytes per chip. Asserted from XLA's AOT memory
+    analysis: argument bytes minus the replicated bf16 params leave the
+    state, which unsharded would be ~4 bytes/param (int8 mu + bf16 nu +
+    int8 comp) and sharded must come out near 4/8 = 0.5 bytes/param."""
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.ops.optimizers import Adam
+    from deepspeed_tpu.ops.quant import is_quantized
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime import zero as zero_lib
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dp, stage, micro, seq = 8, 2, 8, 1024
+    cfg = GPT2Config(
+        n_embd=1600, n_layer=48, n_head=25, dropout=0.0, remat=True,
+        remat_policy="dots_with_no_batch_dims_saveable", use_flash=False,
+    )
+    model = GPT2LMHeadModel(cfg)
+    mesh = build_mesh(data_parallel_size=dp)
+    params_shape = jax.eval_shape(
+        lambda rng: model.init(
+            {"params": rng}, jnp.zeros((1, seq), jnp.int32),
+            jnp.zeros((1, seq), jnp.int32), train=False,
+        )["params"],
+        jax.random.PRNGKey(0),
+    )
+    n = sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params_shape)
+    )
+    bf16_params_shape = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16), params_shape
+    )
+    opt = Adam(
+        state_dtype="int8", state_pad_blocks=dp, master_compensation=True
+    )
+    inner_shape = jax.eval_shape(opt.init, bf16_params_shape)
+    optstate_param_specs = zero_lib.zero_optstate_specs(
+        params_shape, dp, stage
+    )
+    inner_specs = zero_lib.optstate_specs_like(
+        inner_shape, optstate_param_specs, params_shape, dp_size=dp
+    )
+    # every quantized leaf's q AND scale shard over the data axis
+    flat = jax.tree_util.tree_leaves_with_path(
+        inner_shape["mu"], is_leaf=is_quantized
+    )
+    specs_flat = jax.tree_util.tree_leaves_with_path(
+        inner_specs["mu"], is_leaf=lambda x: isinstance(x, P)
+    )
+    spec_by_path = {tuple(str(k) for k in p): s for p, s in specs_flat}
+    nq = 0
+    for path, leaf in flat:
+        if not is_quantized(leaf):
+            continue
+        pq = spec_by_path[tuple(str(k) for k in path) + ("['q']",)]
+        ps = spec_by_path[tuple(str(k) for k in path) + ("['scale']",)]
+        assert pq == P("data"), (path, pq)
+        assert ps == P("data"), (path, ps)
+        nq += 1
+    assert nq > 0
+
+    inner_sh = zero_lib.specs_to_shardings(inner_specs, mesh)
+    param_sh = zero_lib.specs_to_shardings(
+        zero_lib.zero_param_specs(params_shape, dp, stage), mesh
+    )
+    grad_sh = zero_lib.specs_to_shardings(
+        zero_lib.zero_grad_specs(params_shape, dp, stage), mesh
+    )
+    data_sh = NamedSharding(mesh, P("data", None))
+
+    def train_step(params, inner, ids):
+        def loss_fn(p):
+            return model.apply({"params": p}, ids, ids, train=False)
+
+        grads = jax.grad(loss_fn)(params)
+        grads = jax.tree_util.tree_map(
+            lambda g, s: jax.lax.with_sharding_constraint(g, s),
+            grads, grad_sh,
+        )
+        new_params, new_inner, _ = opt.apply(params, grads, inner, 1e-4)
+        new_params = jax.tree_util.tree_map(
+            lambda m, s: jax.lax.with_sharding_constraint(m, s),
+            new_params, param_sh,
+        )
+        return new_params, new_inner
+
+    def shaped(tree, sh):
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+            tree, sh,
+        )
+
+    compiled = jax.jit(
+        train_step,
+        in_shardings=(param_sh, inner_sh, data_sh),
+        out_shardings=(param_sh, inner_sh),
+    ).lower(
+        shaped(bf16_params_shape, param_sh),
+        shaped(inner_shape, inner_sh),
+        jax.ShapeDtypeStruct((micro, seq), jnp.int32, sharding=data_sh),
+    ).compile()
+    mem = compiled.memory_analysis()
+    if mem is None:
+        pytest.skip("backend provides no memory analysis")
+    # replicated bf16 params = 2 bytes/param per chip; everything else in
+    # the arguments is optimizer state (+ the tiny ids). Unsharded state
+    # is ~4 bytes/param (int8 q + scale + bf16 nu + int8 comp); sharded it
+    # must land near 4/8 = 0.5 — well under the 0.8 bound, and nowhere
+    # near the 4.0 replication would cost.
+    state_bytes = mem.argument_size_in_bytes - 2 * n
+    assert state_bytes < 0.8 * n, f"{state_bytes / n:.2f} bytes/param"
+    assert mem.argument_size_in_bytes + mem.temp_size_in_bytes < HBM_BYTES
+
+
 def test_gpt2_1_5b_zero3_shards_params_too():
     """Stage 3 (beyond the reference) additionally shards parameters: the
     per-device footprint must drop well below stage 2's."""

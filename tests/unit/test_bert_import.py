@@ -1,0 +1,184 @@
+"""Pretrained-checkpoint importer tests (tools/import_bert_checkpoint.py).
+
+The importer is what lights up the real-data SQuAD gate (reference:
+tests/model/BingBertSquad/test_e2e_squad.py:40-58 fine-tunes from a
+pretrained BERT): a torch/HF ``state_dict`` becomes this repo's scanned
+12-param layout. Parity here is asserted against the actual HF
+``transformers`` torch model on random weights — logits must match to
+float tolerance through embeddings, all encoder layers, and both heads.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+from flax import serialization
+
+from deepspeed_tpu.models import BertConfig, BertForQuestionAnswering
+from deepspeed_tpu.models.bert import BertForPreTraining
+from tools.import_bert_checkpoint import convert_state_dict
+
+# gelu_new is the tanh approximation — the variant our block computes
+# (ops/transformer.py:316); classic BERT's erf-gelu differs by ~1e-3
+# which would mask real transposition bugs in this parity test
+HF_KW = dict(
+    vocab_size=100,
+    hidden_size=32,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    intermediate_size=64,
+    max_position_embeddings=32,
+    type_vocab_size=2,
+    hidden_act="gelu_new",
+    hidden_dropout_prob=0.0,
+    attention_probs_dropout_prob=0.0,
+)
+
+
+def _our_config():
+    return BertConfig(
+        vocab_size=HF_KW["vocab_size"],
+        hidden_size=HF_KW["hidden_size"],
+        num_hidden_layers=HF_KW["num_hidden_layers"],
+        num_attention_heads=HF_KW["num_attention_heads"],
+        intermediate_size=HF_KW["intermediate_size"],
+        max_position_embeddings=HF_KW["max_position_embeddings"],
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        use_flash=False,
+    )
+
+
+def _batch(B=2, S=16, pad_from=12, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, HF_KW["vocab_size"], (B, S)).astype(np.int32)
+    mask = np.ones((B, S), np.int32)
+    mask[:, pad_from:] = 0  # exercise the padding-mask path end to end
+    tt = rng.integers(0, 2, (B, S)).astype(np.int32)
+    return ids, mask, tt
+
+
+def test_qa_logits_match_hf():
+    hf = transformers.BertForQuestionAnswering(
+        transformers.BertConfig(**HF_KW)
+    ).eval()
+    params, inferred = convert_state_dict(
+        {k: v for k, v in hf.state_dict().items()}, head="qa"
+    )
+    assert inferred["hidden_size"] == HF_KW["hidden_size"]
+    assert inferred["num_hidden_layers"] == HF_KW["num_hidden_layers"]
+
+    model = BertForQuestionAnswering(_our_config())
+    ids, mask, tt = _batch()
+    with torch.no_grad():
+        out = hf(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+            token_type_ids=torch.tensor(tt, dtype=torch.long),
+        )
+    start, end = model.apply(
+        {"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+        jnp.asarray(tt), train=False,
+    )
+    # compare only non-padded positions (HF biases padded logits by -1e4,
+    # ours by -1e30 — both are "ignore"; the values there are arbitrary)
+    valid = mask.astype(bool)
+    np.testing.assert_allclose(
+        np.asarray(start)[valid], out.start_logits.numpy()[valid],
+        rtol=2e-4, atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(end)[valid], out.end_logits.numpy()[valid],
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_msgpack_roundtrip_into_model_init_structure():
+    """The serialized artifact must deserialize against a fresh
+    ``model.init`` tree — exactly how tests/model/test_squad_real_data.py
+    consumes $BERT_CKPT_MSGPACK."""
+    hf = transformers.BertForQuestionAnswering(
+        transformers.BertConfig(**HF_KW)
+    ).eval()
+    params, _ = convert_state_dict(
+        {k: v for k, v in hf.state_dict().items()}, head="qa"
+    )
+    model = BertForQuestionAnswering(_our_config())
+    ids, mask, tt = _batch()
+    target = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(tt), train=False,
+    )["params"]
+    restored = serialization.from_bytes(target, serialization.to_bytes(params))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(restored),
+        jax.tree_util.tree_leaves(params),
+    ):
+        assert a.shape == np.shape(b)
+    start1, _ = model.apply(
+        {"params": restored}, jnp.asarray(ids), jnp.asarray(mask),
+        jnp.asarray(tt), train=False,
+    )
+    start2, _ = model.apply(
+        {"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+        jnp.asarray(tt), train=False,
+    )
+    np.testing.assert_array_equal(np.asarray(start1), np.asarray(start2))
+
+
+def test_pretraining_head_mlm_parity():
+    """MLM logits over REAL vocab entries match HF exactly despite the
+    128-aligned vocab padding (padded rows: zero embedding, -1e30 bias —
+    exp() of which contributes nothing to any softmax)."""
+    hf = transformers.BertForPreTraining(
+        transformers.BertConfig(**HF_KW)
+    ).eval()
+    params, _ = convert_state_dict(
+        {k: v for k, v in hf.state_dict().items()}, head="pretraining"
+    )
+    assert params["bert"]["embeddings"]["word_embeddings"].shape[0] == 128
+    assert params["mlm_bias"].shape[0] == 128
+    assert np.all(params["mlm_bias"][HF_KW["vocab_size"]:] < -1e29)
+
+    model = BertForPreTraining(_our_config())
+    ids, mask, tt = _batch()
+    with torch.no_grad():
+        out = hf(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+            token_type_ids=torch.tensor(tt, dtype=torch.long),
+        )
+    # our pretraining model returns the loss; recompute its logits path
+    # by calling with labels over every valid position and comparing NLL
+    labels = np.where(mask > 0, ids, -1).astype(np.int32)
+    loss = model.apply(
+        {"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+        jnp.asarray(tt), jnp.asarray(labels), None, train=False,
+    )
+    hf_logits = out.prediction_logits.numpy()  # [B, S, V]
+    lse = torch.logsumexp(out.prediction_logits, dim=-1).numpy()
+    picked = np.take_along_axis(hf_logits, labels.clip(0)[..., None], -1)[..., 0]
+    valid = mask.astype(bool)
+    hf_nll = (lse - picked)[valid].mean()
+    np.testing.assert_allclose(float(loss), hf_nll, rtol=5e-4)
+
+
+def test_old_style_gamma_beta_keys():
+    """Pre-HF checkpoints name LayerNorm params gamma/beta; the importer
+    folds them."""
+    hf = transformers.BertForQuestionAnswering(
+        transformers.BertConfig(**HF_KW)
+    ).eval()
+    sd = {}
+    for k, v in hf.state_dict().items():
+        k = k.replace("LayerNorm.weight", "LayerNorm.gamma")
+        k = k.replace("LayerNorm.bias", "LayerNorm.beta")
+        sd[k] = v
+    params, _ = convert_state_dict(sd, head="qa")
+    assert params["bert"]["embeddings"]["LayerNorm"]["scale"].shape == (
+        HF_KW["hidden_size"],
+    )

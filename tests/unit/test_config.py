@@ -266,3 +266,24 @@ def test_mesh_block():
     assert cfg.model_parallel_size == 2
     assert cfg.sequence_parallel_size == 2
     assert cfg.pipeline_parallel_size == 1
+
+
+def test_amp_block_rejected():
+    """apex amp has no TPU path (reference deepspeed_light.py:516-521);
+    an enabled amp block must fail loudly, never be silently ignored."""
+    with pytest.raises(DeepSpeedConfigError, match="amp"):
+        make({"train_batch_size": 8, "amp": {"enabled": True}})
+    with pytest.raises(DeepSpeedConfigError, match="bf16"):
+        make({"train_batch_size": 8, "amp": {"opt_level": "O2"}})
+    # explicitly disabled amp is a no-op, as in the reference
+    cfg = make({"train_batch_size": 8, "amp": {"enabled": False}})
+    assert cfg.train_batch_size == 8
+
+
+def test_zero_allow_untested_optimizer_key():
+    cfg = make({"train_batch_size": 8})
+    assert cfg.zero_allow_untested_optimizer is False
+    cfg = make(
+        {"train_batch_size": 8, "zero_allow_untested_optimizer": True}
+    )
+    assert cfg.zero_allow_untested_optimizer is True

@@ -301,3 +301,25 @@ def test_train_batch_matches_unfused_loop():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
         )
+
+
+def test_zero_untested_optimizer_requires_opt_in():
+    """ZeRO + an optimizer outside the tested set (Adam family / Lamb)
+    must demand zero_allow_untested_optimizer, mirroring the reference
+    guard (deepspeed_light.py:506-515)."""
+    from deepspeed_tpu.config import DeepSpeedConfigError
+
+    cfg = config_dict(batch_size=16, zero_stage=2, optimizer="SGD")
+    with pytest.raises(
+        DeepSpeedConfigError, match="zero_allow_untested_optimizer"
+    ):
+        build_engine(cfg)
+    # the opt-in unlocks it (warning, not error)
+    cfg = config_dict(batch_size=16, zero_stage=2, optimizer="SGD", lr=5e-2)
+    cfg["zero_allow_untested_optimizer"] = True
+    engine, _ = build_engine(cfg)
+    losses = train_steps(engine, n_batches=4)
+    assert np.isfinite(losses).all()
+    # tested optimizers never need the flag
+    engine, _ = build_engine(config_dict(batch_size=16, zero_stage=2))
+    assert engine is not None

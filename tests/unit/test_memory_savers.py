@@ -50,6 +50,25 @@ def test_blocked_ce_matches_naive_gradients():
     np.testing.assert_allclose(np.asarray(gW1), np.asarray(gW2), rtol=2e-5, atol=2e-5)
 
 
+def test_blocked_ce_empty_ignore_values_counts_all_labels():
+    """ignore_values=() with a non-dividing T: pad positions are masked by
+    index, so label-0 padding is never counted and the empty tuple doesn't
+    crash (round-3 advisor finding)."""
+    rng = np.random.default_rng(4)
+    B, S, H, V = 2, 13, 16, 64  # 13 % block_rows(8) != 0 -> padded
+    x = jnp.asarray(rng.normal(size=(B, S, H)), jnp.float32)
+    W = jnp.asarray(rng.normal(size=(V, H)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, size=(B, S)), jnp.int32)
+    labels = labels.at[:, :3].set(0)  # real label-0 targets must count
+    naive = cross_entropy_ignore_index(x @ W.T, labels, ignore_values=())
+    blocked = blocked_lm_head_loss(
+        x, W, labels, block_rows=8, ignore_values=()
+    )
+    np.testing.assert_allclose(
+        np.asarray(blocked), np.asarray(naive), rtol=1e-5, atol=1e-5
+    )
+
+
 def test_blocked_ce_all_ignored_is_zero():
     x = jnp.zeros((1, 4, 8), jnp.float32)
     W = jnp.zeros((32, 8), jnp.float32)
@@ -420,23 +439,32 @@ def test_engine_optimizer_state_dtype_config():
     assert float(loss1) <= float(loss0)
 
 
-def test_engine_downgrades_int8_moments_under_zero():
-    """Quantized moment leaves can't carry ZeRO partition layouts — under
-    stage>=1 with dp>1 the engine stores bf16 moments instead (sharded),
-    never silently replicated int8."""
+def test_engine_int8_moments_shard_under_zero():
+    """int8 moment storage and ZeRO sharding COMPOSE (round-3 verdict #4):
+    under stage>=1 with dp>1 the quantized {'q','scale'} leaves keep int8
+    storage AND shard over the data axis (flat layout, block count padded
+    to dp) — per-chip moment bytes ~ total/dp on top of the 4x dtype
+    saving. Training through the sharded quantized state must work."""
     import flax.linen as nn
 
     import deepspeed_tpu
+    from deepspeed_tpu.config.constants import DATA_AXIS
     from deepspeed_tpu.parallel.mesh import build_mesh
 
     class M(nn.Module):
         @nn.compact
-        def __call__(self, x, train=True):
-            return jnp.mean(nn.Dense(4)(x) ** 2)
+        def __call__(self, x, y, train=True):
+            h = nn.relu(nn.Dense(64)(x))
+            logp = jax.nn.log_softmax(nn.Dense(4)(h))
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
 
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(16, 8)).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.int32)
     model = M()
-    X = jnp.zeros((16, 8), jnp.float32)
-    params = model.init({"params": jax.random.PRNGKey(0)}, X)["params"]
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.asarray(X), jnp.asarray(Y)
+    )["params"]
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
         mesh=build_mesh(data_parallel_size=8),
@@ -453,8 +481,32 @@ def test_engine_downgrades_int8_moments_under_zero():
         engine.optimizer_state["inner"]
         if engine.master_in_opt else engine.optimizer_state
     )
-    for leaf in jax.tree_util.tree_leaves(inner["mu"]):
-        assert leaf.dtype == jnp.bfloat16, leaf.dtype
+    from deepspeed_tpu.ops.quant import BLOCK, is_quantized
+
+    n_sharded = 0
+    for leaf in jax.tree_util.tree_leaves(
+        inner["mu"], is_leaf=is_quantized
+    ):
+        if not is_quantized(leaf):
+            continue
+        assert leaf["q"].dtype == jnp.int8
+        assert leaf["scale"].shape[0] % 8 == 0  # padded to dp
+        spec_q = leaf["q"].sharding.spec
+        spec_s = leaf["scale"].sharding.spec
+        assert spec_q == (DATA_AXIS,), spec_q
+        assert spec_s == (DATA_AXIS,), spec_s
+        # shard boundaries land on quant-block boundaries
+        assert (leaf["q"].shape[0] // 8) % BLOCK == 0
+        n_sharded += 1
+    assert n_sharded > 0
+    # training through the sharded quantized state converges
+    losses = []
+    for _ in range(12):
+        loss = engine(X, Y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < 0.7 * losses[0], losses
 
 
 def test_engine_rejects_reduced_state_for_fused_lamb():

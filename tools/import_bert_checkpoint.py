@@ -1,0 +1,312 @@
+"""Import a pretrained torch/HF BERT checkpoint into the repo's layout.
+
+The reference's SQuAD quality gate starts from a pretrained BERT
+(reference: tests/model/BingBertSquad/test_e2e_squad.py:40-58 — EM 83.98 /
+F1 90.71 is only reachable from pretrained weights). This tool produces
+the `$BERT_CKPT_MSGPACK` artifact that tests/model/test_squad_real_data.py
+consumes, from any of:
+
+  - a HuggingFace model directory (``pytorch_model.bin`` inside), or
+  - a bare ``state_dict`` file saved by torch (``.bin``/``.pt``), with or
+    without a wrapping ``{"model": ...}``/``{"module": ...}`` key.
+
+Layout translation (torch Linear stores ``[out, in]``; our block applies
+``x @ W`` with ``[in, out]`` — every dense weight transposes):
+
+  HF ``bert.encoder.layer.{i}.attention.self.{query,key,value}``
+    -> ``attn_qkvw`` [layers, H, 3H] (transposed, concatenated) / ``attn_qkvb``
+  HF ``attention.output.dense``        -> ``attn_ow``/``attn_ob``
+  HF ``attention.output.LayerNorm``    -> ``attn_nw``/``attn_nb``
+  HF ``intermediate.dense``            -> ``inter_w``/``inter_b``
+  HF ``output.dense``                  -> ``output_w``/``output_b``
+  HF ``output.LayerNorm``              -> ``norm_w``/``norm_b``
+
+The per-layer tensors stack along a leading ``layers`` axis (the
+``nn.scan`` layout of models/bert.py BertEncoder). The vocabulary pads up
+to a multiple of 128 (MXU tiling, models/bert.py:105): embedding rows pad
+with zeros and the MLM bias pads with -1e30, so padded tokens contribute
+exp(-1e30)=0 to every softmax — logits over REAL tokens are bit-identical
+to the unpadded model.
+
+Usage:
+  python tools/import_bert_checkpoint.py CKPT_OR_DIR -o bert_large.msgpack \
+      --head qa            # qa | pretraining | none
+"""
+
+import argparse
+import os
+import re
+import sys
+
+import numpy as np
+
+VOCAB_ALIGN = 128
+MLM_PAD_BIAS = -1e30
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def load_torch_state_dict(path):
+    """Load a state_dict from a file or HF model directory; returns
+    {name: np.ndarray (f32)}."""
+    import torch
+
+    if os.path.isdir(path):
+        for fname in ("pytorch_model.bin", "model.pt", "model.bin"):
+            cand = os.path.join(path, fname)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise FileNotFoundError(f"no pytorch_model.bin under {path}")
+    try:
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+    except TypeError:  # older torch without weights_only
+        sd = torch.load(path, map_location="cpu")
+    for wrapper in ("model", "module", "state_dict"):
+        if isinstance(sd, dict) and wrapper in sd and isinstance(
+            sd[wrapper], dict
+        ):
+            sd = sd[wrapper]
+    return {
+        k: v.detach().to(torch.float32).numpy()
+        for k, v in sd.items()
+        if hasattr(v, "detach")
+    }
+
+
+def _normalize_keys(sd):
+    """Strip common prefixes, fold pre-HF naming (gamma/beta) into
+    weight/bias, and coerce values (torch tensors or arrays) to f32
+    numpy so one mapping serves both checkpoint generations."""
+    out = {}
+    for k, v in sd.items():
+        k = re.sub(r"^(module\.|bert\.)?", "", k, count=1)
+        k = k.replace(".gamma", ".weight").replace(".beta", ".bias")
+        if hasattr(v, "detach"):  # torch tensor
+            v = v.detach().cpu().to_dense() if v.is_sparse else v.detach().cpu()
+            v = v.float().numpy()
+        out[k] = np.asarray(v)
+    return out
+
+
+def _get(sd, key):
+    if key not in sd:
+        raise KeyError(
+            f"checkpoint is missing {key!r}; keys look like: "
+            f"{sorted(sd)[:8]} ..."
+        )
+    return sd[key]
+
+
+def convert_state_dict(sd, head="qa", dtype=np.float32):
+    """torch/HF BERT ``state_dict`` -> this repo's flax param tree
+    (models/bert.py BertForQuestionAnswering / BertForPreTraining).
+
+    Infers H / layers / intermediate / vocab from tensor shapes; returns
+    (params, inferred_config_dict).
+    """
+    sd = _normalize_keys(sd)
+    word = _get(sd, "embeddings.word_embeddings.weight")
+    vocab, H = word.shape
+    layer_ids = sorted({
+        int(m.group(1))
+        for k in sd
+        if (m := re.match(r"encoder\.layer\.(\d+)\.", k))
+    })
+    if not layer_ids or layer_ids != list(range(len(layer_ids))):
+        raise ValueError(f"unexpected encoder layer numbering: {layer_ids}")
+    L = len(layer_ids)
+    inter = _get(sd, "encoder.layer.0.intermediate.dense.weight").shape[0]
+
+    def stack(fmt, transpose=False):
+        ts = [_get(sd, fmt.format(i)) for i in range(L)]
+        if transpose:
+            ts = [t.T for t in ts]
+        return np.stack(ts).astype(dtype)
+
+    qkvw = np.stack([
+        np.concatenate(
+            [
+                _get(sd, f"encoder.layer.{i}.attention.self.{part}.weight").T
+                for part in ("query", "key", "value")
+            ],
+            axis=1,
+        )
+        for i in range(L)
+    ]).astype(dtype)  # [L, H, 3H]
+    qkvb = np.stack([
+        np.concatenate(
+            [
+                _get(sd, f"encoder.layer.{i}.attention.self.{part}.bias")
+                for part in ("query", "key", "value")
+            ]
+        )
+        for i in range(L)
+    ]).astype(dtype)
+
+    layer = {
+        "attn_qkvw": qkvw,
+        "attn_qkvb": qkvb,
+        "attn_ow": stack(
+            "encoder.layer.{}.attention.output.dense.weight", transpose=True
+        ),
+        "attn_ob": stack("encoder.layer.{}.attention.output.dense.bias"),
+        "attn_nw": stack(
+            "encoder.layer.{}.attention.output.LayerNorm.weight"
+        ).astype(np.float32),
+        "attn_nb": stack(
+            "encoder.layer.{}.attention.output.LayerNorm.bias"
+        ).astype(np.float32),
+        "inter_w": stack(
+            "encoder.layer.{}.intermediate.dense.weight", transpose=True
+        ),
+        "inter_b": stack("encoder.layer.{}.intermediate.dense.bias"),
+        "output_w": stack(
+            "encoder.layer.{}.output.dense.weight", transpose=True
+        ),
+        "output_b": stack("encoder.layer.{}.output.dense.bias"),
+        "norm_w": stack(
+            "encoder.layer.{}.output.LayerNorm.weight"
+        ).astype(np.float32),
+        "norm_b": stack(
+            "encoder.layer.{}.output.LayerNorm.bias"
+        ).astype(np.float32),
+    }
+
+    vocab_padded = _round_up(vocab, VOCAB_ALIGN)
+    word_padded = np.zeros((vocab_padded, H), dtype)
+    word_padded[:vocab] = word.astype(dtype)
+
+    bert = {
+        "embeddings": {
+            "word_embeddings": word_padded,
+            "position_embeddings": _get(
+                sd, "embeddings.position_embeddings.weight"
+            ).astype(dtype),
+            "token_type_embeddings": _get(
+                sd, "embeddings.token_type_embeddings.weight"
+            ).astype(dtype),
+            "LayerNorm": {
+                "scale": _get(sd, "embeddings.LayerNorm.weight").astype(
+                    np.float32
+                ),
+                "bias": _get(sd, "embeddings.LayerNorm.bias").astype(
+                    np.float32
+                ),
+            },
+        },
+        "encoder": {"layer": layer},
+        # HF QA checkpoints ship without a pooler (add_pooling_layer=False);
+        # our BertModel always declares one (the NSP head needs it) — zeros
+        # keep the tree complete and the QA path never reads it
+        "pooler": {
+            "kernel": (
+                sd["pooler.dense.weight"].T.astype(dtype)
+                if "pooler.dense.weight" in sd
+                else np.zeros((H, H), dtype)
+            ),
+            "bias": (
+                sd["pooler.dense.bias"].astype(dtype)
+                if "pooler.dense.bias" in sd
+                else np.zeros((H,), dtype)
+            ),
+        },
+    }
+
+    params = {"bert": bert}
+    if head == "qa":
+        if "qa_outputs.weight" in sd:
+            params["qa_outputs"] = {
+                "kernel": sd["qa_outputs.weight"].T.astype(dtype),
+                "bias": sd["qa_outputs.bias"].astype(dtype),
+            }
+        # else: leave the head to the caller's fresh init (fine-tuning
+        # from a pretraining-only checkpoint re-initializes the QA head)
+    elif head == "pretraining":
+        params["transform"] = {
+            "kernel": _get(
+                sd, "cls.predictions.transform.dense.weight"
+            ).T.astype(dtype),
+            "bias": _get(sd, "cls.predictions.transform.dense.bias").astype(
+                dtype
+            ),
+        }
+        params["transform_ln"] = {
+            "scale": _get(
+                sd, "cls.predictions.transform.LayerNorm.weight"
+            ).astype(np.float32),
+            "bias": _get(
+                sd, "cls.predictions.transform.LayerNorm.bias"
+            ).astype(np.float32),
+        }
+        mlm_bias = np.full((vocab_padded,), MLM_PAD_BIAS, np.float32)
+        mlm_bias[:vocab] = _get(sd, "cls.predictions.bias").astype(np.float32)
+        params["mlm_bias"] = mlm_bias
+        params["nsp"] = {
+            "kernel": _get(sd, "cls.seq_relationship.weight").T.astype(dtype),
+            "bias": _get(sd, "cls.seq_relationship.bias").astype(dtype),
+        }
+    elif head != "none":
+        raise ValueError(f"unknown head {head!r} (qa|pretraining|none)")
+
+    cfg = {
+        "vocab_size": int(vocab),
+        "hidden_size": int(H),
+        "num_hidden_layers": int(L),
+        "num_attention_heads": int(H // 64),  # BERT convention: head dim 64
+        "intermediate_size": int(inter),
+        "max_position_embeddings": int(
+            bert["embeddings"]["position_embeddings"].shape[0]
+        ),
+        "type_vocab_size": int(
+            bert["embeddings"]["token_type_embeddings"].shape[0]
+        ),
+    }
+    return params, cfg
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("checkpoint", help="torch state_dict file or HF model dir")
+    ap.add_argument("-o", "--output", required=True, help="output .msgpack")
+    ap.add_argument(
+        "--head", default="qa", choices=("qa", "pretraining", "none")
+    )
+    ap.add_argument(
+        "--dtype", default="float32", choices=("float32", "bfloat16"),
+        help="storage dtype for dense weights (LayerNorms stay fp32)",
+    )
+    args = ap.parse_args(argv)
+
+    from flax import serialization
+    import jax.numpy as jnp
+
+    dtype = np.float32 if args.dtype == "float32" else jnp.bfloat16
+    sd = load_torch_state_dict(args.checkpoint)
+    params, cfg = convert_state_dict(sd, head=args.head, dtype=dtype)
+    with open(args.output, "wb") as f:
+        f.write(serialization.to_bytes(params))
+    n = sum(
+        int(np.prod(np.shape(leaf)))
+        for leaf in _tree_leaves(params)
+    )
+    print(
+        f"wrote {args.output}: {n / 1e6:.1f}M params, config {cfg}",
+        file=sys.stderr,
+    )
+    return cfg
+
+
+def _tree_leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _tree_leaves(v)
+    else:
+        yield tree
+
+
+if __name__ == "__main__":
+    main()
