@@ -566,16 +566,22 @@ class DeepSpeedEngine:
         sd = self.config.optimizer_state_dtype
         if sd == "int8" and self.zero_stage >= 1 and self.dp_world_size > 1:
             # quantized {'q','scale'} moment leaves shard over their FLAT
-            # layout: the block count pads to a dp multiple so shard
-            # boundaries land on quantization-block boundaries, and
-            # optstate_specs_like places the data axis on the flat dim —
-            # int8 moment memory divides by dp ON TOP of the 4x dtype
-            # saving (the two memory savers compose; round-3 verdict #4)
+            # layout: the block count pads so shard boundaries land on
+            # quantization-block boundaries, and optstate_specs_like
+            # places the data axis on the flat dim — int8 moment memory
+            # divides by dp ON TOP of the 4x dtype saving (the two memory
+            # savers compose; round-3 verdict #4). The pad multiple is the
+            # dp-INDEPENDENT constant max(256, dp): padding to dp itself
+            # would bake the saving mesh's size into the stored shapes and
+            # break elastic dp-resize resume (a dp4 checkpoint could not
+            # deserialize into a dp8 engine's template). 256 covers every
+            # power-of-two dp <= 256 at < 0.5 MB overhead per leaf.
             if hasattr(opt, "state_pad_blocks"):
-                opt.state_pad_blocks = self.dp_world_size
+                pad = max(256, self.dp_world_size)
+                opt.state_pad_blocks = pad
                 log_dist(
                     "int8 optimizer moments shard over the data axis "
-                    f"(flat layout, blocks padded to dp={self.dp_world_size})",
+                    f"(flat layout, blocks padded to a multiple of {pad})",
                     ranks=[0],
                 )
         if sd != "fp32":

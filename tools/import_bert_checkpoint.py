@@ -83,7 +83,10 @@ def _normalize_keys(sd):
     numpy so one mapping serves both checkpoint generations."""
     out = {}
     for k, v in sd.items():
-        k = re.sub(r"^(module\.|bert\.)?", "", k, count=1)
+        # DataParallel/DDP saves prepend "module." (possibly nested);
+        # strip all of them, THEN one optional "bert." scope
+        k = re.sub(r"^(module\.)+", "", k)
+        k = re.sub(r"^bert\.", "", k, count=1)
         k = k.replace(".gamma", ".weight").replace(".beta", ".bias")
         if hasattr(v, "detach"):  # torch tensor
             v = v.detach().cpu().to_dense() if v.is_sparse else v.detach().cpu()
