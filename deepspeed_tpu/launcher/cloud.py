@@ -1,0 +1,166 @@
+"""Cloud TPU-VM provisioning helper — the TPU analog of the reference's
+``azure/`` cluster scripts (reference: azure/create_vms.sh provisions N
+VMs from azure_config.json, azure/setup_vms.sh distributes ssh config,
+azure/attach.sh opens a shell, azure/shutdown_vms.sh tears down).
+
+On GCP the unit of provisioning is one ``gcloud compute tpus tpu-vm``
+command per pod (the pod's hosts come up together), so this module is a
+thin, testable command *builder* plus a small CLI:
+
+    python -m deepspeed_tpu.launcher.cloud create   --config tpu_config.json
+    python -m deepspeed_tpu.launcher.cloud hostfile --config tpu_config.json
+    python -m deepspeed_tpu.launcher.cloud ssh      --config tpu_config.json
+    python -m deepspeed_tpu.launcher.cloud delete   --config tpu_config.json
+
+``tpu_config.json`` (analog of azure_config.json):
+
+    {
+      "name": "ds-pod",            // TPU VM name
+      "zone": "us-central2-b",
+      "accelerator_type": "v5e-8", // pod slice
+      "version": "tpu-ubuntu2204-base",
+      "project": null,             // optional gcloud project override
+      "spot": false                // preemptible capacity
+    }
+
+``hostfile`` turns ``gcloud ... describe --format=json`` output into the
+launcher's hostfile grammar (``hostname slots=N`` — launcher/runner.py),
+wiring provisioning directly into ``bin/deepspeed --hostfile``. The
+in-tree ``bin/deepspeed --tpu <name>`` pod auto-discovery covers the
+common case at runtime; this module covers creation/teardown. Every
+command is printed before execution and ``--dry-run`` prints without
+executing (also what the unit tests assert on — no gcloud in CI).
+"""
+
+import argparse
+import json
+import subprocess
+import sys
+
+
+REQUIRED = ("name", "zone", "accelerator_type", "version")
+
+
+def load_config(path):
+    with open(path) as f:
+        cfg = json.load(f)
+    missing = [k for k in REQUIRED if not cfg.get(k)]
+    if missing:
+        raise ValueError(
+            f"tpu config {path} is missing required keys: {missing}"
+        )
+    return cfg
+
+
+def _base(cfg, verb):
+    cmd = ["gcloud", "compute", "tpus", "tpu-vm", verb, cfg["name"],
+           "--zone", cfg["zone"]]
+    if cfg.get("project"):
+        cmd += ["--project", cfg["project"]]
+    return cmd
+
+
+def build_create_command(cfg):
+    cmd = _base(cfg, "create") + [
+        "--accelerator-type", cfg["accelerator_type"],
+        "--version", cfg["version"],
+    ]
+    if cfg.get("spot"):
+        cmd.append("--spot")
+    return cmd
+
+
+def build_delete_command(cfg):
+    return _base(cfg, "delete") + ["--quiet"]
+
+
+def build_describe_command(cfg):
+    return _base(cfg, "describe") + ["--format=json"]
+
+
+def build_ssh_command(cfg, worker="0", command=None):
+    cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", cfg["name"],
+           "--zone", cfg["zone"], f"--worker={worker}"]
+    if cfg.get("project"):
+        cmd += ["--project", cfg["project"]]
+    if command:
+        cmd += ["--command", command]
+    return cmd
+
+
+def hostfile_from_describe(describe_json, slots_per_host=None):
+    """``describe --format=json`` -> launcher hostfile text.
+
+    Endpoint parsing and per-host slot derivation (from
+    ``acceleratorType``) are shared with the runtime pod discovery
+    (launcher/runner.py:pod_resource_pool_from_describe), so provisioning
+    and ``--tpu`` discovery can never disagree. ``slots_per_host``
+    overrides the derived count.
+    """
+    from .runner import pod_resource_pool_from_describe
+
+    doc = (
+        json.loads(describe_json)
+        if isinstance(describe_json, (str, bytes))
+        else describe_json
+    )
+    pool = pod_resource_pool_from_describe(doc)
+    return "".join(
+        f"{host} slots={slots_per_host or slots}\n"
+        for host, slots in pool.items()
+    )
+
+
+def _run(cmd, dry_run):
+    print("cmd:", " ".join(cmd), file=sys.stderr)
+    if dry_run:
+        return 0
+    return subprocess.call(cmd)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "verb", choices=("create", "delete", "describe", "hostfile", "ssh")
+    )
+    ap.add_argument("--config", required=True, help="tpu_config.json path")
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("--worker", default="0", help="ssh target worker index")
+    ap.add_argument(
+        "--slots-per-host", type=int, default=None,
+        help="override chips per pod host for hostfile output (default: "
+        "derived from the pod's acceleratorType)",
+    )
+    ap.add_argument(
+        "-o", "--output", default=None, help="hostfile output path (default stdout)"
+    )
+    args = ap.parse_args(argv)
+    cfg = load_config(args.config)
+
+    if args.verb == "create":
+        return _run(build_create_command(cfg), args.dry_run)
+    if args.verb == "delete":
+        return _run(build_delete_command(cfg), args.dry_run)
+    if args.verb == "describe":
+        return _run(build_describe_command(cfg), args.dry_run)
+    if args.verb == "ssh":
+        return _run(build_ssh_command(cfg, worker=args.worker), args.dry_run)
+    # hostfile: describe (unless dry-run reads stdin) -> grammar
+    if args.dry_run:
+        describe = sys.stdin.read()
+    else:
+        describe = subprocess.check_output(
+            build_describe_command(cfg), text=True
+        )
+    text = hostfile_from_describe(describe, slots_per_host=args.slots_per_host)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+        print(f"wrote {args.output}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

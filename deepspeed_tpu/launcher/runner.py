@@ -247,6 +247,37 @@ def _parse_worker_endpoints(raw):
     return hosts
 
 
+def _slots_for(accel, n_hosts):
+    """Per-host chip slots from an accelerator type like 'v5litepod-16' /
+    'v4-32': the trailing number is total chips (v5e) or TensorCores (v4);
+    divided over the worker count it bounds per-host slots."""
+    slots = _CHIPS_PER_HOST
+    if accel:
+        try:
+            total = int(str(accel).rsplit("-", 1)[1])
+            slots = max(1, min(_CHIPS_PER_HOST, total // n_hosts))
+        except (IndexError, ValueError):
+            pass
+    return slots
+
+
+def pod_resource_pool_from_describe(desc):
+    """``gcloud ... describe --format=json`` output -> OrderedDict(host ->
+    chip slots), acceleratorType-aware. Shared by runtime pod discovery
+    (below) and the provisioning helper (launcher/cloud.py), so the two
+    never disagree on endpoint parsing or slot counts. Raises ValueError
+    when the output carries no usable endpoints."""
+    hosts = [
+        ep.get("ipAddress")
+        for ep in desc.get("networkEndpoints", [])
+        if ep.get("ipAddress")
+    ]
+    if not hosts:
+        raise ValueError("describe output has no usable networkEndpoints")
+    slots = _slots_for(desc.get("acceleratorType"), len(hosts))
+    return collections.OrderedDict((h, slots) for h in hosts)
+
+
 def discover_tpu_pod(tpu_name, metadata_get=_metadata_get,
                      gcloud_describe=_gcloud_describe):
     """Resolve a TPU pod name into an OrderedDict(host -> chip slots).
@@ -256,37 +287,23 @@ def discover_tpu_pod(tpu_name, metadata_get=_metadata_get,
     Source 2 (off the pod): ``gcloud compute tpus tpu-vm describe``.
     Both are injectable for tests.
     """
-    hosts, accel = None, None
     raw = metadata_get("worker-network-endpoints")
     if raw:
         hosts = _parse_worker_endpoints(raw)
-        accel = metadata_get("accelerator-type")
-    if not hosts:
-        desc = gcloud_describe(tpu_name)
-        if desc:
-            hosts = [
-                ep.get("ipAddress")
-                for ep in desc.get("networkEndpoints", [])
-                if ep.get("ipAddress")
-            ]
-            accel = desc.get("acceleratorType", accel)
-    if not hosts:
-        raise RuntimeError(
-            f"could not discover TPU pod {tpu_name!r}: no metadata server "
-            "and no usable `gcloud compute tpus tpu-vm describe` output — "
-            "pass --hostfile instead"
-        )
-    slots = _CHIPS_PER_HOST
-    if accel:
-        # accelerator-type like 'v5litepod-16' / 'v4-32': trailing number is
-        # total chips (v5e) or TensorCores (v4); divided over the worker
-        # count it bounds per-host slots
+        if hosts:
+            slots = _slots_for(metadata_get("accelerator-type"), len(hosts))
+            return collections.OrderedDict((h, slots) for h in hosts)
+    desc = gcloud_describe(tpu_name)
+    if desc:
         try:
-            total = int(str(accel).rsplit("-", 1)[1])
-            slots = max(1, min(_CHIPS_PER_HOST, total // len(hosts)))
-        except (IndexError, ValueError):
+            return pod_resource_pool_from_describe(desc)
+        except ValueError:
             pass
-    return collections.OrderedDict((h, slots) for h in hosts)
+    raise RuntimeError(
+        f"could not discover TPU pod {tpu_name!r}: no metadata server "
+        "and no usable `gcloud compute tpus tpu-vm describe` output — "
+        "pass --hostfile instead"
+    )
 
 
 def _collect_exports():

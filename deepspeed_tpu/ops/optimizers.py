@@ -46,15 +46,18 @@ def _tree_f32(tree):
 _CHUNK_ELEMENTS = 1 << 25  # 33.5M
 
 
-def _slice_count(L, size):
+def _slice_count(L, size, threshold=None):
     """Fewest slices n (dividing the leading axis L) that bound each
-    slice's working set to ~_CHUNK_ELEMENTS. Looping single rows would
-    turn an embedding table into a ~50k-iteration device loop; grouping
-    rows keeps the loop a handful of big fused steps. Returns 0 when no
-    reasonable divisor exists (e.g. a large prime leading axis, where
-    "dividing slices" degenerates into a per-row loop with thousands of
-    device iterations) — callers fall back to the whole-leaf update."""
-    want = max(1, -(-size // _CHUNK_ELEMENTS))
+    slice's working set to ~``threshold`` (default _CHUNK_ELEMENTS).
+    Looping single rows would turn an embedding table into a
+    ~50k-iteration device loop; grouping rows keeps the loop a handful of
+    big fused steps. Returns 0 when no reasonable divisor exists (e.g. a
+    large prime leading axis, where "dividing slices" degenerates into a
+    per-row loop with thousands of device iterations) — callers fall back
+    to the whole-leaf update."""
+    if threshold is None:
+        threshold = _CHUNK_ELEMENTS
+    want = max(1, -(-size // threshold))
     if want >= L:
         return L
     for n in range(want, min(L, max(64, 8 * want)) + 1):
@@ -63,10 +66,17 @@ def _slice_count(L, size):
     return 0
 
 
-def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None):
+def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None, threshold=None):
     """Run ``leaf_fn`` over leading-axis row groups via ``lax.scan``;
     returns None when the leaf doesn't decompose (callers fall back to the
     whole-leaf path).
+
+    Chunking is a SINGLE-CHIP memory measure (bounds fp32 working temps on
+    a 16 GB chip carrying billion-param state). Under ZeRO sharding the
+    engine DISABLES it (``Adam.chunk_elements`` -> huge): per-device
+    working sets are already divided by dp, and splitting a dp-sharded
+    flat quantized leaf's dimension for the scan would force GSPMD to
+    gather it (measured +12.5 GB of temps at 1.5B dp8 in the AOT proof).
 
     The slices are leading-axis reshapes (bitcasts — no data movement) and
     scan writes each output slice directly into its stacked output buffer,
@@ -78,10 +88,12 @@ def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None):
     param-shaped int8 compensation leaf (sliced alongside)."""
     from .quant import BLOCK, is_quantized
 
-    if p.ndim < 2 or p.shape[0] <= 1 or p.size < _CHUNK_ELEMENTS:
+    if threshold is None:
+        threshold = _CHUNK_ELEMENTS
+    if p.ndim < 2 or p.shape[0] <= 1 or p.size < threshold:
         return None
     L = p.shape[0]
-    n = _slice_count(L, p.size)
+    n = _slice_count(L, p.size, threshold)
     if n <= 1:
         return None
     rows = L // n  # rows per slice
@@ -208,6 +220,10 @@ class Adam(Optimizer):
     # sets this to the ZeRO dp size so the flat {'q','scale'} arrays split
     # evenly over the data axis (ops/quant.quantized_zeros_like).
     state_pad_blocks: int = 1
+    # Working-set bound (elements) above which leaves update in leading-
+    # axis chunks; the engine raises this to "never" under ZeRO sharding
+    # (see _chunked_leaf_update).
+    chunk_elements: int = _CHUNK_ELEMENTS
     supports_gate = True
 
     def init(self, params):
@@ -281,7 +297,10 @@ class Adam(Optimizer):
             return out
 
         def leaf_outer(p, g, m_st, v_st, comp=None):
-            chunked = _chunked_leaf_update(leaf, p, g, m_st, v_st, comp)
+            chunked = _chunked_leaf_update(
+                leaf, p, g, m_st, v_st, comp,
+                threshold=self.chunk_elements,
+            )
             return chunked if chunked is not None else leaf(p, g, m_st, v_st, comp)
 
         trees = [params, grads, state["mu"], state["nu"]]

@@ -81,7 +81,10 @@ def ring_attention_local(
     # kv moves j -> j+1 each step, so at step t device i holds chunk (i-t)%sp
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
-    qf = q.astype(jnp.float32)
+    # matmul operands stay in the storage dtype (bf16 MXU pairs with f32
+    # accumulation via preferred_element_type — an explicit f32 upcast
+    # forces the slow f32 MXU path, the round-4 flash-kernel finding);
+    # only the online-softmax bookkeeping (m, l, acc) runs f32
     iota_q = jax.lax.iota(jnp.int32, Sl)
     gq = idx * Sl + iota_q  # global query positions [Sl]
     have_valid = kv_valid is not None
@@ -93,12 +96,12 @@ def ring_attention_local(
         s = (
             jnp.einsum(
                 "bhqd,bhkd->bhqk",
-                qf,
-                k_c.astype(jnp.float32),
+                q,
+                k_c,
                 preferred_element_type=jnp.float32,
             )
             * sm_scale
-        )  # [B,H,Sl,Sl]
+        )  # [B,H,Sl,Sl] f32
         gk = chunk * Sl + jax.lax.iota(jnp.int32, Sl)  # global key positions
         if causal:
             s = jnp.where(gk[None, None, None, :] <= gq[None, None, :, None], s, NEG_INF)
@@ -121,8 +124,8 @@ def ring_attention_local(
         l_new = alpha * l + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd",
-            p_use,
-            v_c.astype(jnp.float32),
+            p_use.astype(v_c.dtype),
+            v_c,
             preferred_element_type=jnp.float32,
         )
 

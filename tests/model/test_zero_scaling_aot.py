@@ -191,8 +191,12 @@ def test_gpt2_1_5b_int8_state_shards_over_dp():
     bf16_params_shape = jax.tree_util.tree_map(
         lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16), params_shape
     )
+    # mirror the engine's ZeRO settings (runtime/engine.py): dp-independent
+    # pad multiple, chunking disabled (it is a single-chip measure; under
+    # sharding the chunk scan would force GSPMD to gather the flat leaves)
     opt = Adam(
-        state_dtype="int8", state_pad_blocks=dp, master_compensation=True
+        state_dtype="int8", state_pad_blocks=max(256, dp),
+        master_compensation=True, chunk_elements=1 << 62,
     )
     inner_shape = jax.eval_shape(opt.init, bf16_params_shape)
     optstate_param_specs = zero_lib.zero_optstate_specs(

@@ -1,0 +1,120 @@
+"""TPU-VM provisioning helper (launcher/cloud.py) — the reference's
+azure/ cluster-script analog, tested as pure command construction (no
+gcloud in CI, mirroring how azure/create_vms.sh is config-driven)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.launcher import cloud
+
+
+CFG = {
+    "name": "ds-pod",
+    "zone": "us-central2-b",
+    "accelerator_type": "v5e-16",
+    "version": "tpu-ubuntu2204-base",
+}
+
+
+def test_create_command():
+    cmd = cloud.build_create_command(dict(CFG))
+    assert cmd[:6] == [
+        "gcloud", "compute", "tpus", "tpu-vm", "create", "ds-pod"
+    ]
+    assert "--accelerator-type" in cmd and "v5e-16" in cmd
+    assert "--spot" not in cmd
+    spot = cloud.build_create_command(dict(CFG, spot=True))
+    assert "--spot" in spot
+
+
+def test_project_override_and_delete():
+    cmd = cloud.build_delete_command(dict(CFG, project="my-proj"))
+    assert ["--project", "my-proj"] == cmd[-3:-1]
+    assert cmd[-1] == "--quiet"
+
+
+def test_ssh_command_with_worker_and_remote_command():
+    cmd = cloud.build_ssh_command(dict(CFG), worker="3", command="hostname")
+    assert "--worker=3" in cmd
+    assert cmd[-2:] == ["--command", "hostname"]
+
+
+def test_hostfile_from_describe():
+    describe = json.dumps({
+        "acceleratorType": "v5litepod-8",
+        "networkEndpoints": [
+            {"ipAddress": "10.0.0.2"},
+            {"ipAddress": "10.0.0.3"},
+        ]
+    })
+    text = cloud.hostfile_from_describe(describe)
+    assert text == "10.0.0.2 slots=4\n10.0.0.3 slots=4\n"
+    # round-trips through the launcher's hostfile parser
+    from deepspeed_tpu.launcher.runner import fetch_hostfile
+
+    import tempfile, os
+
+    with tempfile.NamedTemporaryFile("w", suffix=".host", delete=False) as f:
+        f.write(text)
+        path = f.name
+    try:
+        pool = fetch_hostfile(path)
+    finally:
+        os.unlink(path)
+    assert pool == {"10.0.0.2": 4, "10.0.0.3": 4}
+
+
+def test_hostfile_slots_derive_from_accelerator_type():
+    """Slot counts come from the SAME acceleratorType logic the runtime
+    --tpu discovery uses (runner.pod_resource_pool_from_describe) — a
+    sub-host v5litepod-1 slice gets 1 slot, not a hardcoded 4."""
+    describe = json.dumps({
+        "acceleratorType": "v5litepod-1",
+        "networkEndpoints": [{"ipAddress": "10.0.0.2"}],
+    })
+    assert cloud.hostfile_from_describe(describe) == "10.0.0.2 slots=1\n"
+    # explicit override still wins
+    assert cloud.hostfile_from_describe(
+        describe, slots_per_host=2
+    ) == "10.0.0.2 slots=2\n"
+
+
+def test_hostfile_errors():
+    with pytest.raises(ValueError, match="networkEndpoints"):
+        cloud.hostfile_from_describe("{}")
+    with pytest.raises(ValueError, match="networkEndpoints"):
+        cloud.hostfile_from_describe(
+            json.dumps({"networkEndpoints": [{"port": 8470}]})
+        )
+
+
+def test_config_validation(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"name": "x", "zone": "z"}))
+    with pytest.raises(ValueError, match="accelerator_type"):
+        cloud.load_config(str(p))
+    p.write_text(json.dumps(CFG))
+    assert cloud.load_config(str(p))["name"] == "ds-pod"
+
+
+def test_cli_dry_run_hostfile(tmp_path, monkeypatch, capsys):
+    import io, sys
+
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(CFG))
+    describe = json.dumps(
+        {"networkEndpoints": [{"ipAddress": "10.1.0.9"}]}
+    )
+    monkeypatch.setattr(sys, "stdin", io.StringIO(describe))
+    rc = cloud.main(["hostfile", "--config", str(p), "--dry-run"])
+    assert rc == 0
+    assert capsys.readouterr().out == "10.1.0.9 slots=4\n"
+
+
+def test_cli_dry_run_create(tmp_path, capsys):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(CFG))
+    rc = cloud.main(["create", "--config", str(p), "--dry-run"])
+    assert rc == 0
+    assert "tpu-vm create ds-pod" in capsys.readouterr().err

@@ -167,7 +167,6 @@ def test_chunked_leaf_update_matches_whole_leaf(
     params = {"w": jnp.asarray(rng.normal(size=shape), dtype)}
     grads = {"w": jnp.asarray(rng.normal(size=shape), dtype)}
 
-    monkeypatch.setattr(O, "_CHUNK_ELEMENTS", BLOCK)  # force chunking
     # spy: the chunked path must genuinely engage (None = silent fallback)
     engaged = []
     orig = O._chunked_leaf_update
@@ -178,14 +177,19 @@ def test_chunked_leaf_update_matches_whole_leaf(
         return out
 
     monkeypatch.setattr(O, "_chunked_leaf_update", spy)
-    opt = O.Adam(state_dtype=state_dtype, master_compensation=compensated)
+    opt = O.Adam(
+        state_dtype=state_dtype, master_compensation=compensated,
+        chunk_elements=BLOCK,  # force chunking
+    )
     s0 = opt.init(params)
     p1, s1, _ = opt.apply(params, grads, s0, jnp.float32(1e-2))
     assert any(engaged), "chunked path silently fell back to whole-leaf"
     monkeypatch.setattr(O, "_chunked_leaf_update", orig)
 
-    monkeypatch.setattr(O, "_CHUNK_ELEMENTS", 1 << 60)  # whole-leaf
-    opt2 = O.Adam(state_dtype=state_dtype, master_compensation=compensated)
+    opt2 = O.Adam(
+        state_dtype=state_dtype, master_compensation=compensated,
+        chunk_elements=1 << 60,  # whole-leaf
+    )
     p2, s2, _ = opt2.apply(params, grads, opt2.init(params), jnp.float32(1e-2))
 
     np.testing.assert_allclose(
