@@ -39,6 +39,42 @@ OPTIM_FILE = "zero_pp_rank_{dp}_mp_rank_{mp:02d}optim_states.msgpack"
 LATEST_FILE = "latest"
 
 
+def _normalize_quant_padding(saved_tree, template_tree):
+    """Resize blockwise-quantized ``{'q','scale'}`` leaves to the engine
+    template's (padded) lengths.
+
+    The ZeRO pad multiple for quantized state is a policy constant
+    (max(256, dp), runtime/engine.py) — but checkpoints from other
+    policies must still load: pre-padding saves (nb = ceil(n/BLOCK)),
+    future policy changes, or >256-dp pods. The padded tail decodes to
+    zero and never receives updates, so extending with zeros or dropping
+    tail blocks is lossless."""
+    from ..ops.quant import is_quantized
+
+    if saved_tree is None:
+        return None
+
+    def fit(saved, tmpl):
+        if not (is_quantized(tmpl) and isinstance(saved, dict)):
+            return saved
+        out = {}
+        for k in ("q", "scale"):
+            s = np.asarray(saved[k])
+            want = tmpl[k].shape[0]
+            if s.shape[0] < want:
+                s = np.concatenate(
+                    [s, np.zeros((want - s.shape[0],), s.dtype)]
+                )
+            elif s.shape[0] > want:
+                s = s[:want]
+            out[k] = s
+        return out
+
+    return jax.tree_util.tree_map(
+        fit, saved_tree, template_tree, is_leaf=is_quantized
+    )
+
+
 def _data_axis_of(leaf):
     """Index of the dim sharded over the data axis, or -1 if replicated."""
     sharding = getattr(leaf, "sharding", None)
@@ -316,6 +352,9 @@ def load_checkpoint(
                     ranks=[0],
                 )
         if canonical is not None:
+            canonical["inner"] = _normalize_quant_padding(
+                canonical["inner"], inner_template
+            )
             if engine.master_in_opt:
                 inner_dev = jax.device_put(
                     canonical["inner"], engine._opt_shardings["inner"]

@@ -626,3 +626,86 @@ def test_int8_zero_state_elastic_dp_resume(tmp_path):
     loader.backward(loss)
     loader.step()
     assert np.isfinite(float(loss))
+
+
+def test_int8_checkpoint_crosses_pad_policies(tmp_path):
+    """A checkpoint saved with UNPADDED quantized state (stage 0 / dp1 —
+    also the pre-padding on-disk format) must load into an engine whose
+    template pads blocks for ZeRO sharding: load-time normalization
+    resizes the zero tail (runtime/checkpointing._normalize_quant_padding)."""
+    import flax.linen as nn
+
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel.mesh import build_mesh
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, y, train=True):
+            h = nn.relu(nn.Dense(64)(x))
+            logp = jax.nn.log_softmax(nn.Dense(4)(h))
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(16, 8)).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.int32)
+    model = M()
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.asarray(X), jnp.asarray(Y)
+    )["params"]
+
+    def make(stage, dp):
+        e, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            mesh=build_mesh(
+                devices=jax.devices()[:dp], data_parallel_size=dp
+            ),
+            config_params={
+                "train_batch_size": 16,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": stage},
+                "data_types": {"optimizer_state_dtype": "int8",
+                               "master_dtype": "compensated"},
+                "steps_per_print": 10_000,
+            },
+            rng_seed=0,
+        )
+        return e
+
+    saver = make(stage=0, dp=1)  # unpadded quantized leaves
+    for _ in range(5):
+        loss = saver(X, Y)
+        saver.backward(loss)
+        saver.step()
+    saver.save_checkpoint(str(tmp_path), tag="pads")
+    saver.eval()
+    fp = float(saver(X, Y))
+
+    loader = make(stage=1, dp=8)  # template pads blocks to 256
+    from deepspeed_tpu.ops.quant import is_quantized
+
+    tq = [l for l in jax.tree_util.tree_leaves(
+        loader.optimizer_state["mu"], is_leaf=is_quantized) if is_quantized(l)]
+    sq = [l for l in jax.tree_util.tree_leaves(
+        saver.optimizer_state["mu"], is_leaf=is_quantized) if is_quantized(l)]
+    assert tq[0]["scale"].shape != sq[0]["scale"].shape  # genuinely crossing pads
+    loader.load_checkpoint(str(tmp_path), tag="pads")
+    assert loader.global_steps == 5
+    loader.eval()
+    np.testing.assert_allclose(float(loader(X, Y)), fp, rtol=1e-5)
+    loader.train()
+    loss = loader(X, Y)
+    loader.backward(loss)
+    loader.step()
+    assert np.isfinite(float(loss))
+
+    # TRUNCATION direction: the padded dp8 checkpoint loads back into a
+    # fresh unpadded stage-0 engine (merge-then-drop-zero-tail)
+    loader.save_checkpoint(str(tmp_path), tag="padded")
+    loader.eval()
+    fp2 = float(loader(X, Y))
+    back = make(stage=0, dp=1)
+    back.load_checkpoint(str(tmp_path), tag="padded")
+    assert back.global_steps == 6
+    back.eval()
+    np.testing.assert_allclose(float(back(X, Y)), fp2, rtol=1e-5)
