@@ -67,25 +67,34 @@ def _slice_count(L, size, threshold=None):
 
 
 def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None, threshold=None):
-    """Run ``leaf_fn`` over leading-axis row groups via ``lax.scan``;
-    returns None when the leaf doesn't decompose (callers fall back to the
-    whole-leaf path).
+    """Run ``leaf_fn`` over leading-axis row groups, updating each stored
+    array IN PLACE via a ``fori_loop`` whose carry holds the full-size
+    buffers; returns None when the leaf doesn't decompose (callers fall
+    back to the whole-leaf path).
 
     Chunking is a SINGLE-CHIP memory measure (bounds fp32 working temps on
     a 16 GB chip carrying billion-param state). Under ZeRO sharding the
     engine DISABLES it (``Adam.chunk_elements`` -> huge): per-device
     working sets are already divided by dp, and splitting a dp-sharded
-    flat quantized leaf's dimension for the scan would force GSPMD to
+    flat quantized leaf's dimension for the loop would force GSPMD to
     gather it (measured +12.5 GB of temps at 1.5B dp8 in the AOT proof).
 
-    The slices are leading-axis reshapes (bitcasts — no data movement) and
-    scan writes each output slice directly into its stacked output buffer,
-    so working fp32 temps stay bounded to ONE slice group while the
-    billion-param outputs build up in place. The previous formulation
-    (fori_loop + dynamic_update_slice carries) copied the FULL destination
-    array on every loop iteration — the round-4 device profile showed those
-    copies as ~66 ms of a 614 ms GPT-2 774M window. ``comp`` is an optional
-    param-shaped int8 compensation leaf (sliced alongside)."""
+    Memory shape matters more than anything here: each loop iteration
+    dynamic-slices the group it is about to overwrite OUT OF THE CARRY,
+    computes, and dynamic-update-slices the result back into the same
+    carry buffer. Because the carry's buffers are the only live reference
+    (the donated inputs flow straight into the loop init and nothing else
+    reads them), XLA keeps the DUS in place — persistent state stays at 1x
+    and only one group's fp32 temps are ever live. A round-4 interim
+    ``lax.scan``-over-slices formulation instead produced fresh stacked
+    outputs: correct, and fast on paper, but input + output coexisted per
+    leaf (+~4 GB transient at GPT-2 1.5B) and OOMed the real 16 GB chip
+    that the whole-leaf math already pressed against — scan ys cannot alias
+    scan xs. The even earlier round-3 fori_loop only copied per iteration
+    because the ``lax.cond`` overflow-skip kept a second reference to every
+    buffer alive; with gated updates (Optimizer.supports_gate) that
+    reference is gone and the loop is genuinely in place. ``comp`` is an
+    optional param-shaped int8 compensation leaf (sliced alongside)."""
     from .quant import BLOCK, is_quantized
 
     if threshold is None:
@@ -102,51 +111,56 @@ def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None, threshold=None):
     if (mq or vq) and per_slice % BLOCK:
         return None  # slice boundary would split a quant block
 
-    def split(x):
-        return x.reshape(n, rows, *x.shape[1:])
+    def slice_of(x, i, group):
+        if group == "rows":
+            return jax.lax.dynamic_slice_in_dim(x, i * rows, rows, axis=0)
+        # flat quantized storage: per_slice elements (q) / blocks (scale)
+        sz = per_slice if group == "q" else per_slice // BLOCK
+        return jax.lax.dynamic_slice_in_dim(x, i * sz, sz, axis=0)
 
-    def split_moment(st):
+    def put(buf, val, i, group):
+        if group == "rows":
+            return jax.lax.dynamic_update_slice_in_dim(
+                buf, val, i * rows, axis=0
+            )
+        sz = per_slice if group == "q" else per_slice // BLOCK
+        return jax.lax.dynamic_update_slice_in_dim(buf, val, i * sz, axis=0)
+
+    def moment_slice(st, i):
         if is_quantized(st):
-            # quantized leaves are flat and may carry a padded tail
-            # (state_pad_blocks); scan covers the real n*per_slice prefix,
-            # the tail is re-attached in unsplit_moment
-            return {
-                "q": jax.lax.slice(st["q"], (0,), (n * per_slice,)).reshape(
-                    n, per_slice
-                ),
-                "scale": jax.lax.slice(
-                    st["scale"], (0,), (n * per_slice // BLOCK,)
-                ).reshape(n, per_slice // BLOCK),
-            }
-        return split(st)
+            return {"q": slice_of(st["q"], i, "q"),
+                    "scale": slice_of(st["scale"], i, "scale")}
+        return slice_of(st, i, "rows")
 
-    xs = [split(p), split(g), split_moment(m_st), split_moment(v_st)]
+    def moment_put(buf, val, i):
+        if is_quantized(buf):
+            return {"q": put(buf["q"], val["q"], i, "q"),
+                    "scale": put(buf["scale"], val["scale"], i, "scale")}
+        return put(buf, val, i, "rows")
+
+    def body(i, carry):
+        p_buf, m_buf, v_buf, comp_buf = carry
+        args = [
+            slice_of(p_buf, i, "rows"),
+            slice_of(g, i, "rows"),
+            moment_slice(m_buf, i),
+            moment_slice(v_buf, i),
+        ]
+        if comp is not None:
+            args.append(slice_of(comp_buf, i, "rows"))
+        res = leaf_fn(*args)
+        p_buf = put(p_buf, res[0], i, "rows")
+        m_buf = moment_put(m_buf, res[1], i)
+        v_buf = moment_put(v_buf, res[2], i)
+        if comp is not None:
+            comp_buf = put(comp_buf, res[3], i, "rows")
+        return (p_buf, m_buf, v_buf, comp_buf)
+
+    init = (p, m_st, v_st, comp if comp is not None else jnp.zeros((), jnp.int8))
+    p_new, m_new, v_new, comp_new = jax.lax.fori_loop(0, n, body, init)
+    out = (p_new, m_new, v_new)
     if comp is not None:
-        xs.append(split(comp))
-
-    def body(carry, sl):
-        return carry, leaf_fn(*sl)
-
-    _, ys = jax.lax.scan(body, None, tuple(xs))
-
-    def unsplit_moment(new, old):
-        if is_quantized(old):
-            out = {}
-            for k in ("q", "scale"):
-                flat = new[k].reshape(-1)
-                if flat.size != old[k].size:  # padded tail untouched
-                    flat = jax.lax.dynamic_update_slice(old[k], flat, (0,))
-                out[k] = flat
-            return out
-        return new.reshape(old.shape)
-
-    out = (
-        ys[0].reshape(p.shape),
-        unsplit_moment(ys[1], m_st),
-        unsplit_moment(ys[2], v_st),
-    )
-    if comp is not None:
-        out = out + (ys[3].reshape(comp.shape),)
+        out = out + (comp_new,)
     return out
 
 
