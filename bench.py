@@ -663,24 +663,32 @@ def bench_gpt2(on_result=None):
     return north_star
 
 
-def _load_prev_extras():
-    """Per-section results from the newest BENCH_r*.json (driver-recorded
-    previous rounds) for vs_prev regression tracking."""
+def _load_prev_extras(search_dir=None):
+    """Per-section results merged across ALL BENCH_r*.json files (latest
+    measurement per section wins) for vs_prev regression tracking. Merging
+    matters because driver runs can be partial: r03 recorded bert/squad but
+    no gpt2, r04 the complement — reading only the newest file would
+    silently drop regression tracking for every section it missed."""
     import glob
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    files = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
-    for path in reversed(files):
+    here = search_dir or os.path.dirname(os.path.abspath(__file__))
+    merged, sources = {}, {}
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
         try:
             with open(path) as fd:
                 doc = json.load(fd)
             extras = (doc.get("parsed") or {}).get("extras") or {}
-            if any(extras.values()):
-                log(f"vs_prev reference: {os.path.basename(path)}")
-                return extras
         except Exception:
             continue
-    return {}
+        for key, val in extras.items():
+            # a malformed entry in one historical file must not kill the
+            # whole run (the driver rewrites these files every round)
+            if isinstance(val, dict) and val.get("value"):
+                merged[key] = val
+                sources[key] = os.path.basename(path)
+    for key in sorted(merged):
+        log(f"vs_prev reference: {key} <- {sources[key]}")
+    return merged
 
 
 def main():

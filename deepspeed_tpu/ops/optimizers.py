@@ -156,6 +156,8 @@ def _chunked_leaf_update(leaf_fn, p, g, m_st, v_st, comp=None, threshold=None):
             comp_buf = put(comp_buf, res[3], i, "rows")
         return (p_buf, m_buf, v_buf, comp_buf)
 
+    # comp-less leaves carry a dummy int8 scalar in the comp slot purely to
+    # keep the fori_loop carry arity/structure fixed; body never touches it
     init = (p, m_st, v_st, comp if comp is not None else jnp.zeros((), jnp.int8))
     p_new, m_new, v_new, comp_new = jax.lax.fori_loop(0, n, body, init)
     out = (p_new, m_new, v_new)
@@ -173,6 +175,13 @@ class Optimizer:
     dtype end-to-end — materializing a pre-scaled fp32 copy of a
     billion-param grad tree (~6 GB) is what OOMed GPT-2 1.5B on one chip.
 
+    ``mom`` (optimizers with ``supports_mom = True``): optional traced
+    scalar overriding the first-moment coefficient (``b1`` / SGD
+    ``momentum``) for THIS step — the OneCycle momentum-cycling hook
+    (reference deepspeed_lr_schedules.py:477-520 mutates optimizer groups;
+    here the engine threads the scheduler's ``get_mom()`` value through the
+    jit like ``lr``, so cycling never recompiles).
+
     ``gate`` (optimizers with ``supports_gate = True``): scalar bool; False
     makes the whole update a bit-exact no-op by selecting the OLD stored
     bytes just before every write. This replaces a ``lax.cond`` skip around
@@ -184,6 +193,7 @@ class Optimizer:
     """
 
     supports_gate = False
+    supports_mom = False
 
     def init(self, params) -> Dict[str, Any]:
         raise NotImplementedError
@@ -239,6 +249,7 @@ class Adam(Optimizer):
     # (see _chunked_leaf_update).
     chunk_elements: int = _CHUNK_ELEMENTS
     supports_gate = True
+    supports_mom = True
 
     def init(self, params):
         from .quant import comp_zeros_like, moments_zeros_like
@@ -258,7 +269,8 @@ class Adam(Optimizer):
             state["comp"] = comp_zeros_like(params)
         return state
 
-    def apply(self, params, grads, state, lr, grad_scale=None, gate=None):
+    def apply(self, params, grads, state, lr, grad_scale=None, gate=None,
+              mom=None):
         from .quant import (
             decode_master,
             decode_moment,
@@ -271,7 +283,8 @@ class Adam(Optimizer):
             step = state["step"] + 1
         else:
             step = state["step"] + gate.astype(jnp.int32)
-        b1, b2 = self.b1, self.b2
+        b1 = self.b1 if mom is None else mom
+        b2 = self.b2
         if self.bias_correction:
             c1 = 1.0 - b1 ** step.astype(jnp.float32)
             c2 = 1.0 - b2 ** step.astype(jnp.float32)
@@ -358,6 +371,7 @@ class Lamb(Optimizer):
     state_dtype: str = "fp32"  # moment storage; see Adam.state_dtype
     state_pad_blocks: int = 1  # ZeRO block alignment; see Adam
     supports_gate = True
+    supports_mom = True
 
     def init(self, params):
         from .quant import moments_zeros_like
@@ -374,14 +388,16 @@ class Lamb(Optimizer):
             ),
         }
 
-    def apply(self, params, grads, state, lr, grad_scale=None, gate=None):
+    def apply(self, params, grads, state, lr, grad_scale=None, gate=None,
+              mom=None):
         from .quant import decode_moment, encode_moment
 
         if gate is None:
             step = state["step"] + 1
         else:
             step = state["step"] + gate.astype(jnp.int32)
-        b1, b2 = self.b1, self.b2
+        b1 = self.b1 if mom is None else mom
+        b2 = self.b2
         if self.bias_correction:
             c1 = 1.0 - b1 ** step.astype(jnp.float32)
             c2 = 1.0 - b2 ** step.astype(jnp.float32)
@@ -435,6 +451,15 @@ class SGD(Optimizer):
     weight_decay: float = 0.0
     nesterov: bool = False
 
+    @property
+    def supports_mom(self):
+        # momentum cycling needs the momentum BUFFER, whose existence is
+        # fixed at init time by self.momentum != 0 (torch SGD creates it
+        # lazily; a traced pytree cannot). momentum=0.0 therefore reports
+        # unsupported and the engine warns instead of silently ignoring a
+        # configured OneCycle momentum cycle.
+        return bool(self.momentum)
+
     def init(self, params):
         if self.momentum:
             return {
@@ -445,8 +470,9 @@ class SGD(Optimizer):
             }
         return {"step": jnp.zeros((), jnp.int32), "mom": None}
 
-    def apply(self, params, grads, state, lr, grad_scale=None):
+    def apply(self, params, grads, state, lr, grad_scale=None, mom=None):
         step = state["step"] + 1
+        mu_coeff = self.momentum if mom is None else mom
 
         if self.momentum:
 
@@ -456,8 +482,8 @@ class SGD(Optimizer):
                     g32 = g32 * grad_scale
                 if self.weight_decay:
                     g32 = g32 + self.weight_decay * p32
-                m_new = self.momentum * m + g32
-                d = g32 + self.momentum * m_new if self.nesterov else m_new
+                m_new = mu_coeff * m + g32
+                d = g32 + mu_coeff * m_new if self.nesterov else m_new
                 return (p32 - lr * d).astype(p.dtype), m_new
 
             out = jax.tree_util.tree_map(leaf, params, grads, state["mom"])

@@ -275,6 +275,9 @@ class FusedLamb(Lamb):
     # the opaque pallas_call cannot fold a skip-gate select into its
     # update pass — overflow skips go through the engine's lax.cond path
     supports_gate = False
+    # b1 is a compile-time kernel constant; a traced OneCycle momentum
+    # would recompile the kernel every step — use 'Lamb' for mom cycling
+    supports_mom = False
     multi_tensor_max: int = 1 << 21  # 2M elements (64 kernel blocks)
 
     def apply(self, params, grads, state, lr, grad_scale=None):

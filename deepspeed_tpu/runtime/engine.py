@@ -62,6 +62,11 @@ from .precision import (
 FORWARD_TIMER = "forward"
 BACKWARD_TIMER = "backward"
 STEP_TIMER = "step"
+# fused train_batch() path: the window is ONE compiled program, so host
+# timers cannot split fwd/bwd/step — the whole-window wall clock is timed
+# instead (named_scope sections inside the jit label profiler traces for
+# the per-phase view)
+TRAIN_BATCH_TIMER = "train_batch_window"
 
 
 def _split_model_output(out):
@@ -234,14 +239,22 @@ class DeepSpeedEngine:
         params_f32 = jax.tree_util.tree_map(
             lambda p: jnp.array(p, dtype=jnp.float32, copy=True), model_parameters
         )
+        # int8 moments store FLAT dp-sharded {'q','scale'} leaves: leading-
+        # dim specs keep the flat<->shaped reshapes in the update layout-
+        # trivial (zero.py module docstring); fp32/bf16 state keeps the
+        # largest-dim layout of the measured AOT memory proofs
+        prefer_leading = self.config.optimizer_state_dtype == "int8"
         self._param_specs = zero_lib.zero_param_specs(
-            params_f32, dp_size, stage, model_specs=self._model_specs
+            params_f32, dp_size, stage, model_specs=self._model_specs,
+            prefer_leading=prefer_leading,
         )
         self._grad_specs = zero_lib.zero_grad_specs(
-            params_f32, dp_size, stage, model_specs=self._model_specs
+            params_f32, dp_size, stage, model_specs=self._model_specs,
+            prefer_leading=prefer_leading,
         )
         optstate_param_specs = zero_lib.zero_optstate_specs(
-            params_f32, dp_size, stage, model_specs=self._model_specs
+            params_f32, dp_size, stage, model_specs=self._model_specs,
+            prefer_leading=prefer_leading,
         )
         self._param_shardings = zero_lib.specs_to_shardings(
             self._param_specs, self._mesh
@@ -652,6 +665,29 @@ class DeepSpeedEngine:
     def get_lr(self):
         return [self._current_lr()]
 
+    def _current_mom(self):
+        """First-moment coefficient for THIS step: the scheduler's cycled
+        momentum (OneCycle ``get_mom()``, reference
+        deepspeed_lr_schedules.py:477-520) when available, else the
+        optimizer's configured coefficient. Threaded into the jitted
+        update as a traced scalar alongside lr — cycling never
+        recompiles."""
+        if self.lr_scheduler is not None and hasattr(
+            self.lr_scheduler, "get_mom"
+        ):
+            mom = self.lr_scheduler.get_mom()
+            if mom is not None:
+                if isinstance(mom, (list, tuple)):
+                    mom = mom[0]
+                return float(mom)
+        opt = self.optimizer_obj
+        if hasattr(opt, "b1"):
+            return float(opt.b1)
+        return float(getattr(opt, "momentum", 0.0))
+
+    def get_mom(self):
+        return [self._current_mom()]
+
     # ------------------------------------------------------------------
     # jitted step construction
     # ------------------------------------------------------------------
@@ -760,8 +796,28 @@ class DeepSpeedEngine:
                 overflow = raw_norm < 0.0
             return raw_norm, overflow
 
+        # momentum threads through the jit like lr (a traced scalar) only
+        # for optimizers whose update math accepts a per-step coefficient;
+        # others (e.g. FusedLamb's compile-time kernel constants) never see
+        # the argument
+        use_mom = getattr(optimizer, "supports_mom", False)
+        if (
+            not use_mom
+            and self.lr_scheduler is not None
+            and getattr(self.lr_scheduler, "get_mom", lambda: None)()
+            is not None
+        ):
+            log_dist(
+                "WARNING: the LR scheduler cycles momentum but optimizer "
+                f"{type(optimizer).__name__} cannot apply a per-step "
+                "coefficient (SGD needs momentum != 0; FusedLamb bakes b1 "
+                "into its kernel — use 'Lamb') — momentum cycling is "
+                "ignored",
+                ranks=[0],
+            )
+
         def cond_update(params, opt_state, grads, raw_norm, overflow,
-                        inv_scale, lr, layout):
+                        inv_scale, lr, mom, layout):
             """Shared overflow-gated update core: unscale+clip as one
             scalar grad_scale into the optimizer; layout 'master' steps
             opt_state['master'] and publishes compute-dtype params,
@@ -784,6 +840,8 @@ class DeepSpeedEngine:
                         clip / grad_norm, jnp.float32(1.0),
                     )
                 opt_kw = {} if gate is None else {"gate": gate}
+                if use_mom:
+                    opt_kw["mom"] = mom
                 if layout == "master":
                     # step the fp32 master, then publish the compute-dtype
                     # params — the reference's fp32-partition step + fp16
@@ -836,12 +894,13 @@ class DeepSpeedEngine:
                 overflow, skip_update, do_update, (params, opt_state, grads)
             )
 
-        def update_body(params, opt_state, grad_buffer, scaler_state, lr):
+        def update_body(params, opt_state, grad_buffer, scaler_state, lr,
+                        mom):
             inv_scale = 1.0 / scaler_state.loss_scale
             raw_norm, overflow = detect_overflow(grad_buffer)
             new_params, new_opt, grad_norm, coeffs = cond_update(
                 params, opt_state, grad_buffer, raw_norm, overflow,
-                inv_scale, lr, "master" if master_in_opt else "plain",
+                inv_scale, lr, mom, "master" if master_in_opt else "plain",
             )
             new_params = jax.tree_util.tree_map(
                 lambda p, s: jax.lax.with_sharding_constraint(p, s),
@@ -866,7 +925,8 @@ class DeepSpeedEngine:
 
         if self.host_offload:
 
-            def update_body_offload(master, inner, grads, scaler_state, lr):
+            def update_body_offload(master, inner, grads, scaler_state, lr,
+                                    mom):
                 """Host-side (cpu-jitted) master update: all inputs live on
                 the cpu device, so XLA compiles this for the host backend.
                 Same cond_update core as the on-device path ('master'
@@ -880,7 +940,7 @@ class DeepSpeedEngine:
                 )
                 new_params, new_opt, grad_norm, coeffs = cond_update(
                     params_like, {"master": master, "inner": inner}, grads,
-                    raw_norm, overflow, inv_scale, lr, "master",
+                    raw_norm, overflow, inv_scale, lr, mom, "master",
                 )
                 new_scaler = update_scale(scaler_state, overflow)
                 return (
@@ -892,7 +952,8 @@ class DeepSpeedEngine:
                 update_body_offload, donate_argnums=(0, 1, 2)
             )
 
-        def train_window(params, opt_state, scaler_state, batches, rng_keys, lr):
+        def train_window(params, opt_state, scaler_state, batches, rng_keys,
+                         lr, mom):
             """One full accumulation window in a single compiled program:
             accum x (forward+backward) -> grad sum -> optimizer update.
 
@@ -902,42 +963,49 @@ class DeepSpeedEngine:
             the update with the last backward.
             """
             loss_scale = scaler_state.loss_scale
-            if accum == 1:
-                first = jax.tree_util.tree_map(lambda x: x[0], batches)
-                loss, aux, grads = fwd_bwd(
-                    params, first, rng_keys[0], loss_scale
-                )
-                losses = loss.astype(jnp.float32)[None]
-                # match the accum>1 scan's [accum]-stacked aux layout
-                aux = jax.tree_util.tree_map(lambda a: a[None], aux)
-            else:
-                zeros = jax.tree_util.tree_map(
-                    lambda p, s: jax.lax.with_sharding_constraint(
-                        jnp.zeros(p.shape, accum_dtype), s
-                    ),
-                    params,
-                    grad_shardings,
-                )
-
-                def body(gbuf, xs):
-                    b, k = xs
-                    loss, aux, g = fwd_bwd(params, b, k, loss_scale)
-                    gbuf = jax.tree_util.tree_map(
-                        lambda a, gg, s: jax.lax.with_sharding_constraint(
-                            a + gg, s
+            # named_scope sections label the profiler trace (the fused
+            # window's analog of the reference's per-phase breakdown,
+            # deepspeed_light.py:886-931) — phase attribution survives the
+            # single-program fusion
+            with jax.named_scope("window_fwd_bwd"):
+                if accum == 1:
+                    first = jax.tree_util.tree_map(lambda x: x[0], batches)
+                    loss, aux, grads = fwd_bwd(
+                        params, first, rng_keys[0], loss_scale
+                    )
+                    losses = loss.astype(jnp.float32)[None]
+                    # match the accum>1 scan's [accum]-stacked aux layout
+                    aux = jax.tree_util.tree_map(lambda a: a[None], aux)
+                else:
+                    zeros = jax.tree_util.tree_map(
+                        lambda p, s: jax.lax.with_sharding_constraint(
+                            jnp.zeros(p.shape, accum_dtype), s
                         ),
-                        gbuf,
-                        g,
+                        params,
                         grad_shardings,
                     )
-                    return gbuf, (loss.astype(jnp.float32), aux)
 
-                grads, (losses, aux) = jax.lax.scan(
-                    body, zeros, (batches, rng_keys)
+                    def body(gbuf, xs):
+                        b, k = xs
+                        loss, aux, g = fwd_bwd(params, b, k, loss_scale)
+                        gbuf = jax.tree_util.tree_map(
+                            lambda a, gg, s: jax.lax.with_sharding_constraint(
+                                a + gg, s
+                            ),
+                            gbuf,
+                            g,
+                            grad_shardings,
+                        )
+                        return gbuf, (loss.astype(jnp.float32), aux)
+
+                    grads, (losses, aux) = jax.lax.scan(
+                        body, zeros, (batches, rng_keys)
+                    )
+            with jax.named_scope("window_optimizer_update"):
+                new_params, new_opt, new_scaler, overflow, grad_norm, coeffs = (
+                    update_body(params, opt_state, grads, scaler_state, lr,
+                                mom)
                 )
-            new_params, new_opt, new_scaler, overflow, grad_norm, coeffs = (
-                update_body(params, opt_state, grads, scaler_state, lr)
-            )
             return (
                 new_params, new_opt, new_scaler, overflow, grad_norm, coeffs,
                 jnp.mean(losses), aux,
@@ -1011,6 +1079,7 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(STEP_TIMER).start()
         lr = jnp.float32(self._current_lr())
+        mom = jnp.float32(self._current_mom())
         if self.host_offload:
             grads_host = jax.device_put(self._grad_buffer, self._cpu_device)
             (
@@ -1027,6 +1096,7 @@ class DeepSpeedEngine:
                 grads_host,
                 jax.device_put(self.loss_scale_state, self._cpu_device),
                 jax.device_put(lr, self._cpu_device),
+                jax.device_put(mom, self._cpu_device),
             )
             self.optimizer_state = {"master": new_master, "inner": new_inner}
             # the offload path is inherently synchronous (transfers bound
@@ -1058,6 +1128,7 @@ class DeepSpeedEngine:
                 self._grad_buffer,
                 self.loss_scale_state,
                 lr,
+                mom,
             )
         # donated; backward() lazily re-seeds from the next micro-step
         self._grad_buffer = None
@@ -1124,6 +1195,38 @@ class DeepSpeedEngine:
                 f"{float(self.loss_scale_state.loss_scale)}",
                 ranks=[0],
             )
+            if self.wall_clock_breakdown:
+                # per-phase means over the print interval: fwd/bwd/step on
+                # the unfused path, whole-window on the fused path (the
+                # reference's breakdown, deepspeed_light.py:886-931; the
+                # fused program's phase split lives in profiler traces via
+                # named_scope)
+                interval = self.steps_per_print()
+                if self.timers.has_timer(TRAIN_BATCH_TIMER):
+                    # divide by windows actually RUN since the last print
+                    # (incl. overflow-skipped ones), not steps counted
+                    n_windows = max(1, getattr(self, "_tb_windows", 0))
+                    win_s = self.timers(TRAIN_BATCH_TIMER).elapsed(
+                        reset=True
+                    ) / n_windows
+                    self._tb_windows = 0
+                    if win_s > 0:
+                        sps = self.train_batch_size() / win_s
+                        log_dist(
+                            f"train_batch window: {win_s * 1e3:.1f} ms avg "
+                            f"| {sps:.1f} samples/s",
+                            ranks=[0],
+                        )
+                # the window timer reports via the dedicated line above
+                # (per-window divisor); fwd/bwd/step normalize per printed
+                # step like the reference
+                names = [
+                    n
+                    for n in (FORWARD_TIMER, BACKWARD_TIMER, STEP_TIMER)
+                    if self.timers.has_timer(n)
+                ]
+                if names:
+                    self.timers.log(names, normalizer=interval)
         if self.monitor.enabled and not self.last_overflow:
             # the jitted update returns the -1.0 SENTINEL grad norm when it
             # skipped on device (bf16/fp32 async path) — that window's
@@ -1233,12 +1336,17 @@ class DeepSpeedEngine:
                 return jnp.stack([jnp.asarray(x) for x in xs])
             return np.stack([np.asarray(x) for x in xs])
 
+        if self.wall_clock_breakdown:
+            # whole-window wall clock (start() fences outstanding device
+            # work); the async fast path is untouched when breakdown is off
+            self.timers(TRAIN_BATCH_TIMER).start()
         stacked = jax.tree_util.tree_map(stack_leaf, *batches)
         stacked = self._shard_window_batch(stacked)
         self._rng, sub = jax.random.split(self._rng)
         keys = jax.random.split(sub, accum)
 
         lr = jnp.float32(self._current_lr())
+        mom = jnp.float32(self._current_mom())
         (
             self.params,
             self.optimizer_state,
@@ -1255,8 +1363,17 @@ class DeepSpeedEngine:
             stacked,
             keys,
             lr,
+            mom,
         )
         self.micro_steps += accum
+        if self.wall_clock_breakdown:
+            jax.block_until_ready(mean_loss)
+            self.timers(TRAIN_BATCH_TIMER).stop()
+            # window count since the last breakdown print: overflow-skipped
+            # windows accumulate TIME but not global_steps, so dividing the
+            # timer by steps_per_print would overstate the per-window
+            # average exactly when loss-scale backoff makes it interesting
+            self._tb_windows = getattr(self, "_tb_windows", 0) + 1
         # aux outputs from a multi-output model, [accum, ...]-stacked
         self.last_aux = aux
         self._finish_step(overflow, grad_norm, coeffs, mean_loss)

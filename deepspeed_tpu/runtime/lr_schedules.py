@@ -9,8 +9,10 @@ the same CLI tuning-argument injection/override plumbing
 TPU-first divergence: schedulers here compute *values* (floats) that the
 engine feeds into the jitted train step as a traced scalar — there is no
 mutable optimizer object to poke, and changing the LR never recompiles.
-OneCycle's momentum cycling is exposed via ``get_mom()`` and applied by the
-engine when the optimizer has a ``b1`` coefficient.
+OneCycle's momentum cycling is exposed via ``get_mom()``; the engine
+threads it into the jitted update as a traced scalar (engine.py
+``_current_mom``) for optimizers with ``supports_mom`` (Adam/Lamb ``b1``,
+SGD ``momentum``).
 """
 
 import argparse

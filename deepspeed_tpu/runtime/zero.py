@@ -20,12 +20,19 @@ collapses into sharding declarations and XLA-inserted collectives:
              and raised NotImplementedError, deepspeed_constants.py:167,
              deepspeed_light.py:619-620; on a mesh it is one more spec).
 
-Per-leaf partitioning rule: shard the largest dimension divisible by the
-data-axis size; leaves with no such dimension stay replicated (the
-reference's analogous edge case is `zero_empty_partition` — more ranks than
-elements — tested in tests/unit/test_fp16.py). This keeps every array's
-layout tile-friendly (no flatten-and-split of individual tensors, which
-would fight XLA's tiled memory format).
+Per-leaf partitioning rule: shard the largest unsharded dimension divisible
+by the data-axis size; leaves with no divisible dimension stay replicated
+(the reference's analogous edge case is `zero_empty_partition` — more ranks
+than elements — tested in tests/unit/test_fp16.py). Engines with FLAT
+blockwise-quantized moment storage ({'q','scale'} int8 leaves, ops/quant.py)
+instead prefer the EARLIEST divisible dimension (``prefer_leading=True``):
+each shard is then a CONTIGUOUS row-major block, so the reshape between the
+flat dp-sharded storage and its shaped fp32 working value is layout-trivial
+— with the largest-dim rule the dryrun's dp2xsp2xmp2 update step hit XLA
+"Involuntary full rematerialization" warnings (spmd_partitioner.cc) on
+exactly those reshapes, replicating the tensor mid-update. Either way no
+individual tensor is flattened-and-split, which would fight XLA's tiled
+memory format.
 """
 
 import jax
@@ -35,11 +42,19 @@ from ..config import constants as C
 from ..parallel import mesh as mesh_lib
 
 
-def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=None):
+def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=None,
+                        prefer_leading=False):
     """Choose the PartitionSpec sharding one dim of ``shape`` over the data axis.
 
     Respects ``existing_spec`` (e.g. a model-parallel sharding) by only
     placing the data axis on a currently-unsharded dimension.
+
+    ``prefer_leading=True`` picks the EARLIEST divisible dimension instead
+    of the largest: shards become contiguous row-major blocks, which makes
+    the flat<->shaped reshapes of blockwise-quantized moment storage
+    layout-trivial (see module docstring). Engines enable it exactly when
+    such flat state exists; the fp32-state layout (largest dim) keeps the
+    measured single/multi-chip memory profile of the AOT proofs.
     """
     existing = tuple(existing_spec) if existing_spec is not None else ()
     existing = existing + (None,) * (len(shape) - len(existing))
@@ -55,9 +70,12 @@ def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=Non
         return PartitionSpec(*existing)
     best_dim, best_size = None, 0
     for i, d in enumerate(shape):
-        if existing[i] is not None:
+        if existing[i] is not None or d % dp_size != 0:
             continue
-        if d % dp_size == 0 and d > best_size:
+        if prefer_leading:
+            best_dim = i
+            break
+        if d > best_size:
             best_dim, best_size = i, d
     if best_dim is None:
         return PartitionSpec(*existing) if existing_spec is not None else PartitionSpec()
@@ -66,38 +84,47 @@ def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=Non
     return PartitionSpec(*new)
 
 
-def zero_param_specs(params, dp_size, stage, model_specs=None):
+def zero_param_specs(params, dp_size, stage, model_specs=None, prefer_leading=False):
     """Partition specs for *parameters* (sharded only at stage 3)."""
 
     def spec(path, leaf):
         ms = _lookup(model_specs, path)
         if stage >= C.ZERO_OPTIMIZATION_WEIGHTS:
-            return leaf_partition_spec(leaf.shape, dp_size, existing_spec=ms)
+            return leaf_partition_spec(
+                leaf.shape, dp_size, existing_spec=ms,
+                prefer_leading=prefer_leading,
+            )
         return ms if ms is not None else PartitionSpec()
 
     return _tree_map_with_path(spec, params)
 
 
-def zero_grad_specs(params, dp_size, stage, model_specs=None):
+def zero_grad_specs(params, dp_size, stage, model_specs=None, prefer_leading=False):
     """Partition specs for the gradient-accumulation buffer (stage >= 2 shards)."""
 
     def spec(path, leaf):
         ms = _lookup(model_specs, path)
         if stage >= C.ZERO_OPTIMIZATION_GRADIENTS:
-            return leaf_partition_spec(leaf.shape, dp_size, existing_spec=ms)
+            return leaf_partition_spec(
+                leaf.shape, dp_size, existing_spec=ms,
+                prefer_leading=prefer_leading,
+            )
         return ms if ms is not None else PartitionSpec()
 
     return _tree_map_with_path(spec, params)
 
 
-def zero_optstate_specs(params, dp_size, stage, model_specs=None):
+def zero_optstate_specs(params, dp_size, stage, model_specs=None, prefer_leading=False):
     """Partition specs for per-param optimizer state (moments, master copy);
     sharded from stage >= 1."""
 
     def spec(path, leaf):
         ms = _lookup(model_specs, path)
         if stage >= C.ZERO_OPTIMIZATION_OPTIMIZER_STATES:
-            return leaf_partition_spec(leaf.shape, dp_size, existing_spec=ms)
+            return leaf_partition_spec(
+                leaf.shape, dp_size, existing_spec=ms,
+                prefer_leading=prefer_leading,
+            )
         return ms if ms is not None else PartitionSpec()
 
     return _tree_map_with_path(spec, params)

@@ -99,6 +99,90 @@ def test_qa_finetune_reaches_exact_match_gate():
     assert em >= 0.9, f"exact match {em:.2f} below the 0.9 gate"
 
 
+def test_qa_finetune_from_imported_checkpoint_reaches_gate(tmp_path):
+    """The real-data SQuAD gate's weight path, end to end on synthetic data
+    (no-egress analog of the reference's pretrained-BERT fine-tune,
+    tests/model/BingBertSquad/test_e2e_squad.py:40-58): a torch/HF
+    checkpoint saved by ``torch.save`` -> tools/import_bert_checkpoint
+    conversion -> msgpack artifact -> ``$BERT_CKPT_MSGPACK``-style reload
+    into the flax template -> engine fine-tune -> exact-match gate. Any
+    transposition, padding, or serialization bug upstream of training
+    makes the gate unreachable."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from flax import serialization
+
+    from tools.import_bert_checkpoint import (
+        convert_state_dict,
+        load_torch_state_dict,
+    )
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=VOCAB, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=SEQ, type_vocab_size=2,
+        hidden_act="gelu_new", hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        # HF's default std-0.02 init is tuned for pretraining at full
+        # scale; at this toy scale attention stays uniform and training
+        # plateaus question-blind at exactly ln(3) loss (measured: EM 0.09
+        # after 900 steps). The importer path, not HF's init scale, is
+        # under test — 0.1 matches the trainable scale of the random-init
+        # gate above (measured: loss 3.66 -> 4e-4, EM 1.0).
+        initializer_range=0.1,
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.BertForQuestionAnswering(hf_cfg)
+    ckpt_bin = tmp_path / "pytorch_model.bin"
+    torch.save(hf_model.state_dict(), ckpt_bin)
+
+    imported, _ = convert_state_dict(
+        load_torch_state_dict(str(ckpt_bin)), head="qa"
+    )
+    msgpack_path = tmp_path / "bert_tiny.msgpack"
+    msgpack_path.write_bytes(serialization.to_bytes(imported))
+
+    cfg = BertConfig(
+        vocab_size=VOCAB, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=SEQ, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    model = BertForQuestionAnswering(cfg)
+    rng = np.random.default_rng(0)
+    ids0, s0, e0 = _make_batch(rng, 4)
+    template = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jnp.asarray(ids0), None, None, jnp.asarray(s0), jnp.asarray(e0),
+    )["params"]
+    # the $BERT_CKPT_MSGPACK load path of tests/model/test_squad_real_data
+    params = serialization.from_bytes(template, msgpack_path.read_bytes())
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": 64,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10_000,
+        },
+    )
+    for _ in range(900):
+        ids, starts, ends = _make_batch(rng, 64)
+        loss = engine(ids, None, None, starts, ends)
+        engine.backward(loss)
+        engine.step()
+
+    ids, starts, ends = _make_batch(np.random.default_rng(999), 64)
+    start_logits, end_logits = model.apply(
+        {"params": engine.params}, jnp.asarray(ids), train=False
+    )
+    pred_s = np.asarray(jnp.argmax(start_logits, axis=-1))
+    pred_e = np.asarray(jnp.argmax(end_logits, axis=-1))
+    em = float(np.mean((pred_s == starts) & (pred_e == ends)))
+    assert em >= 0.9, f"exact match {em:.2f} below the 0.9 gate"
+
+
 def test_qa_gate_fails_without_attention_to_question():
     """The distractor design must actually require the question token:
     a majority-class predictor (or one ignoring position 0) cannot reach

@@ -72,9 +72,29 @@ def test_budget_skips_tail_sections_not_gpt2(bench, monkeypatch, capsys):
     assert out and "gpt2" in out[-1]["extras"]
 
 
-def test_vs_prev_attached_from_previous_round(bench, monkeypatch, capsys):
-    """BENCH_r03.json in the repo root carries bert=374.41; a new bert
-    result with the same metric name must get a vs_prev ratio."""
+def _bench_round_file(tmp_path, n, extras):
+    """Driver-shaped BENCH_r{n}.json with the given parsed extras."""
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+        "n": n, "rc": 0, "parsed": {"metric": "m", "extras": extras},
+    }))
+
+
+def test_vs_prev_attached_from_previous_round(bench, monkeypatch, capsys,
+                                              tmp_path):
+    """A prior round's bert=374.41 in a BENCH file must give a new bert
+    result with the same metric name a vs_prev ratio. Hermetic: reads a
+    tmpdir, not the repo root."""
+    _bench_round_file(tmp_path, 3, {
+        "bert": _result(
+            "bert_large_pretrain_seq128_samples_per_sec_per_chip",
+            value=374.41,
+        ),
+    })
+    orig = bench._load_prev_extras
+    monkeypatch.setattr(
+        bench, "_load_prev_extras", lambda: orig(search_dir=str(tmp_path))
+    )
+
     def fake_attempt(spec, timeout=1500):
         if spec["kind"] == "bert" and spec.get("seq", 128) == 128:
             return _result(
@@ -90,6 +110,31 @@ def test_vs_prev_attached_from_previous_round(bench, monkeypatch, capsys):
     assert out, "no emit"
     bert = out[-1]["extras"]["bert"]
     assert bert.get("vs_prev") == pytest.approx(1.1, abs=0.01)
+
+
+def test_prev_extras_merge_across_partial_rounds(bench, tmp_path):
+    """r03 measured bert+squad (gpt2 null), r04 only gpt2: the merged view
+    must keep ALL three sections, taking the newest value per section."""
+    _bench_round_file(tmp_path, 3, {
+        "bert": _result("bert_metric", value=374.41),
+        "squad": _result("squad_metric", value=99.3),
+        "gpt2": None,
+    })
+    _bench_round_file(tmp_path, 4, {
+        "gpt2": _result("gpt2_metric", value=5352.7),
+        "bert": None,
+    })
+    merged = bench._load_prev_extras(search_dir=str(tmp_path))
+    assert merged["bert"]["value"] == 374.41
+    assert merged["squad"]["value"] == 99.3
+    assert merged["gpt2"]["value"] == 5352.7
+
+
+def test_prev_extras_newer_round_wins_per_section(bench, tmp_path):
+    _bench_round_file(tmp_path, 3, {"bert": _result("bert_metric", 374.41)})
+    _bench_round_file(tmp_path, 4, {"bert": _result("bert_metric", 380.0)})
+    merged = bench._load_prev_extras(search_dir=str(tmp_path))
+    assert merged["bert"]["value"] == 380.0
 
 
 def test_worker_attempt_timeout_capped_by_budget(bench, monkeypatch):

@@ -147,16 +147,21 @@ def test_lamb_reduced_state_converges():
     assert aux["lamb_coeffs"]
 
 
+@pytest.mark.parametrize("state_pad_blocks", [1, 16])
 @pytest.mark.parametrize("compensated", [False, True])
 @pytest.mark.parametrize("state_dtype", ["fp32", "bf16", "int8"])
 def test_chunked_leaf_update_matches_whole_leaf(
-    state_dtype, compensated, monkeypatch
+    state_dtype, compensated, state_pad_blocks, monkeypatch
 ):
     """Large stacked leaves update in place slice-by-slice (bounds HLO
     temps on 16GB chips); the math must match the whole-leaf path to
     float-associativity noise. The int8 leaf shape is BLOCK-aligned per
     slice so the quantized dynamic-slice branch is genuinely exercised
-    (a misaligned shape silently falls back to whole-leaf)."""
+    (a misaligned shape silently falls back to whole-leaf).
+    ``state_pad_blocks > 1`` adds a ZeRO-alignment padded tail to the
+    quantized storage: the chunked loop's DUS writes must leave it
+    bit-zero (a corrupt tail silently breaks dp-sharded elastic
+    resume)."""
     from deepspeed_tpu.ops import optimizers as O
     from deepspeed_tpu.ops.quant import BLOCK
 
@@ -179,6 +184,7 @@ def test_chunked_leaf_update_matches_whole_leaf(
     monkeypatch.setattr(O, "_chunked_leaf_update", spy)
     opt = O.Adam(
         state_dtype=state_dtype, master_compensation=compensated,
+        state_pad_blocks=state_pad_blocks,
         chunk_elements=BLOCK,  # force chunking
     )
     s0 = opt.init(params)
@@ -186,8 +192,19 @@ def test_chunked_leaf_update_matches_whole_leaf(
     assert any(engaged), "chunked path silently fell back to whole-leaf"
     monkeypatch.setattr(O, "_chunked_leaf_update", orig)
 
+    if state_dtype == "int8" and state_pad_blocks > 1:
+        # the data tail past p.size (here 8 of 16 aligned blocks) is pure
+        # ZeRO padding: a chunked step must keep its q codes AND scales
+        # bit-zero (only mu quantizes under "int8"; nu stores bf16)
+        n_data = params["w"].size
+        mu = s1["mu"]["w"]
+        assert mu["q"].size == state_pad_blocks * BLOCK
+        assert not np.asarray(mu["q"][n_data:]).any()
+        assert not np.asarray(mu["scale"][n_data // BLOCK:]).any()
+
     opt2 = O.Adam(
         state_dtype=state_dtype, master_compensation=compensated,
+        state_pad_blocks=state_pad_blocks,
         chunk_elements=1 << 60,  # whole-leaf
     )
     p2, s2, _ = opt2.apply(params, grads, opt2.init(params), jnp.float32(1e-2))

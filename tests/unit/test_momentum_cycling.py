@@ -1,0 +1,178 @@
+"""OneCycle momentum cycling applied through the engine (VERDICT r04 #4).
+
+The reference mutates optimizer momentum groups each step
+(deepspeed/pt/deepspeed_lr_schedules.py:477-520: betas[0] for Adam-family,
+``momentum`` for SGD-style). Here the engine threads the scheduler's
+``get_mom()`` into the jitted update as a traced scalar (like lr), so the
+cycle never recompiles. Two tiers of evidence:
+
+- optimizer-level: ``apply(..., mom=x)`` is bit-equivalent to an optimizer
+  constructed with that coefficient statically;
+- engine-level: the effective beta reported by ``engine.get_mom()`` follows
+  the configured cycle across steps, and cycling measurably changes the
+  parameter trajectory vs ``cycle_momentum=False``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.ops.optimizers import SGD, Adam, Lamb
+from deepspeed_tpu.runtime.lr_schedules import OneCycle
+from tests.unit.simple_model import SimpleModel, config_dict, init_model, random_dataset
+
+INPUT_DIM = 16
+
+
+def _tiny_tree(seed=0):
+    rng = np.random.default_rng(seed)
+    params = {
+        "w": jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(4,)).astype(np.float32)),
+    }
+    grads = {
+        "w": jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(4,)).astype(np.float32)),
+    }
+    return params, grads
+
+
+@pytest.mark.parametrize("opt_cls", [Adam, Lamb])
+def test_mom_override_matches_static_b1(opt_cls):
+    params, grads = _tiny_tree()
+    dynamic = opt_cls(b1=0.9)
+    static = opt_cls(b1=0.85)
+    state_d = dynamic.init(params)
+    state_s = static.init(params)
+    lr = jnp.float32(1e-2)
+    p_d, s_d, _ = dynamic.apply(
+        params, grads, state_d, lr, mom=jnp.float32(0.85)
+    )
+    p_s, s_s, _ = static.apply(params, grads, state_s, lr)
+    for a, b in zip(
+        jax.tree_util.tree_leaves((p_d, s_d["mu"], s_d["nu"])),
+        jax.tree_util.tree_leaves((p_s, s_s["mu"], s_s["nu"])),
+    ):
+        # traced-scalar vs constant-folded b1 can differ by ~1 ulp through
+        # the bias-correction power; numerically identical otherwise
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-6, atol=1e-6
+        )
+
+
+def test_sgd_mom_override_matches_static_momentum():
+    params, grads = _tiny_tree()
+    dynamic = SGD(momentum=0.9)
+    static = SGD(momentum=0.7)
+    lr = jnp.float32(1e-2)
+    p_d, s_d, _ = dynamic.apply(
+        params, grads, dynamic.init(params), lr, mom=jnp.float32(0.7)
+    )
+    p_s, s_s, _ = static.apply(params, grads, static.init(params), lr)
+    for a, b in zip(
+        jax.tree_util.tree_leaves((p_d, s_d["mom"])),
+        jax.tree_util.tree_leaves((p_s, s_s["mom"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mom_none_is_default_path():
+    params, grads = _tiny_tree()
+    opt = Adam(b1=0.9)
+    lr = jnp.float32(1e-2)
+    p_a, s_a, _ = opt.apply(params, grads, opt.init(params), lr)
+    p_b, s_b, _ = opt.apply(
+        params, grads, opt.init(params), lr, mom=jnp.float32(0.9)
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves((p_a, s_a["mu"])),
+        jax.tree_util.tree_leaves((p_b, s_b["mu"])),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# engine tier
+# ---------------------------------------------------------------------------
+ONE_CYCLE_CFG = {
+    "type": "OneCycle",
+    "params": {
+        "cycle_min_lr": 1e-2,
+        "cycle_max_lr": 2e-2,
+        "cycle_first_step_size": 5,
+        "cycle_min_mom": 0.5,
+        "cycle_max_mom": 0.9,
+    },
+}
+
+
+def _build(cycle_momentum=True, optimizer="Adam"):
+    cfg = config_dict(batch_size=16, optimizer=optimizer)
+    cfg["scheduler"] = {
+        "type": "OneCycle",
+        "params": dict(
+            ONE_CYCLE_CFG["params"], cycle_momentum=cycle_momentum
+        ),
+    }
+    model = SimpleModel(hidden_dim=32)
+    params = init_model(model, INPUT_DIM)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=cfg
+    )
+    return engine
+
+
+@pytest.mark.slow
+def test_engine_effective_beta_follows_cycle():
+    engine = _build()
+    ref_sched = OneCycle(**ONE_CYCLE_CFG["params"])
+    x, y = random_dataset(16 * 8, INPUT_DIM)
+    seen = []
+    for b in range(8):
+        xb, yb = x[b * 16 : (b + 1) * 16], y[b * 16 : (b + 1) * 16]
+        # the value consumed by THIS step's update (pre-advance, like lr)
+        seen.append(engine.get_mom()[0])
+        loss = engine(xb, yb)
+        engine.backward(loss)
+        engine.step()
+        ref_sched.step()
+    # first step uses max mom; the up-phase then walks toward min mom
+    assert seen[0] == pytest.approx(0.9, abs=1e-6)
+    assert seen[4] < seen[1]  # momentum cycles DOWN while lr cycles up
+    # exact parity with the standalone schedule
+    ref2 = OneCycle(**ONE_CYCLE_CFG["params"])
+    for i, m in enumerate(seen):
+        assert m == pytest.approx(ref2.get_mom(), abs=1e-9), f"step {i}"
+        ref2.step()
+
+
+@pytest.mark.slow
+def test_engine_cycling_changes_trajectory():
+    eng_a = _build(cycle_momentum=True)
+    eng_b = _build(cycle_momentum=False)
+    x, y = random_dataset(16 * 6, INPUT_DIM)
+    for b in range(6):
+        xb, yb = x[b * 16 : (b + 1) * 16], y[b * 16 : (b + 1) * 16]
+        for eng in (eng_a, eng_b):
+            loss = eng(xb, yb)
+            eng.backward(loss)
+            eng.step()
+    la = jax.tree_util.tree_leaves(eng_a.params)
+    lb = jax.tree_util.tree_leaves(eng_b.params)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(la, lb)
+    ), "momentum cycling had no effect on the update"
+
+
+@pytest.mark.slow
+def test_engine_mom_constant_without_scheduler():
+    cfg = config_dict(batch_size=16)
+    model = SimpleModel(hidden_dim=32)
+    params = init_model(model, INPUT_DIM)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=cfg
+    )
+    assert engine.get_mom() == [pytest.approx(0.9)]  # Adam default b1
