@@ -76,6 +76,80 @@ def test_gpipe_matches_sequential_fwd_and_grad():
     )
 
 
+def test_gpipe_bubble_ticks_cannot_poison_gradients():
+    """Robustness smoke test: an amplifying (exp-based) stage map must
+    give finite outputs AND grads through fill/drain. Note what this does
+    and does not pin: the bubble-input zeroing in pipeline.py makes bubble
+    compute input-independent (every bubble tick evaluates stage_fn at
+    zeros, never at stale data-dependent activations), but because valid
+    outputs are unaffected by design, no output-level test can detect its
+    removal — the value-parity tests above pin the valid path, and this
+    test guards the finite-gradient property the masking exists to
+    protect."""
+    mesh = build_mesh(data_parallel_size=4, pipeline_parallel_size=2)
+    W, X = _toy_setup()
+    Wp = W.reshape(2, 3, *W.shape[1:])
+
+    def stage_fn(local_w, x, t, extras):
+        def one(x, w):
+            # exp amplifies any unbounded junk to inf within a few hops;
+            # on VALID (bounded) inputs it stays finite
+            return jnp.exp(jnp.clip(x @ w, -50.0, 50.0)) * 1e-2, None
+
+        y, _ = jax.lax.scan(one, x, local_w)
+        return y
+
+    def loss(w):
+        return jnp.sum(gpipe_spmd(stage_fn, w, X, mesh) ** 2)
+
+    val = jax.jit(loss)(Wp)
+    g = jax.jit(jax.grad(loss))(Wp)
+    assert np.isfinite(float(val))
+    assert all(np.all(np.isfinite(np.asarray(l))) for l in jax.tree_util.tree_leaves(g))
+
+
+def test_gpipe_last_stage_fn_keeps_activations_local():
+    """last_stage_fn: per-microbatch scalars computed ON the final stage
+    must equal the reference head-outside-pipeline computation — only [M]
+    floats cross the pipe axis instead of [M, mb, s, h] activations."""
+    mesh = build_mesh(data_parallel_size=4, pipeline_parallel_size=2)
+    W, X = _toy_setup()
+    Wp = W.reshape(2, 3, *W.shape[1:])
+    stage_fn = _toy_stage_fn(3)
+
+    def head(y, mb_idx, extras):
+        return jnp.mean(y * y) + 0.5 * mb_idx.astype(jnp.float32)
+
+    losses = jax.jit(
+        lambda w, x: gpipe_spmd(
+            stage_fn, w, x, mesh, last_stage_fn=head
+        )
+    )(Wp, X)
+    ref_out = _toy_sequential(W, X)
+    ref = jnp.asarray(
+        [jnp.mean(ref_out[i] ** 2) + 0.5 * i for i in range(X.shape[0])]
+    )
+    assert losses.shape == (X.shape[0],)
+    np.testing.assert_allclose(np.asarray(losses), np.asarray(ref), atol=1e-6)
+
+    # and it differentiates (the training path)
+    def loss(w):
+        return jnp.sum(
+            gpipe_spmd(stage_fn, w, X, mesh, last_stage_fn=head)
+        )
+
+    g = jax.jit(jax.grad(loss))(Wp)
+
+    def loss_ref(w):
+        out = _toy_sequential(w.reshape(-1, *w.shape[2:]), X)
+        return jnp.sum(
+            jnp.asarray([jnp.mean(out[i] ** 2) for i in range(X.shape[0])])
+        )
+
+    g_ref = jax.grad(loss_ref)(Wp)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-5)
+
+
 def test_gpipe_single_stage_degenerates_to_scan():
     mesh = build_mesh(data_parallel_size=8)
     W, X = _toy_setup(n_stages=1, layers_per_stage=4)

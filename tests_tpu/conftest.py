@@ -1,0 +1,16 @@
+"""Real-hardware kernel tier: NO platform forcing (unlike tests/conftest.py,
+which pins the CPU mesh). Collected only when passed explicitly:
+
+    python -m pytest tests_tpu/ -q
+
+Every test skips itself unless jax actually sees a TPU, so an accidental
+`pytest tests_tpu` on a CPU box reports skips, not failures.
+"""
+
+import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: needs real TPU hardware (compiled Mosaic kernels)"
+    )

@@ -1,0 +1,224 @@
+"""Compiled-Mosaic kernel numerics on REAL TPU hardware (VERDICT r04 #3).
+
+Everything in tests/unit runs the Pallas kernels in interpret mode on the
+CPU mesh; the compiled TPU lowering — and the in-kernel hardware-PRNG
+dropout, which interpret mode cannot execute at all — had no correctness
+evidence before this tier (the analog of the reference's on-device kernel
+suites, tests/unit/test_cuda_forward.py / test_cuda_backward.py:1-40).
+
+The dropout backward regenerates its keep-mask by reseeding the TPU PRNG
+per (batch*head, q-block, k-block) tile (ops/attention.py:181,243,295); a
+fwd/bwd mask mismatch silently corrupts gradients. The directional-
+derivative test here is the direct check: with a FIXED seed the dropout
+net is deterministic, so a central finite difference along a random
+direction must match <grad, direction> — any mask disagreement between the
+forward and either backward kernel breaks that identity by O(1).
+
+Run once per round on the bench chip and record in docs/TESTING.md:
+
+    python -m pytest tests_tpu/ -q
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import flash_attention, mha_reference
+
+pytestmark = [
+    pytest.mark.tpu,
+    pytest.mark.skipif(
+        jax.devices()[0].platform != "tpu",
+        reason="needs real TPU hardware",
+    ),
+]
+
+B, H, S, D = 2, 4, 256, 64
+
+
+def _qkv(dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(B, H, S, D)).astype(np.float32), dtype
+    )
+    return mk(), mk(), mk()
+
+
+def _kv_mask(valid=192):
+    m = np.zeros((B, S), np.int32)
+    m[:, :valid] = 1
+    return jnp.asarray(m)
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-2), (jnp.bfloat16, 4e-2)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_matches_reference_compiled(dtype, tol, causal):
+    q, k, v = _qkv(dtype)
+    out = jax.jit(
+        functools.partial(flash_attention, causal=causal)
+    )(q, k, v)
+    ref = mha_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=causal,
+    )
+    err = jnp.max(jnp.abs(out.astype(jnp.float32) - ref))
+    assert float(err) < tol, f"max err {float(err):.2e}"
+
+
+def test_flash_fwd_with_kv_mask_compiled():
+    q, k, v = _qkv()
+    kvm = _kv_mask()
+    out = jax.jit(flash_attention)(q, k, v, kv_mask=kvm)
+    # additive-mask reference
+    add = jnp.where(kvm[:, None, None, :] > 0, 0.0, -1e30)
+    ref = mha_reference(q, k, v, mask=add)
+    err = jnp.max(jnp.abs(out - ref))
+    assert float(err) < 2e-2, f"max err {float(err):.2e}"
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_reference_compiled(causal):
+    q, k, v = _qkv()
+    w = jnp.asarray(
+        np.random.default_rng(9).normal(size=(B, H, S, D)).astype(np.float32)
+    )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) * w)
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        denom = float(jnp.max(jnp.abs(b))) or 1.0
+        rel = float(jnp.max(jnp.abs(a - b))) / denom
+        assert rel < 5e-2, f"d{name} rel err {rel:.2e}"
+
+
+def test_flash_dropout_deterministic_per_seed():
+    q, k, v = _qkv()
+    f = jax.jit(
+        functools.partial(flash_attention, dropout_rate=0.3)
+    )
+    a = f(q, k, v, dropout_seed=7)
+    b = f(q, k, v, dropout_seed=7)
+    c = f(q, k, v, dropout_seed=8)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(jnp.max(jnp.abs(a - c))) > 1e-3, "seed does not change mask"
+    nodrop = jax.jit(flash_attention)(q, k, v)
+    assert float(jnp.max(jnp.abs(a - nodrop))) > 1e-3, "dropout is a no-op"
+
+
+@pytest.mark.parametrize("wrt", [0, 1, 2])
+def test_flash_dropout_fwd_bwd_mask_consistency(wrt):
+    """Central finite difference == autodiff directional derivative.
+
+    The keep-mask depends only on (seed, tile indices) — never on the
+    inputs — so with a fixed seed both f(x+h d) and f(x-h d) see the SAME
+    mask and the identity is exact up to float noise. If any of the three
+    kernels (fwd, dq, dkv) regenerated a different mask, backward would
+    differentiate a different function and the mismatch would be O(1)."""
+    q, k, v = _qkv()
+    w = jnp.asarray(
+        np.random.default_rng(3).normal(size=(B, H, S, D)).astype(np.float32)
+    )
+
+    def loss(*args):
+        return jnp.sum(
+            flash_attention(*args, dropout_rate=0.3, dropout_seed=11) * w
+        )
+
+    args = [q, k, v]
+    g = jax.jit(jax.grad(loss, argnums=wrt))(*args)
+    d = jnp.asarray(
+        np.random.default_rng(4).normal(size=(B, H, S, D)).astype(np.float32)
+    )
+    h = 2e-2
+    jl = jax.jit(loss)
+    plus = list(args)
+    plus[wrt] = args[wrt] + h * d
+    minus = list(args)
+    minus[wrt] = args[wrt] - h * d
+    fd = (float(jl(*plus)) - float(jl(*minus))) / (2 * h)
+    ad = float(jnp.sum(g * d))
+    scale = max(abs(fd), abs(ad), 1.0)
+    assert abs(fd - ad) / scale < 0.15, (
+        f"directional derivative mismatch wrt {'qkv'[wrt]}: fd={fd:.4f} "
+        f"ad={ad:.4f} — fwd/bwd dropout masks disagree"
+    )
+
+
+def test_train_with_attention_dropout_converges():
+    """Statistical tier: a small causal LM trained THROUGH the flash
+    dropout path (rate 0.1) must reduce loss with finite grads — the
+    end-to-end form of the mask-consistency evidence."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config(
+        vocab_size=256, n_positions=S, n_embd=128, n_layer=2, n_head=4,
+        dropout=0.1,  # feeds BOTH attn_dropout_ratio and hidden_dropout
+    )
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    # learnable structure: next token = current token + 1 (mod vocab)
+    base = rng.integers(0, 256, (8, S + 1)).astype(np.int32)
+    seq = np.cumsum(np.ones_like(base), axis=1) % 7 + (base[:, :1] % 13)
+    ids = (seq[:, :-1] % 256).astype(np.int32)
+    tgt = (seq[:, 1:] % 256).astype(np.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jnp.asarray(ids[:2]), jnp.asarray(tgt[:2]),
+    )["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    losses = []
+    for _ in range(30):
+        loss = engine(ids, tgt)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert engine.skipped_steps == 0
+    assert losses[-1] < 0.7 * losses[0], losses
+
+
+def test_pallas_lamb_matches_xla_lamb_compiled():
+    from deepspeed_tpu.ops.optimizers import Lamb
+    from deepspeed_tpu.ops.pallas import FusedLamb
+
+    rng = np.random.default_rng(0)
+    params = {
+        "w": jnp.asarray(rng.normal(size=(256, 128)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(128,)).astype(np.float32)),
+    }
+    grads = {
+        "w": jnp.asarray(rng.normal(size=(256, 128)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(128,)).astype(np.float32)),
+    }
+    xla = Lamb(weight_decay=0.01)
+    fused = FusedLamb(weight_decay=0.01)
+    lr = jnp.float32(1e-2)
+    p1, s1, a1 = jax.jit(xla.apply)(params, grads, xla.init(params), lr)
+    p2, s2, a2 = jax.jit(fused.apply)(params, grads, fused.init(params), lr)
+    for key in params:
+        np.testing.assert_allclose(
+            np.asarray(p1[key]), np.asarray(p2[key]), rtol=1e-5, atol=1e-6
+        )
+    for c1, c2 in zip(a1["lamb_coeffs"], a2["lamb_coeffs"]):
+        np.testing.assert_allclose(
+            float(c1), float(c2), rtol=1e-5, atol=1e-6
+        )
