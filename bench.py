@@ -602,8 +602,8 @@ def _gpt2_section_key(name):
     }[name]
 
 
-def bench_gpt2(on_result=None):
-    models = GPT2_MODELS
+def bench_gpt2(on_result=None, models=None):
+    models = GPT2_MODELS if models is None else models
     name_env = os.environ.get("BENCH_GPT2")
     if name_env:
         models = [m for m in models if m == name_env]
@@ -724,10 +724,16 @@ def main():
             "extras": {k: v for k, v in results.items() if v is not None},
         }), flush=True)
 
-    # north star FIRST (the round-3 run died compiling it last); the soft
-    # budget then decides how many of the stable sections re-measure
+    # north star FIRST (the round-3 run died compiling it last), then the
+    # four HEADLINE sections; the smaller gpt2 proxies run only on leftover
+    # budget (the round-4 run died compiling 774m before BERT ever ran)
     if only in (None, "gpt2"):
-        bench_gpt2(on_result=record)
+        # BENCH_GPT2 pins one model: let the env filter pick it from the
+        # full list; otherwise only the 1.5B north star runs up front
+        bench_gpt2(
+            on_result=record,
+            models=None if os.environ.get("BENCH_GPT2") else ["gpt2_1.5b"],
+        )
     for key, fn, est in (
         ("bert", bench_bert, 240),
         ("bert_seq512", bench_bert_seq512, 240),
@@ -740,6 +746,17 @@ def main():
             log(f"{key}: budget low ({_remaining():.0f}s < ~{est}s); skipping")
             continue
         record(key, fn())
+    if only in (None, "gpt2") and not os.environ.get("BENCH_GPT2"):
+        if _remaining() >= 300:
+            bench_gpt2(
+                on_result=record,
+                models=["gpt2_large_774m", "gpt2_medium_355m"],
+            )
+        else:
+            log(
+                f"gpt2 proxies: budget low ({_remaining():.0f}s); "
+                "headline grid complete, skipping 774m/355m"
+            )
 
     if all(v is None for v in results.values()):
         log("FATAL: no benchmark produced a number")

@@ -271,6 +271,9 @@ def load_checkpoint(
     engine.global_steps = int(state["global_steps"])
     engine.skipped_steps = int(state["skipped_steps"])
     engine.micro_steps = int(state["micro_steps"])
+    # saves reconcile first (keep_last=False), so the persisted
+    # global_steps IS the settled count — resync the monitor step index
+    engine._settled_steps = engine.global_steps
     import jax.numpy as jnp
 
     sc = state["loss_scaler"]

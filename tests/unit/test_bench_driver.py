@@ -137,6 +137,32 @@ def test_prev_extras_newer_round_wins_per_section(bench, tmp_path):
     assert merged["bert"]["value"] == 380.0
 
 
+def test_headline_sections_run_before_gpt2_proxies(bench, monkeypatch):
+    """r04 lesson: the driver run died compiling the 774m PROXY before
+    BERT ever ran. Order must be: 1.5B north star, then bert/bert512/
+    squad, then proxies only on leftover budget."""
+    calls = []
+
+    def fake_attempt(spec, timeout=1500):
+        calls.append(spec)
+        if spec["kind"] == "gpt2":
+            return _result(
+                f"{spec['model']}_causal_lm_seq1024_tokens_per_sec_per_chip"
+            )
+        return _result(f"{spec['kind']}_metric")
+
+    monkeypatch.setattr(bench, "_run_attempt", fake_attempt)
+    bench.main()
+    order = [
+        c["model"] if c["kind"] == "gpt2" else c["kind"] for c in calls
+    ]
+    first_bert = order.index("bert")
+    first_proxy = order.index("gpt2_large_774m")
+    assert order[0] == "gpt2_1.5b"
+    assert first_bert < first_proxy
+    assert "squad" in order[:first_proxy]
+
+
 def test_worker_attempt_timeout_capped_by_budget(bench, monkeypatch):
     seen = {}
 

@@ -166,3 +166,57 @@ def test_train_batch_path_reconciles():
     engine._reconcile_deferred(keep_last=False)
     assert engine.skipped_steps == 1
     assert engine.global_steps == 2
+
+
+def test_monitor_steps_unique_after_reconciled_skip():
+    """Round-3/4 known artifact, now fixed: monitor scalars on the async
+    path settle WITH the overflow flags and write at the settled step
+    index, so a reconciled skip can never make two windows share a step
+    number in TensorBoard-style sinks."""
+
+    class RecordingMonitor:
+        enabled = True
+
+        def __init__(self):
+            self.writes = []
+
+        def write_scalars(self, scalars, step):
+            self.writes.append((step, dict(scalars)))
+
+    engine = _engine("bf16")
+    engine.monitor = RecordingMonitor()
+    _step(engine)
+    _step(engine, poison=True)  # device-side skip, settles a window late
+    _step(engine)
+    _step(engine)
+    engine._reconcile_deferred(keep_last=False)
+    steps = [s for s, _ in engine.monitor.writes]
+    # 3 clean windows -> exactly 3 writes at unique, consecutive indices
+    assert steps == [1, 2, 3], steps
+    assert engine.global_steps == 3 and engine.skipped_steps == 1
+    # the skipped window must not have produced a write at all
+    for _, scalars in engine.monitor.writes:
+        assert scalars.get("Train/grad_norm", 0.0) >= 0.0
+
+
+def test_flush_monitor_writes_final_window():
+    """The settle queue holds the NEWEST window's scalars until the next
+    settle point; flush_monitor() (and checkpoint saves) must emit it."""
+
+    class RecordingMonitor:
+        enabled = True
+        writer = None
+
+        def __init__(self):
+            self.writes = []
+
+        def write_scalars(self, scalars, step):
+            self.writes.append(step)
+
+    engine = _engine("bf16")
+    engine.monitor = RecordingMonitor()
+    _step(engine)
+    _step(engine)
+    assert engine.monitor.writes == [1]  # window 2 still pending
+    engine.flush_monitor()
+    assert engine.monitor.writes == [1, 2]
