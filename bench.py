@@ -87,14 +87,19 @@ GPT2_POLICY = "dots_with_no_batch_dims_saveable+flash_out+flash_lse"
 # (774M measured: int8@micro8 13.3k tok/s / 61.6 TFLOPS vs fp32@micro4
 # 12.5k / 57.9; micro=12 and 16 OOM). fp32 rungs keep the
 # reference-exact-state fallback.
+# accum rungs amortize the optimizer step (774M int8@micro8 measured r05:
+# accum=8 16226 tok/s / 75.4 TFLOPS > accum=4 15776 / 73.3 > accum=1
+# 11916 / 55.4 — +36% from accumulation alone, vs_baseline 1.98)
 GPT2_ATTEMPTS = [
-    (GPT2_POLICY, 8, "int8"),
-    (GPT2_POLICY, 8, "fp32"),
-    (GPT2_POLICY, 4, "fp32"),
-    ("dots_with_no_batch_dims_saveable", 4, "fp32"),
-    ("full", 4, "fp32"),
-    ("full", 2, "fp32"),
-    ("full", 1, "fp32"),
+    (GPT2_POLICY, 8, "int8", 8),
+    (GPT2_POLICY, 8, "int8", 4),
+    (GPT2_POLICY, 8, "int8", 1),
+    (GPT2_POLICY, 8, "fp32", 1),
+    (GPT2_POLICY, 4, "fp32", 1),
+    ("dots_with_no_batch_dims_saveable", 4, "fp32", 1),
+    ("full", 4, "fp32", 1),
+    ("full", 2, "fp32", 1),
+    ("full", 1, "fp32", 1),
 ]
 # ladder when fp32 optimizer state cannot fit (e.g. 1.5B on 16 GB):
 # compensated bf16 master (int8 Kahan codes) + int8 mu + bf16 nu + bf16
@@ -103,11 +108,18 @@ GPT2_ATTEMPTS = [
 # micro=2 3853 tok/s, micro=1 full-remat 2441 tok/s
 # (micro=8 measured OOM at runtime — not in the ladder: a failed rung
 # costs ~10 min of compile before the OOM surfaces)
+# 4th field: gradient-accumulation steps — amortizes the optimizer step
+# (measured r05 at 1.5B: fwd+bwd ~460 ms vs step ~340 ms per window) over
+# accum x tokens, like the reference's accumulated global batches. At
+# 1.5B every accum>1 rung OOMs (measured, even with the fold-into-buffer
+# accumulate): the state already presses the 16 GB ceiling — so the
+# reduced ladder stays accum=1 and accum rungs live in GPT2_ATTEMPTS
+# where headroom exists.
 GPT2_REDUCED_ATTEMPTS = [
-    ("flash_out+flash_lse", 4, "int8"),
-    ("flash_out+flash_lse", 2, "int8"),
-    ("flash_out+flash_lse", 1, "int8"),
-    ("full", 1, "int8"),
+    ("flash_out+flash_lse", 4, "int8", 1),
+    ("flash_out+flash_lse", 2, "int8", 1),
+    ("flash_out+flash_lse", 1, "int8", 1),
+    ("full", 1, "int8", 1),
 ]
 
 
@@ -157,13 +169,16 @@ def _measure_engine(engine, micro_batches, accum, warmup_windows, measure_window
     return _measure(window, warmup_windows, measure_windows)
 
 
-def _measure_engine_unfused(engine, batch, warmup_windows, measure_windows):
-    """Like _measure_engine but through forward()/backward()/step() (accum
-    windows of 1); returns seconds/window."""
+def _measure_engine_unfused(engine, batch, warmup_windows, measure_windows,
+                            accum=1):
+    """Like _measure_engine but through forward()/backward()/step();
+    ``accum`` micro-steps per optimizer step. Returns seconds/window
+    (window = accum micro-batches + one update)."""
 
     def window():
-        loss = engine(*batch)
-        engine.backward(loss)
+        for _ in range(accum):
+            loss = engine(*batch)
+            engine.backward(loss)
         engine.step()
         return loss
 
@@ -324,7 +339,7 @@ def squad_attempt(policy, micro):
     }
 
 
-def gpt2_attempt(model_name, policy, micro, state_dtype="fp32"):
+def gpt2_attempt(model_name, policy, micro, state_dtype="fp32", accum=1):
     import dataclasses
 
     import jax
@@ -362,7 +377,9 @@ def gpt2_attempt(model_name, policy, micro, state_dtype="fp32"):
         model=model,
         model_parameters=params,
         config_params={
-            "train_batch_size": micro,
+            "train_batch_size": micro * accum,
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": accum,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
             "bf16": {"enabled": True},
             "zero_optimization": {"stage": 2},
@@ -388,18 +405,20 @@ def gpt2_attempt(model_name, policy, micro, state_dtype="fp32"):
     fused_env = os.environ.get("BENCH_GPT2_FUSED")
     if state_dtype != "fp32" and fused_env != "1":
         # reduced-state models run the UNFUSED step (forward/backward/step
-        # as two programs): the fused window's grad carries + allocator
-        # fragmentation exceed 16 GB at 1.5B, the split programs fit
-        # (BENCH_GPT2_FUSED=1 forces the fused window for tuning runs)
+        # as separate programs): the fused window's grad carries +
+        # allocator fragmentation exceed 16 GB at 1.5B, the split programs
+        # fit (BENCH_GPT2_FUSED=1 forces the fused window for tuning runs)
         sec_per_window = _measure_engine_unfused(
             engine, (ids, ids), warmup_windows=2, measure_windows=6,
+            accum=accum,
         )
     else:
         sec_per_window = _measure_engine(
-            engine, [(ids, ids)], 1, warmup_windows=2, measure_windows=6,
+            engine, [(ids, ids)] * accum, accum,
+            warmup_windows=2, measure_windows=6,
         )
-    tps = micro * SEQ / sec_per_window
-    tflops = 6 * n_params * micro * SEQ / sec_per_window / 1e12
+    tps = micro * accum * SEQ / sec_per_window
+    tflops = 6 * n_params * micro * accum * SEQ / sec_per_window / 1e12
     baseline_tps = REF_TFLOPS / (6 * n_params)
     log(f"GPT-2 {model_name}: {tps:.0f} tokens/s ({tflops:.1f} model TFLOPS)")
     return {
@@ -409,6 +428,7 @@ def gpt2_attempt(model_name, policy, micro, state_dtype="fp32"):
         "vs_baseline": round(tps / baseline_tps, 3),
         "baseline_tokens_per_sec": round(baseline_tps, 1),
         "micro_batch": micro,
+        "accum": accum,
         "remat_policy": policy,
         "optimizer_state_dtype": state_dtype,
         "model_tflops": round(tflops, 1),
@@ -431,6 +451,7 @@ def _worker_main():
             result = gpt2_attempt(
                 spec["model"], spec["policy"], spec["micro"],
                 state_dtype=spec.get("state_dtype", "fp32"),
+                accum=spec.get("accum", 1),
             )
     except Exception as e:  # noqa: BLE001
         if _is_oom(e):
@@ -621,6 +642,7 @@ def bench_gpt2(on_result=None, models=None):
                 os.environ.get("BENCH_GPT2_POLICY", GPT2_POLICY),
                 int(micro_env),
                 os.environ.get("BENCH_GPT2_STATE", "int8"),
+                int(os.environ.get("BENCH_GPT2_ACCUM", "1")),
             )]
         elif fits("fp32"):
             attempts = GPT2_ATTEMPTS
@@ -643,14 +665,14 @@ def bench_gpt2(on_result=None, models=None):
                 "skipping (this is the model ZeRO shards across chips)"
             )
             continue
-        for policy, micro, sd in attempts:
+        for policy, micro, sd, accum in attempts:
             log(
-                f"GPT-2 {name} attempt: micro={micro} policy={policy} "
-                f"state={sd}"
+                f"GPT-2 {name} attempt: micro={micro} accum={accum} "
+                f"policy={policy} state={sd}"
             )
             result = _run_attempt(
                 {"kind": "gpt2", "model": name, "policy": policy,
-                 "micro": micro, "state_dtype": sd}
+                 "micro": micro, "state_dtype": sd, "accum": accum}
             )
             if result is not None:
                 if on_result is not None:

@@ -248,6 +248,17 @@ class Adam(Optimizer):
     # axis chunks; the engine raises this to "never" under ZeRO sharding
     # (see _chunked_leaf_update).
     chunk_elements: int = _CHUNK_ELEMENTS
+    # OPT-IN (see below): blockwise-quantized (int8) first moments update
+    # in the PADDED FLAT domain of the {'q','scale'} storage instead of
+    # per-leading-axis chunks — one fused elementwise pass, no fori_loop
+    # serialization. Math-verified vs the chunked/whole-leaf paths
+    # (tests/unit/test_memory_savers.py) and correct on every backend, but
+    # left OFF by default: the round-5 bench platform's remote TPU
+    # compiler crashed (tpu_compile_helper exit 1, reproducibly, in both
+    # 1D and (nb, BLOCK) 2D formulations) compiling it at GPT-2 1.5B
+    # scale, so the measured default stays the chunked path (414 ms at
+    # 1.5B vs a ~26 ms HBM-bandwidth ideal — revisit on newer toolchains).
+    flat_quant_update: bool = False
     supports_gate = True
     supports_mom = True
 
@@ -292,13 +303,9 @@ class Adam(Optimizer):
             c1 = c2 = jnp.float32(1.0)
         comped = self.master_compensation
 
-        def leaf(p, g, m_st, v_st, comp=None):
-            g32 = _f32(g)
-            if grad_scale is not None:
-                g32 = g32 * grad_scale
-            p32 = decode_master(p, comp) if comped else _f32(p)
-            m = decode_moment(m_st, p.shape)
-            v = decode_moment(v_st, p.shape)
+        def adam_core(p32, g32, m, v):
+            """The fp32 update math — ONE implementation shared by the
+            shaped leaf path and the flat quantized path."""
             if self.weight_decay and not self.adam_w_mode:
                 g32 = g32 + self.weight_decay * p32
             m_new = b1 * m + (1.0 - b1) * g32
@@ -306,7 +313,16 @@ class Adam(Optimizer):
             update = (m_new / c1) / (jnp.sqrt(v_new / c2) + self.eps)
             if self.weight_decay and self.adam_w_mode:
                 update = update + self.weight_decay * p32
-            master_new = p32 - lr * update
+            return p32 - lr * update, m_new, v_new
+
+        def leaf(p, g, m_st, v_st, comp=None):
+            g32 = _f32(g)
+            if grad_scale is not None:
+                g32 = g32 * grad_scale
+            p32 = decode_master(p, comp) if comped else _f32(p)
+            m = decode_moment(m_st, p.shape)
+            v = decode_moment(v_st, p.shape)
+            master_new, m_new, v_new = adam_core(p32, g32, m, v)
             if comped:
                 p_new, comp_new = encode_master(master_new, p.dtype)
             else:
@@ -323,7 +339,72 @@ class Adam(Optimizer):
                 out = out + (_gate_stored(gate, comp_new, comp),)
             return out
 
+        def leaf_flat_quant(p, g, m_st, v_st, comp=None):
+            """``adam_core`` on the padded flat domain of the quantized mu
+            storage. The zero padding is self-preserving: zero grads +
+            zero params give a zero update, so the ZeRO-aligned tail stays
+            bit-zero (pinned by test_memory_savers.
+            test_flat_quant_update_matches_whole_leaf's tail
+            assertions)."""
+            from .quant import (
+                BLOCK,
+                decode_master,
+                dequantize,
+                encode_master,
+                encode_moment,
+                quantize,
+            )
+
+            npad = m_st["q"].size
+            pad = npad - p.size
+            gf = jnp.pad(g.reshape(-1), (0, pad))
+            g32 = _f32(gf)
+            if grad_scale is not None:
+                g32 = g32 * grad_scale
+            pf = jnp.pad(p.reshape(-1), (0, pad))
+            if comped:
+                cf = jnp.pad(comp.reshape(-1), (0, pad))
+                p32 = decode_master(pf, cf)
+            else:
+                p32 = _f32(pf)
+            m = dequantize(m_st, (npad,))
+            v = _f32(jnp.pad(v_st.reshape(-1), (0, pad)))
+            master_new, m_new, v_new = adam_core(p32, g32, m, v)
+
+            def unflat(x):
+                return x[: p.size].reshape(p.shape)
+
+            if comped:
+                p_new, comp_new = encode_master(master_new, p.dtype)
+                p_new, comp_new = unflat(p_new), unflat(comp_new)
+            else:
+                p_new, comp_new = unflat(master_new).astype(p.dtype), None
+            out = (
+                _gate_stored(gate, p_new, p),
+                _gate_stored(gate, quantize(m_new, nb=npad // BLOCK), m_st),
+                _gate_stored(
+                    gate, encode_moment(unflat(v_new), v_st), v_st
+                ),
+            )
+            if comped:
+                out = out + (_gate_stored(gate, comp_new, comp),)
+            return out
+
         def leaf_outer(p, g, m_st, v_st, comp=None):
+            from .quant import is_quantized
+
+            # flat path exactly where chunking WOULD have engaged (same
+            # size threshold): under ZeRO sharding the engine raises
+            # chunk_elements to "never", which also keeps the shaped
+            # whole-leaf path there — flattening tp/dp-sharded operands
+            # would reintroduce the resharding reshapes the leading-dim
+            # specs eliminated
+            if (
+                self.flat_quant_update
+                and is_quantized(m_st)
+                and p.size >= self.chunk_elements
+            ):
+                return leaf_flat_quant(p, g, m_st, v_st, comp)
             chunked = _chunked_leaf_update(
                 leaf, p, g, m_st, v_st, comp,
                 threshold=self.chunk_elements,

@@ -186,6 +186,7 @@ def test_chunked_leaf_update_matches_whole_leaf(
         state_dtype=state_dtype, master_compensation=compensated,
         state_pad_blocks=state_pad_blocks,
         chunk_elements=BLOCK,  # force chunking
+        flat_quant_update=False,  # the CHUNKED path is under test here
     )
     s0 = opt.init(params)
     p1, s1, _ = opt.apply(params, grads, s0, jnp.float32(1e-2))
@@ -726,3 +727,87 @@ def test_int8_checkpoint_crosses_pad_policies(tmp_path):
     assert back.global_steps == 6
     back.eval()
     np.testing.assert_allclose(float(back(X, Y)), fp2, rtol=1e-5)
+
+
+@pytest.mark.parametrize("state_pad_blocks", [1, 16])
+@pytest.mark.parametrize("compensated", [False, True])
+def test_flat_quant_update_matches_whole_leaf(compensated, state_pad_blocks):
+    """The padded-flat-domain int8 update (Adam.flat_quant_update — an
+    OPT-IN path, default OFF: the round-5 bench platform's TPU compiler
+    crashes on it at 1.5B scale; the chunked path stays the measured
+    default) must match the shaped whole-leaf path to float noise, and
+    keep the ZeRO padded tail bit-zero."""
+    from deepspeed_tpu.ops import optimizers as O
+    from deepspeed_tpu.ops.quant import BLOCK
+
+    rng = np.random.default_rng(0)
+    shape = (4, 2, BLOCK)
+    dtype = jnp.bfloat16 if compensated else jnp.float32
+    params = {"w": jnp.asarray(rng.normal(size=shape), dtype)}
+    grads = {"w": jnp.asarray(rng.normal(size=shape), dtype)}
+
+    flat = O.Adam(
+        state_dtype="int8", master_compensation=compensated,
+        state_pad_blocks=state_pad_blocks,
+        chunk_elements=BLOCK,  # size threshold met -> flat path engages
+        flat_quant_update=True,
+    )
+    whole = O.Adam(
+        state_dtype="int8", master_compensation=compensated,
+        state_pad_blocks=state_pad_blocks,
+        chunk_elements=1 << 60,  # whole-leaf shaped path
+        flat_quant_update=True,  # inert below the threshold
+    )
+    lr = jnp.float32(1e-2)
+    p1, s1, _ = flat.apply(params, grads, flat.init(params), lr)
+    p2, s2, _ = whole.apply(params, grads, whole.init(params), lr)
+    np.testing.assert_allclose(
+        np.asarray(p1["w"], np.float32), np.asarray(p2["w"], np.float32),
+        rtol=1e-5, atol=1e-6,
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1), jax.tree_util.tree_leaves(s2)
+    ):
+        if a.dtype == jnp.int8:
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1.0
+            )
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-5, atol=1e-6,
+            )
+    if state_pad_blocks > 1:
+        n_data = params["w"].size
+        mu = s1["mu"]["w"]
+        assert not np.asarray(mu["q"][n_data:]).any()
+        assert not np.asarray(mu["scale"][n_data // BLOCK:]).any()
+
+
+def test_flat_quant_update_gate_is_bitexact_noop():
+    from deepspeed_tpu.ops import optimizers as O
+    from deepspeed_tpu.ops.quant import BLOCK
+
+    rng = np.random.default_rng(1)
+    params = {"w": jnp.asarray(rng.normal(size=(4, 2, BLOCK)), jnp.bfloat16)}
+    grads = {"w": jnp.asarray(rng.normal(size=(4, 2, BLOCK)), jnp.bfloat16)}
+    opt = O.Adam(
+        state_dtype="int8", master_compensation=True,
+        chunk_elements=BLOCK, flat_quant_update=True,
+    )
+    s0 = opt.init(params)
+    # one real step to produce nonzero state, then a gated-off step
+    p1, s1, _ = opt.apply(params, grads, s0, jnp.float32(1e-2))
+    p2, s2, _ = opt.apply(
+        p1, grads, s1, jnp.float32(1e-2), gate=jnp.bool_(False)
+    )
+    np.testing.assert_array_equal(np.asarray(p1["w"]), np.asarray(p2["w"]))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(
+            {k: s1[k] for k in ("mu", "nu", "comp")}
+        ),
+        jax.tree_util.tree_leaves(
+            {k: s2[k] for k in ("mu", "nu", "comp")}
+        ),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
