@@ -82,8 +82,8 @@ GPT2_MODELS = ["gpt2_1.5b", "gpt2_large_774m", "gpt2_medium_355m"]
 # backward: measured 8.0k -> 13.1k tokens/s together with the 512-block
 # kernel defaults on gpt2-large.
 GPT2_POLICY = "dots_with_no_batch_dims_saveable+flash_out+flash_lse"
-# (policy, micro, optimizer_state_dtype) ladder. The reduced-state rung
-# leads even when fp32 fits: the freed HBM buys a bigger micro-batch
+# (policy, micro, optimizer_state_dtype, accum) ladder. The reduced-state
+# rung leads even when fp32 fits: the freed HBM buys a bigger micro-batch
 # (774M measured: int8@micro8 13.3k tok/s / 61.6 TFLOPS vs fp32@micro4
 # 12.5k / 57.9; micro=12 and 16 OOM). fp32 rungs keep the
 # reference-exact-state fallback.
