@@ -205,6 +205,68 @@ class DeepSpeedConfig:
             tb_dict, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT
         )
 
+        # telemetry block (deepspeed_tpu/telemetry/, docs/observability.md)
+        tel_dict = get_dict_param(pd, C.TELEMETRY)
+        self.telemetry_enabled = get_scalar_param(
+            tel_dict, C.TELEMETRY_ENABLED, C.TELEMETRY_ENABLED_DEFAULT
+        )
+        self.telemetry_output_path = get_scalar_param(
+            tel_dict, C.TELEMETRY_OUTPUT_PATH, C.TELEMETRY_OUTPUT_PATH_DEFAULT
+        )
+        self.telemetry_job_name = get_scalar_param(
+            tel_dict, C.TELEMETRY_JOB_NAME, C.TELEMETRY_JOB_NAME_DEFAULT
+        )
+        self.telemetry_interval = get_scalar_param(
+            tel_dict, C.TELEMETRY_INTERVAL, C.TELEMETRY_INTERVAL_DEFAULT
+        )
+        # keep a non-list value (a bare string would list() into
+        # characters, an int would TypeError) for _check_telemetry to
+        # reject with a config error instead
+        exporters = tel_dict.get(
+            C.TELEMETRY_EXPORTERS, C.TELEMETRY_EXPORTERS_DEFAULT
+        )
+        self.telemetry_exporters = (
+            list(exporters) if isinstance(exporters, (list, tuple))
+            else exporters
+        )
+        self.telemetry_prometheus_path = get_scalar_param(
+            tel_dict,
+            C.TELEMETRY_PROMETHEUS_PATH,
+            C.TELEMETRY_PROMETHEUS_PATH_DEFAULT,
+        )
+        profile_dict = get_dict_param(tel_dict, C.TELEMETRY_PROFILE)
+        self.telemetry_profile_start_step = get_scalar_param(
+            profile_dict,
+            C.TELEMETRY_PROFILE_START_STEP,
+            C.TELEMETRY_PROFILE_START_STEP_DEFAULT,
+        )
+        self.telemetry_profile_num_steps = get_scalar_param(
+            profile_dict,
+            C.TELEMETRY_PROFILE_NUM_STEPS,
+            C.TELEMETRY_PROFILE_NUM_STEPS_DEFAULT,
+        )
+        self.telemetry_profile_output_path = get_scalar_param(
+            profile_dict,
+            C.TELEMETRY_PROFILE_OUTPUT_PATH,
+            C.TELEMETRY_PROFILE_OUTPUT_PATH_DEFAULT,
+        )
+        watchdog_dict = get_dict_param(tel_dict, C.TELEMETRY_WATCHDOG)
+        self.telemetry_watchdog_enabled = self.telemetry_enabled and get_scalar_param(
+            watchdog_dict,
+            C.TELEMETRY_WATCHDOG_ENABLED,
+            C.TELEMETRY_WATCHDOG_ENABLED_DEFAULT,
+        )
+        self.telemetry_watchdog_timeout = get_scalar_param(
+            watchdog_dict,
+            C.TELEMETRY_WATCHDOG_TIMEOUT,
+            C.TELEMETRY_WATCHDOG_TIMEOUT_DEFAULT,
+        )
+        self.telemetry_watchdog_poll_interval = get_scalar_param(
+            watchdog_dict,
+            C.TELEMETRY_WATCHDOG_POLL_INTERVAL,
+            C.TELEMETRY_WATCHDOG_POLL_INTERVAL_DEFAULT,
+        )
+
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
         self.data_parallel_size = get_scalar_param(
@@ -299,6 +361,7 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
         if self.loss_scale < 0:
             raise DeepSpeedConfigError(f"loss_scale must be >= 0, got {self.loss_scale}")
+        self._check_telemetry()
         amp_dict = get_dict_param(self._param_dict, C.AMP)
         if amp_dict.get(C.AMP_ENABLED, bool(amp_dict)):
             # apex amp (reference deepspeed_light.py:516-521) has no TPU
@@ -308,6 +371,92 @@ class DeepSpeedConfig:
                 'the "amp" block has no TPU equivalent (apex amp is '
                 "CUDA-only); use {'bf16': {'enabled': true}} — bf16 is the "
                 "native mixed-precision path and needs no loss scaler"
+            )
+
+    def _check_telemetry(self):
+        """Validate the telemetry block (like the tensorboard block, but
+        with cross-field constraints worth failing loudly on)."""
+        if not isinstance(self.telemetry_exporters, list) or not all(
+            isinstance(e, str) for e in self.telemetry_exporters
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_EXPORTERS} must be a list of "
+                f"strings, got {self.telemetry_exporters!r}"
+            )
+        for exporter in self.telemetry_exporters:
+            if exporter not in C.TELEMETRY_VALID_EXPORTERS:
+                raise DeepSpeedConfigError(
+                    f"unknown telemetry exporter {exporter!r}; valid: "
+                    f"{list(C.TELEMETRY_VALID_EXPORTERS)}"
+                )
+        if (
+            not isinstance(self.telemetry_interval, int)
+            or isinstance(self.telemetry_interval, bool)
+            or self.telemetry_interval < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_INTERVAL} must be an integer "
+                f">= 1, got {self.telemetry_interval!r}"
+            )
+        # type-check numerics up front: a string like "600" would hit the
+        # range comparisons below as a raw TypeError instead of a config
+        # error naming the field
+        for field, value, want_int in (
+            (f"{C.TELEMETRY_PROFILE}.{C.TELEMETRY_PROFILE_START_STEP}",
+             self.telemetry_profile_start_step, True),
+            (f"{C.TELEMETRY_PROFILE}.{C.TELEMETRY_PROFILE_NUM_STEPS}",
+             self.telemetry_profile_num_steps, True),
+            (f"{C.TELEMETRY_WATCHDOG}.{C.TELEMETRY_WATCHDOG_TIMEOUT}",
+             self.telemetry_watchdog_timeout, False),
+            (f"{C.TELEMETRY_WATCHDOG}.{C.TELEMETRY_WATCHDOG_POLL_INTERVAL}",
+             self.telemetry_watchdog_poll_interval, False),
+        ):
+            if value is None and not want_int:
+                continue  # watchdog fields accept null (poll -> timeout/4)
+            ok = (
+                isinstance(value, int) if want_int
+                else isinstance(value, (int, float))
+            ) and not isinstance(value, bool)
+            if not ok:
+                raise DeepSpeedConfigError(
+                    f"{C.TELEMETRY}.{field} must be "
+                    f"{'an integer' if want_int else 'a number'}, "
+                    f"got {value!r}"
+                )
+        if self.telemetry_profile_start_step < -1:
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_PROFILE}."
+                f"{C.TELEMETRY_PROFILE_START_STEP} must be >= 0 (or -1 for "
+                f"disabled), got {self.telemetry_profile_start_step}"
+            )
+        if (
+            self.telemetry_profile_start_step >= 0
+            and self.telemetry_profile_num_steps < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_PROFILE}."
+                f"{C.TELEMETRY_PROFILE_NUM_STEPS} must be >= 1 when a "
+                f"profile window is armed, got "
+                f"{self.telemetry_profile_num_steps}"
+            )
+        if self.telemetry_watchdog_enabled and not (
+            self.telemetry_watchdog_timeout
+            and self.telemetry_watchdog_timeout > 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_WATCHDOG}."
+                f"{C.TELEMETRY_WATCHDOG_TIMEOUT} must be > 0 seconds, got "
+                f"{self.telemetry_watchdog_timeout!r}"
+            )
+        if (
+            self.telemetry_watchdog_poll_interval is not None
+            and self.telemetry_watchdog_poll_interval <= 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_WATCHDOG}."
+                f"{C.TELEMETRY_WATCHDOG_POLL_INTERVAL} must be > 0 seconds "
+                f"(or null for timeout/4), got "
+                f"{self.telemetry_watchdog_poll_interval!r}"
             )
 
     def _do_warning_check(self):

@@ -265,6 +265,52 @@ TENSORBOARD_OUTPUT_PATH_DEFAULT = ""
 TENSORBOARD_JOB_NAME = "job_name"
 TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
 
+# Unified telemetry subsystem (deepspeed_tpu/telemetry/,
+# docs/observability.md): metrics registry + exporters, config-driven
+# profiler windows, step-heartbeat watchdog. TPU-native addition — the
+# reference had only the rank-0 tensorboard block above.
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_ENABLED_DEFAULT = False
+TELEMETRY_OUTPUT_PATH = "output_path"
+TELEMETRY_OUTPUT_PATH_DEFAULT = ""
+TELEMETRY_JOB_NAME = "job_name"
+TELEMETRY_JOB_NAME_DEFAULT = "DeepSpeedJobName"
+# Export (and device-value materialization — one host sync) cadence, in
+# accumulation windows. Raise it on remote-tunneled platforms where a
+# per-window sync would throttle the async loop.
+TELEMETRY_INTERVAL = "interval"
+TELEMETRY_INTERVAL_DEFAULT = 1
+TELEMETRY_EXPORTERS = "exporters"
+TELEMETRY_EXPORTERS_DEFAULT = ("jsonl", "prometheus")
+TELEMETRY_VALID_EXPORTERS = ("jsonl", "prometheus", "tensorboard")
+# Prometheus textfile destination; "" => <output_path>/<job_name>/metrics.prom
+TELEMETRY_PROMETHEUS_PATH = "prometheus_path"
+TELEMETRY_PROMETHEUS_PATH_DEFAULT = ""
+
+# Profiler window sub-block: {"profile": {"start_step": N, "num_steps": M}}
+# arms an automatic jax.profiler trace over windows [N, N+M) — the
+# config-driven replacement for manual start_profile()/stop_profile().
+# start_step -1 (default) leaves profiling off.
+TELEMETRY_PROFILE = "profile"
+TELEMETRY_PROFILE_START_STEP = "start_step"
+TELEMETRY_PROFILE_START_STEP_DEFAULT = -1
+TELEMETRY_PROFILE_NUM_STEPS = "num_steps"
+TELEMETRY_PROFILE_NUM_STEPS_DEFAULT = 3
+TELEMETRY_PROFILE_OUTPUT_PATH = "output_path"
+TELEMETRY_PROFILE_OUTPUT_PATH_DEFAULT = ""
+
+# Step-heartbeat watchdog sub-block: fires a rank-tagged stall report when
+# no accumulation window completes within `timeout` seconds. On (with the
+# telemetry block) by default — liveness is the block's reason to exist.
+TELEMETRY_WATCHDOG = "watchdog"
+TELEMETRY_WATCHDOG_ENABLED = "enabled"
+TELEMETRY_WATCHDOG_ENABLED_DEFAULT = True
+TELEMETRY_WATCHDOG_TIMEOUT = "timeout"
+TELEMETRY_WATCHDOG_TIMEOUT_DEFAULT = 600.0
+TELEMETRY_WATCHDOG_POLL_INTERVAL = "poll_interval"
+TELEMETRY_WATCHDOG_POLL_INTERVAL_DEFAULT = None  # => timeout / 4
+
 #############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,
 # which delegated model parallelism to an external mpu object)

@@ -46,6 +46,7 @@ class DeepSpeedDataLoader:
         drop_last=True,
         tput_timer=None,
         prefetch=2,
+        telemetry=None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -56,6 +57,11 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.tput_timer = tput_timer
         self.prefetch = prefetch
+        # telemetry (engine's Telemetry facade): the dataloader/queue_depth
+        # gauge reads the prefetch queue at each batch handoff — a queue
+        # pinned at 0 means the host data path, not the device, bounds
+        # throughput
+        self.telemetry = telemetry
         self._epoch = 0
 
         import jax
@@ -188,6 +194,8 @@ class DeepSpeedDataLoader:
                         continue
                     except StopIteration:
                         break
+                    if self.telemetry is not None:
+                        self.telemetry.set_dataloader_depth(q.qsize())
                     yield self._place(batch)
             finally:
                 q.stop()

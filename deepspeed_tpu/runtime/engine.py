@@ -243,6 +243,12 @@ class DeepSpeedEngine:
         params_f32 = jax.tree_util.tree_map(
             lambda p: jnp.array(p, dtype=jnp.float32, copy=True), model_parameters
         )
+        # parameter count feeds telemetry's model-TFLOPS gauge (bench.py's
+        # 6*N-per-token accounting)
+        self._n_params = sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(params_f32)
+        )
         # int8 moments store FLAT dp-sharded {'q','scale'} leaves: leading-
         # dim specs keep the flat<->shaped reshapes in the update layout-
         # trivial (zero.py module docstring); fp32/bf16 state keeps the
@@ -449,6 +455,25 @@ class DeepSpeedEngine:
             # drain via a REAL output of the newest update program — a
             # generic fence program is not ordered behind compute on
             # remote-tunneled platforms (see utils/timers._device_sync)
+            fence_fn=lambda: jax.block_until_ready(
+                jax.tree_util.tree_leaves(self.optimizer_state)[0]
+            ),
+        )
+
+        # ---- telemetry (docs/observability.md) ------------------------
+        # Registry + exporters + config-armed profiler window + heartbeat
+        # watchdog. A no-op facade when the "telemetry" block is absent, so
+        # the async fast path never touches a device value for it.
+        from ..telemetry import build_telemetry
+
+        self.telemetry = build_telemetry(
+            self.config,
+            rank=jax.process_index(),
+            n_params=self._n_params,
+            timers=self.timers,
+            # trace/stall fences block on a REAL output of the newest
+            # update program (see utils/timers._device_sync for why a
+            # generic fence program is not enough)
             fence_fn=lambda: jax.block_until_ready(
                 jax.tree_util.tree_leaves(self.optimizer_state)[0]
             ),
@@ -1056,6 +1081,20 @@ class DeepSpeedEngine:
                 "accumulation window (gradients already folded into the "
                 "buffer)"
             )
+        if self._training and self.telemetry.enabled:
+            # every micro-step is liveness, not just window completion: a
+            # deep accumulation window (or one slow-host micro-step) can
+            # legitimately outlast the watchdog timeout end-to-end, and
+            # only on_window_end beats
+            self.telemetry.heartbeat()
+            if self.micro_steps % self.gradient_accumulation_steps() == 0:
+                # first micro-step of a new accumulation window
+                self.telemetry.on_window_start()
+            self.telemetry.count_batch(*self._batch_tokens(inputs))
+        elif not self._training:
+            # eval forwards are liveness, not windows: without this an
+            # eval epoch longer than the watchdog timeout reads as a stall
+            self.telemetry.heartbeat()
         if self.wall_clock_breakdown:
             self.timers(FORWARD_TIMER).start()
         batch = self._shard_batch(inputs)
@@ -1093,6 +1132,29 @@ class DeepSpeedEngine:
         return loss
 
     __call__ = forward
+
+    @staticmethod
+    def _batch_tokens(inputs):
+        """(tokens, samples) of one micro-batch from its first array leaf:
+        rows are samples; rows x dim-1 extent are tokens ONLY for 2-d
+        INTEGER leaves (the (batch, seq) id/label layout of LM batches).
+        Float feature matrices, images, and other non-id inputs count
+        tokens == samples — calling the feature dim of a (B, 512) dense
+        batch or dim-1 of a (B, H, W, C) image "sequence length" would
+        inflate the tokens/sec and model-TFLOPS gauges by that factor."""
+        for leaf in jax.tree_util.tree_leaves(inputs):
+            shape = getattr(leaf, "shape", None)
+            if shape:
+                samples = int(shape[0])
+                dtype = getattr(leaf, "dtype", None)
+                is_token_ids = (
+                    len(shape) == 2
+                    and dtype is not None
+                    and np.issubdtype(dtype, np.integer)
+                )
+                tokens = samples * int(shape[1]) if is_token_ids else samples
+                return tokens, samples
+        return 0, 0
 
     def backward(self, loss, allreduce_gradients=True):
         """Accumulate the gradients stashed by forward (reference contract:
@@ -1320,6 +1382,18 @@ class DeepSpeedEngine:
                 ),
                 self.global_steps,
             )
+        if self.telemetry.enabled:
+            # raw device values go in; the manager materializes them (one
+            # host sync) only at export boundaries (telemetry.interval)
+            self.telemetry.on_window_end(
+                loss=window_loss,
+                grad_norm=grad_norm,
+                loss_scale=self.loss_scale_state.loss_scale,
+                lr=self.get_lr()[0],
+                global_steps=self.global_steps,
+                skipped_steps=self.skipped_steps,
+                micro_steps=self.micro_steps,
+            )
         # settle overflow flags from windows BEFORE this one: their compute
         # has finished (or is about to — the current window is already
         # dispatched, so the device stays busy while we wait)
@@ -1347,6 +1421,7 @@ class DeepSpeedEngine:
         self._reconcile_deferred(keep_last=False)
         if self.monitor.enabled and getattr(self.monitor, "writer", None):
             self.monitor.writer.flush()
+        self.telemetry.flush()
 
     def _reconcile_deferred(self, keep_last=True):
         """Settle queued bf16/fp32 device-side overflow flags.
@@ -1446,6 +1521,10 @@ class DeepSpeedEngine:
                 return jnp.stack([jnp.asarray(x) for x in xs])
             return np.stack([np.asarray(x) for x in xs])
 
+        if self.telemetry.enabled:
+            self.telemetry.on_window_start()
+            for batch in batches:
+                self.telemetry.count_batch(*self._batch_tokens(batch))
         if self.wall_clock_breakdown:
             # whole-window wall clock (start() fences outstanding device
             # work); the async fast path is untouched when breakdown is off
@@ -1604,6 +1683,7 @@ class DeepSpeedEngine:
             collate_fn=self.collate_fn,
             shuffle=is_train,  # the reference's DistributedSampler shuffles
             tput_timer=self.tput_timer if is_train else None,
+            telemetry=self.telemetry if is_train else None,
         )
 
     # ------------------------------------------------------------------
@@ -1614,7 +1694,13 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def start_profile(self, log_dir="profile"):
         """Begin a ``jax.profiler`` trace; pair with :meth:`stop_profile`.
-        Typical use: profile 3-5 steady-state steps, not the compile."""
+        Typical use: profile 3-5 steady-state steps, not the compile.
+
+        The PRIMARY profiling path is the config-armed window — a
+        ``"telemetry": {"profile": {"start_step": N, "num_steps": M}}``
+        block traces automatically and wraps each window in
+        ``StepTraceAnnotation`` (docs/observability.md). These manual
+        methods remain for interactive sessions."""
         if getattr(self, "_profiling", False):
             return
         jax.profiler.start_trace(log_dir)
@@ -1639,7 +1725,11 @@ class DeepSpeedEngine:
         # persisted counters must be truthful: settle ALL in-flight
         # device-side skip flags, including the newest window's
         self._reconcile_deferred(keep_last=False)
-        return _save(self, save_dir, tag=tag, client_state=client_state or {})
+        # a large-model save can outlast the watchdog timeout; suspend
+        # stall detection for its whole duration, not just a beat around it
+        with self.telemetry.liveness_exempt():
+            result = _save(self, save_dir, tag=tag, client_state=client_state or {})
+        return result
 
     def load_checkpoint(
         self, load_dir, tag=None, load_module_strict=True,
@@ -1655,13 +1745,16 @@ class DeepSpeedEngine:
         stale_flags = self._deferred_overflows
         self._deferred_overflows = []
         try:
-            result = _load(
-                self,
-                load_dir,
-                tag=tag,
-                load_optimizer_states=load_optimizer_states,
-                load_lr_scheduler_states=load_lr_scheduler_states,
-            )
+            # like save_checkpoint: an in-training restore of a large model
+            # can outlast the watchdog timeout
+            with self.telemetry.liveness_exempt():
+                result = _load(
+                    self,
+                    load_dir,
+                    tag=tag,
+                    load_optimizer_states=load_optimizer_states,
+                    load_lr_scheduler_states=load_lr_scheduler_states,
+                )
         except Exception:
             # a load that raised mid-restore also leaves the old timeline
             # running — put its flags back before re-raising

@@ -1,0 +1,55 @@
+"""Unified telemetry subsystem (docs/observability.md).
+
+Three layers behind one config block:
+
+- **Metrics core** (registry.py, exporters.py): a process-local
+  ``MetricsRegistry`` of counters / gauges / fixed-bucket histograms, with
+  pluggable exporters — the pre-existing JSONL and TensorBoard writers
+  refitted as registry exporters, plus a Prometheus textfile exporter for
+  pod scrapers.
+- **Config-driven profiling** (profiling.py): an automatic ``jax.profiler``
+  trace window armed by step index, each traced window wrapped in
+  ``StepTraceAnnotation`` so the engine's ``named_scope`` phase labels are
+  navigable per step.
+- **Liveness** (watchdog.py): a step-heartbeat watchdog thread that logs a
+  rank-tagged stall report (timers, device memory, last metric values)
+  when no window completes within the configured timeout.
+
+``manager.build_telemetry`` wires all three from the engine's config.
+"""
+
+from .exporters import (
+    JsonlExporter,
+    MetricExporter,
+    PrometheusTextfileExporter,
+    SummaryWriterExporter,
+    prometheus_name,
+)
+from .manager import ENGINE_METRICS, Telemetry, build_telemetry
+from .profiling import ProfilerWindow
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    install_recompile_hook,
+)
+from .watchdog import StepHeartbeatWatchdog
+
+__all__ = [
+    "Counter",
+    "ENGINE_METRICS",
+    "Gauge",
+    "Histogram",
+    "JsonlExporter",
+    "MetricExporter",
+    "MetricsRegistry",
+    "PrometheusTextfileExporter",
+    "ProfilerWindow",
+    "StepHeartbeatWatchdog",
+    "SummaryWriterExporter",
+    "Telemetry",
+    "build_telemetry",
+    "install_recompile_hook",
+    "prometheus_name",
+]

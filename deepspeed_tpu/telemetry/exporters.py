@@ -1,0 +1,172 @@
+"""Pluggable exporters: serialize MetricsRegistry views to scalar sinks.
+
+The pre-telemetry writers are refitted here rather than reimplemented:
+``JsonlExporter`` writes through ``utils.monitor.JsonlSummaryWriter`` (one
+RFC-compliant JSON object per line) and ``SummaryWriterExporter`` through
+``utils.monitor.get_summary_writer`` (torch TensorBoard when importable,
+JSONL fallback otherwise). ``PrometheusTextfileExporter`` is new: it
+rewrites a textfile atomically on every export, the contract of the
+node-exporter textfile collector pod scrapers mount.
+"""
+
+import math
+import os
+import re
+import time
+
+from ..utils.logging import warn_once
+
+
+class MetricExporter:
+    """One exporter = one sink. ``export`` receives the registry's
+    ``collect()`` list plus the step index the values settle at."""
+
+    def export(self, metrics, step):
+        raise NotImplementedError
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class JsonlExporter(MetricExporter):
+    """Registry -> ``metrics.jsonl``: counters/gauges as the writer's
+    standard ``{tag, value, step, wall_time}`` records, histograms as one
+    ``kind: "histogram"`` record carrying thresholds and counts."""
+
+    def __init__(self, log_dir, filename="metrics.jsonl"):
+        from ..utils.monitor import JsonlSummaryWriter
+
+        self.writer = JsonlSummaryWriter(log_dir, filename=filename)
+
+    def export(self, metrics, step):
+        now = time.time()
+        for m in metrics:
+            if m.kind == "histogram":
+                self.writer.add_record(
+                    {
+                        "tag": m.name,
+                        "kind": "histogram",
+                        "count": m.count,
+                        "sum": m.sum,
+                        "thresholds": list(m.thresholds),
+                        "bucket_counts": list(m.bucket_counts),
+                        "step": step,
+                        "wall_time": now,
+                    }
+                )
+            else:
+                self.writer.add_scalar(m.name, m.value, global_step=step)
+        self.writer.flush()
+
+    def flush(self):
+        self.writer.flush()
+
+    def close(self):
+        self.writer.close()
+
+
+class SummaryWriterExporter(MetricExporter):
+    """Registry -> TensorBoard scalar streams (torch SummaryWriter when
+    available, events.jsonl fallback). Histograms export as ``name/count``
+    and ``name/sum`` scalars — the navigable trend of a histogram without
+    requiring torch's histogram protos."""
+
+    def __init__(self, log_dir=None, job_name="DeepSpeedJobName", writer=None):
+        if writer is None:
+            from ..utils.monitor import get_summary_writer
+
+            writer = get_summary_writer(name=job_name, base=log_dir)
+        self.writer = writer
+
+    def export(self, metrics, step):
+        for m in metrics:
+            if m.kind == "histogram":
+                self.writer.add_scalar(m.name + "/count", m.count, global_step=step)
+                self.writer.add_scalar(m.name + "/sum", m.sum, global_step=step)
+            else:
+                self.writer.add_scalar(m.name, m.value, global_step=step)
+        self.writer.flush()
+
+    def flush(self):
+        self.writer.flush()
+
+    def close(self):
+        self.writer.close()
+
+
+def prometheus_name(name):
+    """Sanitize a registry name into the Prometheus charset
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``): ``train/loss`` -> ``train_loss``."""
+    sanitized = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if re.match(r"^[0-9]", sanitized):
+        sanitized = "_" + sanitized
+    return sanitized
+
+
+def _format_value(v):
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+class PrometheusTextfileExporter(MetricExporter):
+    """Registry -> Prometheus text exposition format, rewritten atomically
+    (write-temp-then-rename) so a scraper never reads a torn file. Point
+    the node-exporter textfile collector (or any sidecar that serves
+    ``*.prom`` files) at the directory."""
+
+    def __init__(self, path):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def export(self, metrics, step):
+        del step  # prometheus samples carry scrape time, not step indices
+        lines = []
+        for m in metrics:
+            name = prometheus_name(m.name)
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if m.kind == "histogram":
+                cumulative = 0
+                for threshold, count in zip(m.thresholds, m.bucket_counts):
+                    cumulative += count
+                    lines.append(
+                        f'{name}_bucket{{le="{_format_value(threshold)}"}} '
+                        f"{cumulative}"
+                    )
+                lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{name}_sum {_format_value(m.sum)}")
+                lines.append(f"{name}_count {m.count}")
+            else:
+                lines.append(f"{name} {_format_value(m.value)}")
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write("\n".join(lines) + "\n")
+            os.replace(tmp, self.path)
+        except OSError as e:
+            warn_once(
+                ("prom_unwritable", self.path),
+                "prometheus textfile %s not writable (%s); further export "
+                "failures are silent", self.path, e,
+            )
+
+
+def build_exporter(name, out_dir, job_name, prometheus_path=None):
+    """Exporter factory for the config-named kinds."""
+    if name == "jsonl":
+        return JsonlExporter(out_dir)
+    if name == "tensorboard":
+        return SummaryWriterExporter(log_dir=os.path.dirname(out_dir) or ".",
+                                     job_name=job_name)
+    if name == "prometheus":
+        return PrometheusTextfileExporter(
+            prometheus_path or os.path.join(out_dir, "metrics.prom")
+        )
+    raise ValueError(f"unknown telemetry exporter {name!r}")

@@ -1,0 +1,408 @@
+"""Engine-facing telemetry facade: registry + exporters + profiler + watchdog.
+
+One ``Telemetry`` instance per engine, built from the config's
+``"telemetry"`` block by :func:`build_telemetry`. The engine calls three
+hooks — ``on_window_start`` at each accumulation window's first dispatch,
+``on_window_end`` after the update is dispatched, ``set_dataloader_depth``
+from the loader — and everything else (metric materialization cadence,
+export fan-out, profiler window arming, heartbeats) happens here.
+
+Async-dispatch discipline: ``on_window_end`` receives loss / grad-norm /
+loss-scale as RAW device values and only materializes them (one host sync)
+every ``interval`` windows, at the export boundary. With telemetry
+disabled no hook touches a device value, so the engine's async fast path
+is unchanged; with it enabled, the sync cost is one blocked float per
+export — size ``interval`` accordingly on remote-tunneled platforms.
+"""
+
+import atexit
+import contextlib
+import os
+import time
+import weakref
+
+from ..utils.logging import logger, warn_once
+from .exporters import build_exporter
+from .profiling import ProfilerWindow
+from .registry import (
+    DEFAULT_TIME_BUCKETS_MS,
+    MetricsRegistry,
+    install_recompile_hook,
+)
+from .watchdog import StepHeartbeatWatchdog
+
+# The engine's metric catalog (docs/observability.md documents each).
+# Instruments are pre-registered at construction so every export carries
+# the full golden set — an absent stream means a broken emitter, not an
+# idle one, and tests pin exactly this list.
+ENGINE_METRICS = (
+    ("gauge", "train/loss", "mean unscaled loss of the last settled window"),
+    ("gauge", "train/learning_rate", "learning rate applied to the last window"),
+    ("gauge", "train/loss_scale", "dynamic loss scale (fp16) or 1.0"),
+    ("gauge", "train/grad_norm", "post-unscale global gradient norm"),
+    ("gauge", "train/tokens_per_sec", "tokens consumed per second over the last export interval"),
+    ("gauge", "train/samples_per_sec", "samples consumed per second over the last export interval"),
+    ("gauge", "train/model_tflops", "model TFLOPS (6*N*tokens/sec) over the last export interval"),
+    # gauges, not counters: these mirror engine counts that are revised
+    # DOWNWARD — deferred-overflow reconciliation decrements global_steps
+    # one window late and an in-process load_checkpoint rolls all three
+    # back. A Prometheus counter that decreases reads as a reset-to-zero,
+    # so rate() would extrapolate a huge spike on every reconciliation.
+    ("gauge", "train/global_steps", "optimizer updates applied"),
+    ("gauge", "train/skipped_steps", "windows skipped by overflow/non-finite grad norm"),
+    ("gauge", "train/micro_steps", "micro-steps (forward+backward) run"),
+    ("counter", "jax/recompiles", "XLA backend compiles (growth after warmup = recompile storm)"),
+    ("gauge", "device/bytes_in_use", "device HBM bytes in use (0 when the platform reports none)"),
+    ("gauge", "device/peak_bytes_in_use", "peak device HBM bytes in use"),
+    ("gauge", "dataloader/queue_depth", "prefetch queue depth at the last batch handoff"),
+    ("histogram", "train/window_time_ms", "host wall time per accumulation window"),
+)
+
+
+class Telemetry:
+    def __init__(
+        self,
+        enabled=False,
+        exporters=(),
+        interval=1,
+        n_params=0,
+        profiler=None,
+        watchdog=None,
+        registry=None,
+    ):
+        self.enabled = enabled
+        self.registry = registry or MetricsRegistry()
+        self.exporters = list(exporters)
+        self.interval = max(1, int(interval))
+        self.n_params = int(n_params)
+        self.profiler = profiler
+        self.watchdog = watchdog
+        self._windows_ended = 0
+        self._windows_since_export = 0
+        self._pending_values = None
+        self._window_start = None
+        self._last_export_time = None
+        self._tokens_since_export = 0
+        self._samples_since_export = 0
+        if not enabled:
+            return
+        for kind, name, help_text in ENGINE_METRICS:
+            getattr(self.registry, kind)(name, help=help_text)
+        install_recompile_hook(self.registry.counter("jax/recompiles"))
+        if self.watchdog is not None:
+            self.watchdog.start()
+            # the polling thread keeps the watchdog itself alive, so a
+            # dropped engine (retry loop, notebook rebuild) would leak the
+            # thread and fire a spurious stall report ~timeout later;
+            # stop it as soon as this facade is collected (the bound
+            # method references the watchdog, not self — no self-cycle)
+            weakref.finalize(self, self.watchdog.stop)
+        # Close at interpreter exit (weakly — engines created and dropped
+        # in tests are not kept alive): stops the watchdog, terminates a
+        # still-open trace window, and flushes/closes the sinks for jobs
+        # that never call close() themselves. close() flips enabled off,
+        # so an explicit close makes this a no-op.
+        ref = weakref.ref(self)
+
+        def _close_at_exit():
+            t = ref()
+            if t is not None and t.enabled:
+                try:
+                    t.close()
+                except Exception:
+                    pass
+
+        # kept so close() can unregister: a sweep/notebook that builds N
+        # engines in one process must not accumulate N dead callbacks
+        self._atexit_cb = _close_at_exit
+        atexit.register(_close_at_exit)
+
+    # -- engine hooks ---------------------------------------------------
+    def on_window_start(self):
+        if not self.enabled:
+            return
+        if self.profiler is not None:
+            self.profiler.on_window_start()
+        self._window_start = time.time()
+
+    def count_batch(self, tokens, samples):
+        if not self.enabled:
+            return
+        self._tokens_since_export += int(tokens)
+        self._samples_since_export += int(samples)
+
+    def on_window_end(
+        self,
+        loss=None,
+        grad_norm=None,
+        loss_scale=None,
+        lr=None,
+        global_steps=0,
+        skipped_steps=0,
+        micro_steps=0,
+    ):
+        """Window bookkeeping; ``loss``/``grad_norm``/``loss_scale`` may be
+        raw device arrays — they are only materialized at export
+        boundaries (see module docstring)."""
+        if not self.enabled:
+            return
+        if self.profiler is not None:
+            self.profiler.on_window_end()
+        now = time.time()
+        # true window duration (first dispatch -> update dispatched), not
+        # the end-to-end gap: the gap also counts dataloader wait and eval
+        # phases between windows, which would poison the histogram
+        if self._window_start is not None:
+            self.registry.histogram(
+                "train/window_time_ms", buckets=DEFAULT_TIME_BUCKETS_MS
+            ).observe((now - self._window_start) * 1000.0)
+            self._window_start = None
+        self._windows_ended += 1
+        if self.watchdog is not None:
+            self.watchdog.beat(step=self._windows_ended)
+        self.registry.gauge("train/global_steps").set(global_steps)
+        self.registry.gauge("train/skipped_steps").set(skipped_steps)
+        self.registry.gauge("train/micro_steps").set(micro_steps)
+        self._windows_since_export += 1
+        if self._windows_since_export >= self.interval:
+            self._materialize(loss, grad_norm, loss_scale, lr, now)
+            self.export(step=global_steps)
+            self._windows_since_export = 0
+            self._pending_values = None
+        else:
+            # raw device refs only (no host sync): flush() settles these
+            # so the trailing windows % interval are not lost when the
+            # run ends between export boundaries
+            self._pending_values = (loss, grad_norm, loss_scale, lr,
+                                    global_steps)
+
+    def heartbeat(self):
+        """Non-window liveness beat: eval forwards call this so a long
+        eval epoch is not read as a stall. Does not advance the
+        last-completed-window index in stall reports."""
+        if self.enabled and self.watchdog is not None:
+            self.watchdog.beat()
+
+    @contextlib.contextmanager
+    def liveness_exempt(self):
+        """Suspend stall detection for a phase with no step cadence of its
+        own — a checkpoint save can legitimately outlast the watchdog
+        timeout, and a single beat before/after it would not keep a
+        LONGER-than-timeout save from firing a false stall mid-phase.
+        The stall clock restarts when the phase exits."""
+        if self.enabled and self.watchdog is not None:
+            self.watchdog.pause()
+            try:
+                yield
+            finally:
+                self.watchdog.resume()
+        else:
+            yield
+
+    def set_dataloader_depth(self, depth):
+        if not self.enabled:
+            return
+        self.registry.gauge("dataloader/queue_depth").set(depth)
+
+    # -- internals ------------------------------------------------------
+    def _materialize(self, loss, grad_norm, loss_scale, lr, now):
+        """Resolve device values and derived rates into gauges. The
+        float() calls below are the subsystem's only host syncs."""
+        reg = self.registry
+        if loss is not None:
+            reg.gauge("train/loss").set(float(loss))
+        if grad_norm is not None:
+            gn = float(grad_norm)
+            # -1.0 is the engine's non-finite sentinel (skipped update);
+            # a skipped window keeps the previous finite norm on the gauge
+            if gn >= 0.0:
+                reg.gauge("train/grad_norm").set(gn)
+        if loss_scale is not None:
+            reg.gauge("train/loss_scale").set(float(loss_scale))
+        if lr is not None:
+            reg.gauge("train/learning_rate").set(float(lr))
+        if self._last_export_time is not None:
+            elapsed = now - self._last_export_time
+            if elapsed > 0:
+                tps = self._tokens_since_export / elapsed
+                reg.gauge("train/tokens_per_sec").set(tps)
+                reg.gauge("train/samples_per_sec").set(
+                    self._samples_since_export / elapsed
+                )
+                # bench.py's model-flops accounting: 6*N per token
+                # (fwd 2N + bwd 4N), the measured-throughput MFU numerator
+                reg.gauge("train/model_tflops").set(
+                    6.0 * self.n_params * tps / 1e12
+                )
+        self._last_export_time = now
+        self._tokens_since_export = 0
+        self._samples_since_export = 0
+        self._set_memory_gauges()
+
+    def _set_memory_gauges(self):
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            return  # gauges stay 0 (CPU backends report no memory_stats)
+        self.registry.gauge("device/bytes_in_use").set(
+            stats.get("bytes_in_use", 0)
+        )
+        self.registry.gauge("device/peak_bytes_in_use").set(
+            stats.get("peak_bytes_in_use", 0)
+        )
+
+    def export(self, step=None):
+        if not self.enabled:
+            return
+        metrics = self.registry.collect()
+        for exporter in self.exporters:
+            try:
+                exporter.export(metrics, step)
+            except Exception as e:
+                # once per exporter: a full disk fails EVERY export and
+                # would bury the log at the default interval=1 cadence
+                warn_once(
+                    f"telemetry-exporter-{type(exporter).__name__}",
+                    "telemetry exporter %s failed: %s",
+                    type(exporter).__name__, e,
+                )
+
+    def flush(self):
+        """Settle and export any windows past the last export boundary
+        (one host sync), then flush the sinks — without this a run ending
+        mid-interval would record state stale by up to interval-1
+        windows."""
+        if self.enabled and self._pending_values is not None:
+            loss, grad_norm, loss_scale, lr, global_steps = (
+                self._pending_values
+            )
+            self._materialize(loss, grad_norm, loss_scale, lr, time.time())
+            self.export(step=global_steps)
+            self._windows_since_export = 0
+            self._pending_values = None
+        for exporter in self.exporters:
+            try:
+                exporter.flush()
+            except Exception:
+                pass
+
+    def close(self):
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.profiler is not None:
+            self.profiler.close()
+        self.flush()
+        for exporter in self.exporters:
+            try:
+                exporter.close()
+            except Exception:
+                pass
+        self.enabled = False
+        cb = getattr(self, "_atexit_cb", None)
+        if cb is not None:
+            atexit.unregister(cb)
+            self._atexit_cb = None
+
+
+def build_telemetry(config, rank=0, n_params=0, timers=None, fence_fn=None):
+    """Construct the engine's Telemetry from a validated DeepSpeedConfig.
+
+    Rank policy: jsonl/tensorboard exporters and the profiler trace run on
+    process 0 only (the reference's tensorboard convention); the
+    Prometheus textfile is written by EVERY process (pod scrapers are
+    per-host — the filename gains a ``.rank{N}`` suffix on multi-process
+    meshes) and the watchdog runs everywhere, because the stalled rank is
+    exactly the one rank-0 gating would silence.
+    """
+    if not getattr(config, "telemetry_enabled", False):
+        return Telemetry(enabled=False)
+
+    base = config.telemetry_output_path or os.path.join(
+        os.path.expanduser("~"), "telemetry"
+    )
+    out_dir = os.path.join(base, config.telemetry_job_name)
+    os.makedirs(out_dir, exist_ok=True)
+
+    import jax
+
+    process_count = jax.process_count()
+    prometheus_path = config.telemetry_prometheus_path or os.path.join(
+        out_dir, "metrics.prom"
+    )
+    if process_count > 1:
+        # rank goes BEFORE the extension: textfile collectors glob
+        # '*.prom', so 'metrics.prom.rank1' would never be scraped
+        root, ext = os.path.splitext(prometheus_path)
+        prometheus_path = f"{root}.rank{rank}{ext}"
+
+    if (
+        "tensorboard" in config.telemetry_exporters
+        and getattr(config, "tensorboard_enabled", False)
+        and rank == 0
+    ):
+        # both sinks are legitimate alone: the legacy block writes exact
+        # per-step Train/* curves (overflow-settled indices), the exporter
+        # samples registry gauges at the export cadence. Together they put
+        # two near-duplicate stream families in tensorboard — flag it.
+        logger.warning(
+            "both the 'tensorboard' config block and the telemetry "
+            "'tensorboard' exporter are enabled: expect duplicate "
+            "Train/* (per-step) and train/* (sampled) scalar streams"
+        )
+
+    exporters = []
+    for name in config.telemetry_exporters:
+        if name != "prometheus" and rank != 0:
+            continue
+        exporters.append(
+            build_exporter(
+                name, out_dir, config.telemetry_job_name,
+                prometheus_path=prometheus_path,
+            )
+        )
+
+    profiler = None
+    if config.telemetry_profile_start_step >= 0 and rank == 0:
+        profiler = ProfilerWindow(
+            start_step=config.telemetry_profile_start_step,
+            num_steps=config.telemetry_profile_num_steps,
+            output_path=config.telemetry_profile_output_path
+            or os.path.join(out_dir, "profile"),
+            fence=fence_fn,
+        )
+
+    registry = MetricsRegistry()
+    watchdog = None
+    if config.telemetry_watchdog_enabled:
+        from ..utils.timers import SynchronizedWallClockTimer
+
+        def _stall_context():
+            context = {
+                "device_memory": SynchronizedWallClockTimer.memory_usage(),
+                "metrics": registry.snapshot(),
+            }
+            if timers is not None:
+                context["timers_s"] = {
+                    k: round(v, 3) for k, v in timers.snapshot().items()
+                }
+            return context
+
+        watchdog = StepHeartbeatWatchdog(
+            timeout=config.telemetry_watchdog_timeout,
+            poll_interval=config.telemetry_watchdog_poll_interval,
+            context_fn=_stall_context,
+        )
+
+    return Telemetry(
+        enabled=True,
+        exporters=exporters,
+        interval=config.telemetry_interval,
+        n_params=n_params,
+        profiler=profiler,
+        watchdog=watchdog,
+        registry=registry,
+    )

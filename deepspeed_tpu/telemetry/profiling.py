@@ -1,0 +1,109 @@
+"""Config-driven profiler windows.
+
+Arms an automatic ``jax.profiler`` trace: the trace starts when training
+reaches window ``start_step`` (0-based, counted in accumulation windows)
+and stops after ``num_steps`` windows. Every traced window is wrapped in
+``jax.profiler.StepTraceAnnotation``, so the engine's ``named_scope``
+phase labels (``window_fwd_bwd`` / ``window_optimizer_update``) land under
+a navigable per-step hierarchy in TensorBoard's trace viewer / Perfetto.
+
+This replaces the manual ``engine.start_profile()`` / ``stop_profile()``
+pairing as the primary path — the JSON config decides the window, so a
+production job profiles its steady state without code changes. The manual
+methods remain for interactive use.
+"""
+
+from ..utils.logging import log_dist
+
+
+class ProfilerWindow:
+    """Step-counted trace window around the engine's accumulation windows.
+
+    ``fence`` is called before the trace stops: profiling a window is only
+    truthful if the dispatched device work it covers has landed, and on an
+    async TPU stream that requires blocking on a real output of the traced
+    programs (the engine passes a block-on-optimizer-state fence).
+    """
+
+    def __init__(self, start_step, num_steps, output_path, fence=None,
+                 enabled=True):
+        self.start_step = int(start_step)
+        self.num_steps = int(num_steps)
+        self.output_path = output_path
+        self.fence = fence
+        self.enabled = enabled and self.start_step >= 0 and self.num_steps > 0
+        self._window_index = 0  # windows BEGUN so far
+        self._tracing = False
+        self._in_window = False
+        self._annotation = None
+
+    @property
+    def tracing(self):
+        return self._tracing
+
+    def on_window_start(self):
+        """Call when an accumulation window begins (first micro-step's
+        forward, or train_batch dispatch). Idempotent within a window."""
+        if not self.enabled or self._in_window:
+            return
+        self._in_window = True
+        if not self._tracing and self._window_index == self.start_step:
+            import jax
+
+            jax.profiler.start_trace(self.output_path)
+            self._tracing = True
+            log_dist(
+                f"telemetry profiler: trace window armed at step "
+                f"{self._window_index} for {self.num_steps} step(s) -> "
+                f"{self.output_path}",
+                ranks=[0],
+            )
+        if self._tracing:
+            import jax
+
+            self._annotation = jax.profiler.StepTraceAnnotation(
+                "train_window", step_num=self._window_index
+            )
+            self._annotation.__enter__()
+
+    def on_window_end(self):
+        """Call when the window's update has been dispatched
+        (``_finish_step``). Stops the trace once the window count is
+        exhausted."""
+        if not self.enabled or not self._in_window:
+            return
+        self._in_window = False
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
+        self._window_index += 1
+        if (
+            self._tracing
+            and self._window_index >= self.start_step + self.num_steps
+        ):
+            self._stop()
+
+    def _stop(self):
+        import jax
+
+        if self.fence is not None:
+            try:
+                self.fence()
+            except Exception:
+                pass
+        jax.effects_barrier()
+        jax.profiler.stop_trace()
+        self._tracing = False
+        self.enabled = False  # one window per run; re-arm via a new config
+        log_dist(
+            f"telemetry profiler: trace window complete -> "
+            f"{self.output_path}",
+            ranks=[0],
+        )
+
+    def close(self):
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
+        if self._tracing:
+            self._stop()

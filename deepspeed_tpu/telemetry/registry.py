@@ -1,0 +1,210 @@
+"""Process-local metrics core: counters, gauges, fixed-bucket histograms.
+
+The registry is the single source of truth for every telemetry stream the
+engine emits; exporters (exporters.py) serialize point-in-time views of it.
+Prometheus's data-model conventions are followed (monotonic counters,
+cumulative histogram buckets with a +Inf catch-all) so the textfile
+exporter is a direct mapping, but nothing here imports a metrics client —
+the registry is a few dicts behind one lock, cheap enough to update from
+the training loop's host thread and safe to snapshot from the watchdog
+thread.
+
+Metric names use ``component/metric_name`` form (e.g. ``train/loss``);
+exporters that need a flat charset (Prometheus) sanitize on their side.
+"""
+
+import threading
+import weakref
+
+from ..utils.logging import logger
+
+# Default histogram thresholds for per-window wall times, in milliseconds.
+# Spans sub-10ms fused CPU windows to the minute-scale compiles that
+# precede step 1; +Inf is implicit.
+DEFAULT_TIME_BUCKETS_MS = (
+    5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
+)
+
+
+class Counter:
+    """Monotonically non-decreasing count."""
+
+    kind = "counter"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def inc(self, n=1.0):
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {n})")
+        self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value; may move in either direction."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def set(self, value):
+        self._value = float(value)
+
+    def inc(self, n=1.0):
+        self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram: per-bucket counts plus sum/count.
+
+    ``buckets`` are upper-bound thresholds (ascending); an implicit +Inf
+    bucket catches everything above the last threshold. ``bucket_counts``
+    are NON-cumulative per-bucket counts; exporters compute the cumulative
+    form Prometheus wants.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, buckets, help=""):
+        thresholds = tuple(float(b) for b in buckets)
+        if not thresholds or list(thresholds) != sorted(thresholds):
+            raise ValueError(
+                f"histogram {name} buckets must be non-empty ascending, "
+                f"got {buckets!r}"
+            )
+        self.name = name
+        self.help = help
+        self.thresholds = thresholds
+        self._counts = [0] * (len(thresholds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value):
+        v = float(value)
+        self._sum += v
+        self._count += 1
+        for i, t in enumerate(self.thresholds):
+            if v <= t:
+                self._counts[i] += 1
+                return
+        self._counts[-1] += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    @property
+    def bucket_counts(self):
+        return tuple(self._counts)
+
+
+class MetricsRegistry:
+    """Thread-safe get-or-create registry of the three instrument kinds."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get_or_create(self, cls, name, **kwargs):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}, requested {cls.kind}"
+                    )
+                return existing
+            metric = cls(name, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name, help=""):
+        return self._get_or_create(Counter, name, help=help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(Gauge, name, help=help)
+
+    def histogram(self, name, buckets=DEFAULT_TIME_BUCKETS_MS, help=""):
+        return self._get_or_create(Histogram, name, buckets=buckets, help=help)
+
+    def collect(self):
+        """Consistent point-in-time list of live metric objects, sorted by
+        name (exporters iterate this under no lock — instruments are only
+        ever mutated by simple attribute writes, and a slightly torn
+        histogram view is acceptable for monitoring output)."""
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def snapshot(self):
+        """Flat ``{name: value}`` scalar view (histograms contribute
+        ``name/count`` and ``name/sum``) — the watchdog's stall report and
+        tests read this."""
+        out = {}
+        for m in self.collect():
+            if m.kind == "histogram":
+                out[m.name + "/count"] = m.count
+                out[m.name + "/sum"] = m.sum
+            else:
+                out[m.name] = m.value
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Recompile accounting via jax.monitoring: one process-global listener feeds
+# every live registry counter (engines come and go in tests; the WeakSet
+# drops counters whose telemetry was garbage-collected).
+# ---------------------------------------------------------------------------
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_recompile_counters = weakref.WeakSet()
+_listener_installed = False
+
+
+def install_recompile_hook(counter):
+    """Count XLA backend compiles into ``counter``.
+
+    Every ``jax.jit`` cache miss ends in a backend compile, so after the
+    warmup windows this counter moving is the recompile-storm signal
+    (shape-polymorphic batches, dtype flips, donation mismatches). The
+    initial compiles land in it too — read it as a rate, not a level.
+    """
+    global _listener_installed
+    _recompile_counters.add(counter)
+    if _listener_installed:
+        return True
+    try:
+        from jax import monitoring as jax_monitoring
+
+        def _on_event_duration(event, duration, **kwargs):
+            del duration, kwargs
+            if event == BACKEND_COMPILE_EVENT:
+                for c in list(_recompile_counters):
+                    c.inc()
+
+        jax_monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+        _listener_installed = True
+        return True
+    except Exception as e:  # pragma: no cover - jax.monitoring is stable
+        logger.info("jax.monitoring unavailable; recompile counter off: %s", e)
+        return False

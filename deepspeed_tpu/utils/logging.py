@@ -46,3 +46,16 @@ def log_dist(message, ranks=None, level=logging.INFO):
     my_rank = _current_rank()
     if ranks is None or -1 in ranks or my_rank in ranks:
         logger.log(level, "[Rank %s] %s", my_rank, message)
+
+
+_warned_keys = set()
+
+
+def warn_once(key, message, *args):
+    """Emit a warning once per process per ``key`` — for conditions that
+    recur every step (an unwritable metrics sink, a platform without
+    memory stats) where repeating the line would bury the signal."""
+    if key in _warned_keys:
+        return
+    _warned_keys.add(key)
+    logger.warning(message, *args)

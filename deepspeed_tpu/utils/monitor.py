@@ -9,6 +9,7 @@ still record training curves.
 """
 
 import json
+import math
 import os
 import time
 
@@ -17,25 +18,33 @@ from .logging import logger
 
 class JsonlSummaryWriter:
     """Minimal SummaryWriter-compatible scalar sink: one JSON object per
-    line {tag, value, step, wall_time}."""
+    line {tag, value, step, wall_time}. Also the backing writer of the
+    telemetry JSONL exporter (telemetry/exporters.py)."""
 
-    def __init__(self, log_dir):
+    def __init__(self, log_dir, filename="events.jsonl"):
         os.makedirs(log_dir, exist_ok=True)
-        self._path = os.path.join(log_dir, "events.jsonl")
+        self._path = os.path.join(log_dir, filename)
         self._fd = open(self._path, "a")
 
     def add_scalar(self, tag, value, global_step=None):
-        self._fd.write(
-            json.dumps(
-                {
-                    "tag": tag,
-                    "value": float(value),
-                    "step": global_step,
-                    "wall_time": time.time(),
-                }
-            )
-            + "\n"
-        )
+        value = float(value)
+        record = {
+            "tag": tag,
+            "value": value,
+            "step": global_step,
+            "wall_time": time.time(),
+        }
+        if not math.isfinite(value):
+            # json.dumps would emit bare NaN/Infinity — valid Python, not
+            # RFC 8259 JSON, and strict downstream parsers choke on it.
+            # Non-finite scalars serialize as null with an explicit marker.
+            record["value"] = None
+            record["finite"] = False
+        self._fd.write(json.dumps(record, allow_nan=False) + "\n")
+
+    def add_record(self, record):
+        """Write one pre-built JSON object (telemetry histogram records)."""
+        self._fd.write(json.dumps(record, allow_nan=False) + "\n")
 
     def flush(self):
         self._fd.flush()

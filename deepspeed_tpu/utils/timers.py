@@ -93,6 +93,22 @@ class SynchronizedWallClockTimer:
     def has_timer(self, name):
         return name in self.timers
 
+    def snapshot(self):
+        """Non-destructive ``{name: elapsed_seconds}`` view including the
+        running portion of started timers. No device fence and no timer
+        state change — safe to call from another thread (the telemetry
+        watchdog reads this for stall reports)."""
+        now = time.time()
+        out = {}
+        # list(): the training thread may register a first-use timer while
+        # the watchdog thread iterates; a live dict view would raise
+        for name, timer in list(self.timers.items()):
+            elapsed = timer.elapsed_
+            if timer.started_:
+                elapsed += now - timer.start_time
+            out[name] = elapsed
+        return out
+
     def log(self, names, normalizer=1.0, reset=True, ranks=None):
         assert normalizer > 0.0
         string = "time (ms)"
@@ -184,13 +200,17 @@ class ThroughputTimer:
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
             if report_speed and self.local_step_count % self.steps_per_output == 0:
-                self.logging(
-                    "{}/{}, SamplesPerSec={:.3f}".format(
-                        self.epoch_count,
-                        self.local_step_count,
-                        self.avg_samples_per_sec(),
+                avg = self.avg_samples_per_sec()
+                if avg > 0:
+                    # pre-warmup (or zero-elapsed) windows have no
+                    # truthful rate yet — skip the line rather than log 0
+                    self.logging(
+                        "{}/{}, SamplesPerSec={:.3f}".format(
+                            self.epoch_count,
+                            self.local_step_count,
+                            avg,
+                        )
                     )
-                )
                 if self.monitor_memory:
                     try:
                         import psutil
@@ -207,4 +227,8 @@ class ThroughputTimer:
         if self.total_step_count > self.start_step and self.total_elapsed_time > 0:
             samples = self.batch_size * (self.total_step_count - self.start_step)
             return samples / self.total_elapsed_time
-        return float("-inf")
+        # Pre-warmup there is no measurement; the reference returned
+        # float("-inf") here, which leaked into logs and scalar sinks as a
+        # non-finite value. 0.0 is the no-data-yet sentinel (stop() skips
+        # the report line while it holds).
+        return 0.0

@@ -6,6 +6,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.utils.monitor import JsonlSummaryWriter, Monitor
@@ -23,6 +24,26 @@ def test_jsonl_writer_roundtrip(tmp_path):
     ]
     assert lines[0]["tag"] == "Train/loss" and lines[0]["value"] == 1.5
     assert lines[1]["step"] == 3
+
+
+def test_jsonl_writer_nonfinite_values_stay_rfc_json(tmp_path):
+    """json.dumps would emit bare NaN/Infinity (valid Python, not RFC 8259
+    JSON); non-finite scalars must serialize as null + finite:false so
+    strict downstream parsers survive a loss spike."""
+    w = JsonlSummaryWriter(str(tmp_path / "tb"))
+    w.add_scalar("Train/loss", float("nan"), global_step=1)
+    w.add_scalar("Train/grad_norm", float("inf"), global_step=1)
+    w.add_scalar("Train/lr", 0.5, global_step=1)
+    w.close()
+    raw = open(tmp_path / "tb" / "events.jsonl").read()
+    lines = [
+        # parse_constant trips on any bare NaN/Infinity token
+        json.loads(l, parse_constant=lambda s: pytest.fail(f"non-RFC: {s}"))
+        for l in raw.splitlines()
+    ]
+    assert lines[0]["value"] is None and lines[0]["finite"] is False
+    assert lines[1]["value"] is None and lines[1]["finite"] is False
+    assert lines[2]["value"] == 0.5 and "finite" not in lines[2]
 
 
 def test_monitor_disabled_is_noop():

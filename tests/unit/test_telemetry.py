@@ -1,0 +1,720 @@
+"""Telemetry subsystem: registry semantics, exporter round-trips, the
+engine's golden metric catalog, config-armed profiler windows, and the
+step-heartbeat watchdog (docs/observability.md)."""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.telemetry import (
+    ENGINE_METRICS,
+    JsonlExporter,
+    MetricsRegistry,
+    PrometheusTextfileExporter,
+    StepHeartbeatWatchdog,
+    SummaryWriterExporter,
+    Telemetry,
+    prometheus_name,
+)
+from deepspeed_tpu.utils.timers import ThroughputTimer
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("train/steps", help="h")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # get-or-create returns the same instrument
+    assert reg.counter("train/steps") is c
+
+
+def test_gauge_semantics():
+    reg = MetricsRegistry()
+    g = reg.gauge("mem/bytes")
+    g.set(10.0)
+    assert g.value == 10.0
+    g.set(4.0)  # gauges may decrease
+    assert g.value == 4.0
+    g.inc(1.5)
+    assert g.value == 5.5
+
+
+def test_histogram_semantics():
+    reg = MetricsRegistry()
+    h = reg.histogram("t/ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 5.0, 50.0, 5000.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(5060.5)
+    # per-bucket (non-cumulative) counts, +Inf last
+    assert h.bucket_counts == (1, 2, 1, 1)
+    with pytest.raises(ValueError):
+        reg.histogram("bad", buckets=(10.0, 1.0))  # not ascending
+
+
+def test_registry_kind_mismatch_raises():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_registry_snapshot_flattens_histograms():
+    reg = MetricsRegistry()
+    reg.counter("a").inc(2)
+    reg.histogram("h", buckets=(1.0,)).observe(0.5)
+    snap = reg.snapshot()
+    assert snap["a"] == 2
+    assert snap["h/count"] == 1
+    assert snap["h/sum"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# exporter round-trips
+# ---------------------------------------------------------------------------
+def _populated_registry():
+    reg = MetricsRegistry()
+    reg.counter("train/steps", help="steps").inc(4)
+    reg.gauge("train/loss", help="loss").set(1.25)
+    h = reg.histogram("train/window_time_ms", buckets=(10.0, 100.0))
+    h.observe(5.0)
+    h.observe(50.0)
+    h.observe(5000.0)
+    return reg
+
+
+def test_jsonl_exporter_reparse(tmp_path):
+    reg = _populated_registry()
+    exp = JsonlExporter(str(tmp_path))
+    exp.export(reg.collect(), step=7)
+    exp.close()
+    # every line must be strict RFC JSON (parse_constant trips on bare
+    # NaN/Infinity)
+    lines = [
+        json.loads(l, parse_constant=lambda s: pytest.fail(f"non-RFC: {s}"))
+        for l in open(tmp_path / "metrics.jsonl").read().splitlines()
+    ]
+    by_tag = {l["tag"]: l for l in lines}
+    assert by_tag["train/steps"]["value"] == 4
+    assert by_tag["train/loss"]["value"] == 1.25
+    assert by_tag["train/loss"]["step"] == 7
+    hist = by_tag["train/window_time_ms"]
+    assert hist["kind"] == "histogram"
+    assert hist["count"] == 3
+    assert hist["bucket_counts"] == [1, 1, 1]
+    assert hist["thresholds"] == [10.0, 100.0]
+
+
+def test_prometheus_textfile_format(tmp_path):
+    reg = _populated_registry()
+    path = str(tmp_path / "metrics.prom")
+    exp = PrometheusTextfileExporter(path)
+    exp.export(reg.collect(), step=7)
+    text = open(path).read()
+    assert "# TYPE train_steps counter" in text
+    assert "train_steps 4.0" in text
+    assert "# TYPE train_loss gauge" in text
+    assert "train_loss 1.25" in text
+    # histogram: cumulative buckets, +Inf catch-all equals _count
+    assert '# TYPE train_window_time_ms histogram' in text
+    assert 'train_window_time_ms_bucket{le="10.0"} 1' in text
+    assert 'train_window_time_ms_bucket{le="100.0"} 2' in text
+    assert 'train_window_time_ms_bucket{le="+Inf"} 3' in text
+    assert "train_window_time_ms_count 3" in text
+    # atomic write: no temp file left behind
+    assert not os.path.exists(path + ".tmp")
+    # re-export overwrites (textfile collector contract), never appends
+    exp.export(reg.collect(), step=8)
+    assert open(path).read().count("# TYPE train_steps counter") == 1
+
+
+def test_prometheus_name_sanitization():
+    assert prometheus_name("train/loss") == "train_loss"
+    assert prometheus_name("9lives") == "_9lives"
+    assert prometheus_name("a.b-c/d") == "a_b_c_d"
+
+
+def test_summary_writer_exporter_fallback(tmp_path, monkeypatch):
+    """Without torch, the tensorboard exporter writes the JSONL fallback —
+    the pre-telemetry writer refitted as a registry exporter."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_torch(name, *args, **kwargs):
+        if name.startswith("torch"):
+            raise ImportError(name)
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_torch)
+    reg = _populated_registry()
+    exp = SummaryWriterExporter(log_dir=str(tmp_path), job_name="job")
+    exp.export(reg.collect(), step=2)
+    exp.close()
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "job" / "events.jsonl").read().splitlines()
+    ]
+    tags = {l["tag"] for l in lines}
+    # histograms surface as count/sum scalar streams
+    assert {"train/steps", "train/loss", "train/window_time_ms/count",
+            "train/window_time_ms/sum"} <= tags
+
+
+# ---------------------------------------------------------------------------
+# throughput-timer warmup fix (satellite)
+# ---------------------------------------------------------------------------
+def test_tput_timer_no_inf_before_warmup():
+    lines = []
+    t = ThroughputTimer(
+        batch_size=4, num_workers=1, start_step=2, steps_per_output=1,
+        monitor_memory=False, logging_fn=lines.append,
+        fence_fn=lambda: None,
+    )
+    assert t.avg_samples_per_sec() == 0.0  # was float("-inf")
+    # two warmup steps: no rate line may be emitted (and never a -inf one)
+    for _ in range(2):
+        t.start()
+        t.stop()
+    assert not any("SamplesPerSec" in l for l in lines)
+    assert all("inf" not in l for l in lines)
+    # past warmup the real rate appears
+    for _ in range(3):
+        t.start()
+        t.stop()
+    assert any("SamplesPerSec" in l for l in lines)
+    assert t.avg_samples_per_sec() > 0
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+def test_watchdog_stall_detection_fake_clock():
+    now = [0.0]
+    reports = []
+    wd = StepHeartbeatWatchdog(
+        timeout=30.0,
+        poll_interval=1.0,
+        clock=lambda: now[0],
+        context_fn=lambda: {"device_memory": "fake", "last": 42},
+        report_fn=lambda waited, step, ctx: reports.append((waited, step, ctx)),
+    )
+    # unarmed: a long quiet period before the first window is NOT a stall
+    now[0] = 1000.0
+    assert not wd.check()
+    wd.beat(step=3)
+    now[0] += 29.0
+    assert not wd.check()  # inside the timeout
+    now[0] += 2.0
+    assert wd.check()  # 31s since beat -> stall fires
+    assert not wd.check()  # one report per stall, not one per poll
+    waited, step, ctx = reports[0]
+    assert waited == pytest.approx(31.0)
+    assert step == 3
+    assert ctx["device_memory"] == "fake"
+    # a recovery beat re-arms detection
+    wd.beat(step=4)
+    assert not wd.check()
+    now[0] += 31.0
+    assert wd.check()
+    assert wd.stall_count == 2
+
+
+def test_watchdog_default_report_is_rank_tagged():
+    import logging as _logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    records = []
+
+    class Capture(_logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture(level=_logging.ERROR)
+    ds_logger.addHandler(handler)  # the shared logger has propagate=False
+    try:
+        now = [0.0]
+        wd = StepHeartbeatWatchdog(
+            timeout=5.0, poll_interval=1.0, clock=lambda: now[0],
+            context_fn=lambda: {"metrics": {"train/loss": 1.0}},
+        )
+        wd.beat(step=1)
+        now[0] = 10.0
+        assert wd.check()
+    finally:
+        ds_logger.removeHandler(handler)
+    assert any(
+        "STEP HEARTBEAT STALL" in r.getMessage()
+        and "[Rank 0]" in r.getMessage()
+        and r.levelno == _logging.ERROR
+        for r in records
+    )
+
+
+def test_watchdog_liveness_beat_never_arms():
+    """A step=None beat (eval forward) before the first training window
+    must NOT arm the watchdog: a job that runs a baseline eval first is
+    still owed the first-window compilation grace."""
+    now = [0.0]
+    wd = StepHeartbeatWatchdog(
+        timeout=30.0, poll_interval=1.0, clock=lambda: now[0],
+        report_fn=lambda *a: None,
+    )
+    wd.beat()  # eval-phase liveness before any training window
+    now[0] += 1000.0  # first window compiles for far longer than timeout
+    assert not wd.check()  # still unarmed: no false stall mid-compile
+    wd.beat(step=1)  # first completed window arms it
+    now[0] += 31.0
+    assert wd.check()
+
+
+def test_watchdog_pause_resume():
+    """pause() suspends detection for phases with no step cadence (a
+    checkpoint save can outlast the timeout); resume() restarts the stall
+    clock so the paused phase never counts against it."""
+    now = [0.0]
+    reports = []
+    wd = StepHeartbeatWatchdog(
+        timeout=30.0, poll_interval=1.0, clock=lambda: now[0],
+        report_fn=lambda waited, step, ctx: reports.append(step),
+    )
+    wd.beat(step=1)
+    wd.pause()
+    now[0] += 1000.0  # a save far longer than the timeout
+    assert not wd.check()  # paused: no stall mid-save
+    wd.resume()
+    assert not wd.check()  # clock restarted at resume, not still at beat
+    now[0] += 29.0
+    assert not wd.check()
+    now[0] += 2.0
+    assert wd.check()  # detection is live again after resume
+    assert reports == [1]
+    # nesting: detection stays off until the outermost resume
+    wd.beat(step=2)
+    wd.pause()
+    wd.pause()
+    wd.resume()
+    now[0] += 100.0
+    assert not wd.check()
+    wd.resume()
+    now[0] += 31.0
+    assert wd.check()
+
+
+def test_telemetry_liveness_exempt_and_window_duration():
+    """Telemetry.liveness_exempt pauses the watchdog for the block, and
+    train/window_time_ms measures start->end duration, not the gap
+    between successive window ends."""
+    now = [0.0]
+    wd = StepHeartbeatWatchdog(
+        timeout=30.0, poll_interval=1.0, clock=lambda: now[0],
+        report_fn=lambda *a: None,
+    )
+    t = Telemetry(enabled=True, watchdog=wd)
+    wd.stop()  # drive the fake clock by hand, not from the poll thread
+    t.on_window_end(global_steps=1)
+    with t.liveness_exempt():
+        now[0] += 1000.0
+        assert not wd.check()
+    assert not wd.check()  # clock restarted on exit
+    # duration histogram: only windows bracketed by on_window_start count
+    hist = t.registry.histogram("train/window_time_ms")
+    assert hist.count == 0  # no on_window_start -> no bogus gap sample
+    t.on_window_start()
+    t.on_window_end(global_steps=2)
+    assert hist.count == 1
+    t.close()
+
+
+def test_watchdog_thread_start_stop():
+    wd = StepHeartbeatWatchdog(timeout=60.0, poll_interval=0.05)
+    wd.start()
+    assert wd._thread.is_alive()
+    wd.start()  # idempotent
+    wd.stop()
+    assert wd._thread is None
+
+
+def test_watchdog_rejects_bad_timeout():
+    with pytest.raises(ValueError):
+        StepHeartbeatWatchdog(timeout=0)
+    # Event.wait(<=0) returns immediately -> the poll thread would
+    # busy-spin a core; must be rejected up front
+    with pytest.raises(ValueError):
+        StepHeartbeatWatchdog(timeout=60.0, poll_interval=-1)
+
+
+def test_watchdog_heartbeat_without_step():
+    """A step=None beat (eval forward, checkpoint save) defers the stall
+    but keeps the last-completed-window index in the report."""
+    now = [0.0]
+    reports = []
+    wd = StepHeartbeatWatchdog(
+        timeout=30.0, poll_interval=1.0, clock=lambda: now[0],
+        report_fn=lambda waited, step, ctx: reports.append(step),
+    )
+    wd.beat(step=7)
+    now[0] += 25.0
+    wd.beat()  # liveness-only: eval phase in progress
+    now[0] += 25.0
+    assert not wd.check()  # 25s since last beat — no stall
+    now[0] += 6.0
+    assert wd.check()
+    assert reports == [7]  # window index survived the None beats
+
+
+def test_flush_exports_trailing_windows():
+    """With interval > 1, windows past the last export boundary must be
+    settled and exported by flush()/close(), not silently dropped."""
+    class Capture:
+        def __init__(self):
+            self.steps = []
+
+        def export(self, metrics, step):
+            self.steps.append(step)
+
+        def flush(self):
+            pass
+
+        def close(self):
+            pass
+
+    sink = Capture()
+    t = Telemetry(enabled=True, interval=3, exporters=[sink])
+    for step in range(1, 5):  # 4 windows: boundary at 3, one trailing
+        t.on_window_start()
+        t.on_window_end(loss=2.5, global_steps=step)
+    assert sink.steps == [3]
+    t.flush()
+    assert sink.steps == [3, 4]  # trailing window settled at flush
+    assert t.registry.snapshot()["train/loss"] == 2.5
+    t.flush()
+    assert sink.steps == [3, 4]  # nothing pending: no duplicate export
+    t.close()
+
+
+def test_batch_tokens_dtype_rule():
+    """rows x dim-1 counts tokens only for 2-d integer leaves (LM ids);
+    float features and images count tokens == samples."""
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    ids = np.zeros((8, 128), np.int32)
+    assert DeepSpeedEngine._batch_tokens((ids,)) == (8 * 128, 8)
+    feats = np.zeros((8, 512), np.float32)
+    assert DeepSpeedEngine._batch_tokens((feats,)) == (8, 8)
+    images = np.zeros((8, 32, 32, 3), np.float32)
+    assert DeepSpeedEngine._batch_tokens((images,)) == (8, 8)
+    assert DeepSpeedEngine._batch_tokens(()) == (0, 0)
+
+
+def test_multiprocess_prometheus_path_keeps_prom_extension(
+    tmp_path, monkeypatch
+):
+    """Rank suffix goes BEFORE '.prom': textfile collectors glob '*.prom',
+    so 'metrics.prom.rank1' would never be scraped."""
+    from deepspeed_tpu.telemetry import build_telemetry
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    cfg = _cfg({
+        "enabled": True,
+        "output_path": str(tmp_path),
+        "exporters": ["prometheus"],
+        "watchdog": {"enabled": False},
+    })
+    t = build_telemetry(cfg, rank=1)
+    try:
+        assert t.exporters[0].path.endswith(".rank1.prom")
+    finally:
+        t.close()
+
+
+# ---------------------------------------------------------------------------
+# config block validation
+# ---------------------------------------------------------------------------
+def _cfg(telemetry):
+    return DeepSpeedConfig(
+        None,
+        param_dict={"train_batch_size": 8, "telemetry": telemetry},
+        world_size=1,
+    )
+
+
+def test_config_defaults():
+    cfg = _cfg({"enabled": True})
+    assert cfg.telemetry_enabled
+    assert cfg.telemetry_interval == 1
+    assert cfg.telemetry_exporters == ["jsonl", "prometheus"]
+    assert cfg.telemetry_profile_start_step == -1  # profiling off
+    assert cfg.telemetry_watchdog_enabled
+    assert cfg.telemetry_watchdog_timeout == 600.0
+    # absent block: fully off, watchdog included
+    off = DeepSpeedConfig(None, param_dict={"train_batch_size": 8}, world_size=1)
+    assert not off.telemetry_enabled
+    assert not off.telemetry_watchdog_enabled
+
+
+def test_config_rejects_unknown_exporter():
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "exporters": ["jsonl", "statsd"]})
+
+
+def test_config_rejects_non_list_exporters():
+    # a bare string must not be list()ed into characters
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "exporters": "jsonl"})
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "exporters": 5})
+
+
+def test_config_rejects_non_numeric_fields():
+    # strings must raise a config error naming the field, not a raw
+    # TypeError from a range comparison
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "profile": {"start_step": "20"}})
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True,
+              "profile": {"start_step": 2, "num_steps": "2"}})
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "watchdog": {"timeout": "600"}})
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "watchdog": {"poll_interval": "5"}})
+
+
+def test_config_rejects_bad_interval():
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "interval": 0})
+    # bool passes isinstance(..., int): a user treating interval as a
+    # flag must get the config error, not silent every-window export
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "interval": True})
+
+
+def test_config_rejects_bad_profile_window():
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "profile": {"start_step": 2, "num_steps": 0}})
+
+
+def test_config_rejects_bad_watchdog_timeout():
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "watchdog": {"timeout": 0}})
+
+
+def test_config_rejects_bad_watchdog_poll_interval():
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg({"enabled": True, "watchdog": {"poll_interval": -1}})
+
+
+# ---------------------------------------------------------------------------
+# engine integration: golden catalog, exporters, config-armed profiler
+# ---------------------------------------------------------------------------
+GOLDEN_SCALAR_NAMES = sorted(name for _, name, _ in ENGINE_METRICS)
+
+
+def _small_engine(tmp_path, telemetry_extra=None, steps=3):
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, y, train=True):
+            pred = nn.Dense(1)(x)
+            return jnp.mean((pred[:, 0] - y) ** 2)
+
+    m = M()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    y = rng.standard_normal((8,)).astype(np.float32)
+    params = m.init(jax.random.PRNGKey(0), x[:2], y[:2])["params"]
+    telemetry = {
+        "enabled": True,
+        "output_path": str(tmp_path),
+        "job_name": "job",
+        "watchdog": {"timeout": 300.0},
+    }
+    telemetry.update(telemetry_extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 1000,
+            "telemetry": telemetry,
+        },
+    )
+    for _ in range(steps):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    engine.flush_monitor()
+    return engine, (x, y)
+
+
+def test_engine_golden_scalar_names(tmp_path):
+    """Pins the engine's emitted metric catalog: a new stream must be added
+    to ENGINE_METRICS (and docs/observability.md); a dropped one is a
+    regression this test catches."""
+    engine, _ = _small_engine(tmp_path)
+    engine.telemetry.close()
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "job" / "metrics.jsonl").read().splitlines()
+    ]
+    assert sorted({l["tag"] for l in lines}) == GOLDEN_SCALAR_NAMES
+
+
+def test_engine_exports_new_streams_to_both_sinks(tmp_path):
+    """Acceptance smoke: grad-norm, skip counters, memory gauges and
+    tokens/sec appear in BOTH the JSONL and the Prometheus textfile sinks
+    with plausible values."""
+    engine, _ = _small_engine(tmp_path, steps=4)
+    engine.telemetry.close()
+    job = tmp_path / "job"
+    lines = [json.loads(l) for l in open(job / "metrics.jsonl").read().splitlines()]
+    last = {}
+    for l in lines:
+        last[l["tag"]] = l
+    assert last["train/grad_norm"]["value"] > 0
+    assert last["train/global_steps"]["value"] == 4
+    assert last["train/skipped_steps"]["value"] == 0
+    assert last["train/micro_steps"]["value"] == 4
+    assert last["train/loss"]["value"] > 0
+    assert last["train/tokens_per_sec"]["value"] > 0
+    assert last["jax/recompiles"]["value"] > 0
+    prom = open(job / "metrics.prom").read()
+    for stream in (
+        "train_grad_norm", "train_skipped_steps", "device_bytes_in_use",
+        "train_tokens_per_sec", "train_window_time_ms_bucket",
+    ):
+        assert stream in prom, f"{stream} missing from textfile"
+
+
+def test_engine_config_armed_profiler_window(tmp_path):
+    """A profile sub-block produces a trace for the configured window with
+    no manual start_profile()/stop_profile() call."""
+    engine, _ = _small_engine(
+        tmp_path,
+        telemetry_extra={"profile": {"start_step": 1, "num_steps": 2}},
+        steps=4,
+    )
+    engine.telemetry.close()
+    trace_dir = str(tmp_path / "job" / "profile")
+    artifacts = glob.glob(trace_dir + "/**/*.pb", recursive=True) + glob.glob(
+        trace_dir + "/**/*.json.gz", recursive=True
+    )
+    assert artifacts, os.listdir(trace_dir)
+    # the window closed itself: no trace is still running
+    assert not engine.telemetry.profiler.tracing
+
+
+def test_engine_fused_train_batch_feeds_telemetry(tmp_path):
+    """train_batch() (the fused window) goes through the same hooks."""
+    engine, (x, y) = _small_engine(tmp_path, steps=1)
+    for _ in range(2):
+        engine.train_batch(iter([(x, y)]))
+    engine.flush_monitor()
+    engine.telemetry.close()
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "job" / "metrics.jsonl").read().splitlines()
+    ]
+    last = {}
+    for l in lines:
+        last[l["tag"]] = l
+    assert last["train/global_steps"]["value"] == 3
+    assert last["train/loss"]["value"] > 0
+
+
+def test_engine_training_forward_beats_watchdog(tmp_path):
+    """Micro-step progress is liveness: a deep accumulation window (or one
+    slow-host micro-step) can legitimately outlast the watchdog timeout,
+    so every training forward must defer the stall — not only
+    on_window_end."""
+    engine, (x, y) = _small_engine(tmp_path, steps=1)
+    beats = []
+    wd = engine.telemetry.watchdog
+    orig = wd.beat
+    wd.beat = lambda step=None: (beats.append(step), orig(step=step))
+    loss = engine(x, y)  # forward only: window still open
+    assert None in beats  # liveness-only beat — window index untouched
+    engine.backward(loss)
+    engine.step()
+    engine.telemetry.close()
+
+
+def test_engine_step_mirrors_export_as_gauges(tmp_path):
+    """global/skipped/micro step mirrors are downward-revisable (deferred
+    overflow reconciliation, in-process load_checkpoint), so the textfile
+    must declare them TYPE gauge — a decreasing counter reads as a reset
+    and blows up rate() on scrapers."""
+    engine, _ = _small_engine(tmp_path, steps=2)
+    engine.telemetry.close()
+    prom = open(tmp_path / "job" / "metrics.prom").read()
+    for name in ("train_global_steps", "train_skipped_steps",
+                 "train_micro_steps"):
+        assert f"# TYPE {name} gauge" in prom
+    assert "# TYPE jax_recompiles counter" in prom
+
+
+def test_dataloader_queue_depth_gauge():
+    class StubTelemetry:
+        def __init__(self):
+            self.depths = []
+
+        def set_dataloader_depth(self, depth):
+            self.depths.append(depth)
+
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    data = (np.arange(64, dtype=np.float32).reshape(16, 4),)
+    stub = StubTelemetry()
+    loader = DeepSpeedDataLoader(
+        data, batch_size=4, mesh=None, prefetch=2, telemetry=stub
+    )
+    batches = list(loader)
+    assert len(batches) == 4
+    assert len(stub.depths) == 4  # one reading per handoff
+    assert all(0 <= d <= 2 for d in stub.depths)
+
+
+def test_telemetry_disabled_is_inert(tmp_path):
+    """Without the config block every hook is a no-op: no files, no
+    watchdog thread, no registry churn on the hot path."""
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, y, train=True):
+            return jnp.mean((nn.Dense(1)(x)[:, 0] - y) ** 2)
+
+    m = M()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    y = rng.standard_normal((8,)).astype(np.float32)
+    params = m.init(jax.random.PRNGKey(0), x[:2], y[:2])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 1000,
+        },
+    )
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    assert not engine.telemetry.enabled
+    assert engine.telemetry.watchdog is None
+    assert engine.telemetry.exporters == []
