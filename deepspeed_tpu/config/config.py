@@ -24,6 +24,7 @@ TPU-first divergences (documented, intentional):
 """
 
 import logging
+import os
 
 from . import constants as C
 from .activation_checkpointing_config import DeepSpeedActivationCheckpointingConfig
@@ -267,6 +268,79 @@ class DeepSpeedConfig:
             C.TELEMETRY_WATCHDOG_POLL_INTERVAL_DEFAULT,
         )
 
+        # resilience block (deepspeed_tpu/resilience/, docs/resilience.md)
+        res_dict = get_dict_param(pd, C.RESILIENCE)
+        self.resilience_enabled = get_scalar_param(
+            res_dict, C.RESILIENCE_ENABLED, C.RESILIENCE_ENABLED_DEFAULT
+        )
+        self.resilience_fsync = get_scalar_param(
+            res_dict, C.RESILIENCE_FSYNC, C.RESILIENCE_FSYNC_DEFAULT
+        )
+        self.resilience_verify_on_load = get_scalar_param(
+            res_dict,
+            C.RESILIENCE_VERIFY_ON_LOAD,
+            C.RESILIENCE_VERIFY_ON_LOAD_DEFAULT,
+        )
+        self.resilience_fallback_on_corruption = get_scalar_param(
+            res_dict,
+            C.RESILIENCE_FALLBACK_ON_CORRUPTION,
+            C.RESILIENCE_FALLBACK_ON_CORRUPTION_DEFAULT,
+        )
+        self.resilience_keep_last_n = get_scalar_param(
+            res_dict, C.RESILIENCE_KEEP_LAST_N, C.RESILIENCE_KEEP_LAST_N_DEFAULT
+        )
+        retry_dict = get_dict_param(res_dict, C.RESILIENCE_RETRY)
+        self.resilience_retry_max_attempts = get_scalar_param(
+            retry_dict,
+            C.RESILIENCE_RETRY_MAX_ATTEMPTS,
+            C.RESILIENCE_RETRY_MAX_ATTEMPTS_DEFAULT,
+        )
+        self.resilience_retry_backoff_base = get_scalar_param(
+            retry_dict,
+            C.RESILIENCE_RETRY_BACKOFF_BASE,
+            C.RESILIENCE_RETRY_BACKOFF_BASE_DEFAULT,
+        )
+        self.resilience_retry_backoff_max = get_scalar_param(
+            retry_dict,
+            C.RESILIENCE_RETRY_BACKOFF_MAX,
+            C.RESILIENCE_RETRY_BACKOFF_MAX_DEFAULT,
+        )
+        self.resilience_retry_jitter = get_scalar_param(
+            retry_dict,
+            C.RESILIENCE_RETRY_JITTER,
+            C.RESILIENCE_RETRY_JITTER_DEFAULT,
+        )
+        pre_dict = get_dict_param(res_dict, C.RESILIENCE_PREEMPTION)
+        self.resilience_preemption_enabled = get_scalar_param(
+            pre_dict,
+            C.RESILIENCE_PREEMPTION_ENABLED,
+            C.RESILIENCE_PREEMPTION_ENABLED_DEFAULT,
+        )
+        signals = pre_dict.get(
+            C.RESILIENCE_PREEMPTION_SIGNALS,
+            C.RESILIENCE_PREEMPTION_SIGNALS_DEFAULT,
+        )
+        # keep non-list values (a bare "SIGTERM" would list() into
+        # characters) for _check_resilience to reject with a config error
+        self.resilience_preemption_signals = (
+            list(signals) if isinstance(signals, (list, tuple)) else signals
+        )
+        self.resilience_preemption_save_dir = get_scalar_param(
+            pre_dict,
+            C.RESILIENCE_PREEMPTION_SAVE_DIR,
+            C.RESILIENCE_PREEMPTION_SAVE_DIR_DEFAULT,
+        )
+        self.resilience_preemption_tag_prefix = get_scalar_param(
+            pre_dict,
+            C.RESILIENCE_PREEMPTION_TAG_PREFIX,
+            C.RESILIENCE_PREEMPTION_TAG_PREFIX_DEFAULT,
+        )
+        self.resilience_preemption_exit_after_save = get_scalar_param(
+            pre_dict,
+            C.RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE,
+            C.RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE_DEFAULT,
+        )
+
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
         self.data_parallel_size = get_scalar_param(
@@ -362,6 +436,7 @@ class DeepSpeedConfig:
         if self.loss_scale < 0:
             raise DeepSpeedConfigError(f"loss_scale must be >= 0, got {self.loss_scale}")
         self._check_telemetry()
+        self._check_resilience()
         amp_dict = get_dict_param(self._param_dict, C.AMP)
         if amp_dict.get(C.AMP_ENABLED, bool(amp_dict)):
             # apex amp (reference deepspeed_light.py:516-521) has no TPU
@@ -457,6 +532,86 @@ class DeepSpeedConfig:
                 f"{C.TELEMETRY_WATCHDOG_POLL_INTERVAL} must be > 0 seconds "
                 f"(or null for timeout/4), got "
                 f"{self.telemetry_watchdog_poll_interval!r}"
+            )
+
+    def _check_resilience(self):
+        """Validate the resilience block (docs/resilience.md): a typo'd
+        retry policy or an unknown signal name must fail at init, not at
+        the first flaky write / first SIGTERM."""
+        if (
+            not isinstance(self.resilience_keep_last_n, int)
+            or isinstance(self.resilience_keep_last_n, bool)
+            or self.resilience_keep_last_n < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.RESILIENCE}.{C.RESILIENCE_KEEP_LAST_N} must be an "
+                f"integer >= 0 (0 keeps everything), got "
+                f"{self.resilience_keep_last_n!r}"
+            )
+        if (
+            not isinstance(self.resilience_retry_max_attempts, int)
+            or isinstance(self.resilience_retry_max_attempts, bool)
+            or self.resilience_retry_max_attempts < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.RESILIENCE}.{C.RESILIENCE_RETRY}."
+                f"{C.RESILIENCE_RETRY_MAX_ATTEMPTS} must be an integer >= 1 "
+                f"(1 = no retries), got "
+                f"{self.resilience_retry_max_attempts!r}"
+            )
+        for field, value in (
+            (C.RESILIENCE_RETRY_BACKOFF_BASE, self.resilience_retry_backoff_base),
+            (C.RESILIENCE_RETRY_BACKOFF_MAX, self.resilience_retry_backoff_max),
+        ):
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or value <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{C.RESILIENCE}.{C.RESILIENCE_RETRY}.{field} must be a "
+                    f"number > 0 seconds, got {value!r}"
+                )
+        jitter = self.resilience_retry_jitter
+        if (
+            not isinstance(jitter, (int, float))
+            or isinstance(jitter, bool)
+            or not 0 <= jitter <= 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.RESILIENCE}.{C.RESILIENCE_RETRY}."
+                f"{C.RESILIENCE_RETRY_JITTER} must be a number in [0, 1], "
+                f"got {jitter!r}"
+            )
+        sigs = self.resilience_preemption_signals
+        if not isinstance(sigs, list) or not sigs or not all(
+            isinstance(s, str) for s in sigs
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.RESILIENCE}.{C.RESILIENCE_PREEMPTION}."
+                f"{C.RESILIENCE_PREEMPTION_SIGNALS} must be a non-empty "
+                f"list of signal names, got {sigs!r}"
+            )
+        import signal as _signal
+
+        for name in sigs:
+            if not isinstance(getattr(_signal, name, None), _signal.Signals):
+                raise DeepSpeedConfigError(
+                    f"{C.RESILIENCE}.{C.RESILIENCE_PREEMPTION}."
+                    f"{C.RESILIENCE_PREEMPTION_SIGNALS}: unknown signal "
+                    f"name {name!r}"
+                )
+        prefix = self.resilience_preemption_tag_prefix
+        if (
+            not isinstance(prefix, str)
+            or not prefix
+            or os.sep in prefix
+            or prefix in (".", "..")
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.RESILIENCE}.{C.RESILIENCE_PREEMPTION}."
+                f"{C.RESILIENCE_PREEMPTION_TAG_PREFIX} must be a non-empty "
+                f"path-component-safe string, got {prefix!r}"
             )
 
     def _do_warning_check(self):

@@ -311,6 +311,61 @@ TELEMETRY_WATCHDOG_TIMEOUT_DEFAULT = 600.0
 TELEMETRY_WATCHDOG_POLL_INTERVAL = "poll_interval"
 TELEMETRY_WATCHDOG_POLL_INTERVAL_DEFAULT = None  # => timeout / 4
 
+# Crash-safe checkpointing / preemption resilience
+# (deepspeed_tpu/resilience/, docs/resilience.md). TPU-native addition:
+# the reference sequenced checkpoint writers with barriers and a `latest`
+# tag but had no defense against torn writes, corrupt files, or
+# preempted workers.
+RESILIENCE = "resilience"
+# Master switch for the atomic commit protocol (tmp+fsync+rename writes,
+# sha256 MANIFEST.json, verify-before-publish) and verified loads. Off =>
+# the legacy bare-open() write path.
+RESILIENCE_ENABLED = "enabled"
+RESILIENCE_ENABLED_DEFAULT = True
+# fsync files and directory entries on the commit path. Disable only for
+# throwaway runs on local disk where save latency matters more than
+# power-loss durability (kill-safety via rename atomicity still holds).
+RESILIENCE_FSYNC = "fsync"
+RESILIENCE_FSYNC_DEFAULT = True
+# Deep-verify (sha256) the manifest before trusting a checkpoint on load.
+RESILIENCE_VERIFY_ON_LOAD = "verify_on_load"
+RESILIENCE_VERIFY_ON_LOAD_DEFAULT = True
+# On corruption/missing files under a `latest`-driven load, walk back to
+# the newest valid tag instead of failing the load.
+RESILIENCE_FALLBACK_ON_CORRUPTION = "fallback_on_corruption"
+RESILIENCE_FALLBACK_ON_CORRUPTION_DEFAULT = True
+# Retention GC: keep the newest N loadable checkpoints, delete older ones
+# after each successful save. 0 (default) keeps everything. The newest
+# valid checkpoint and the `latest` target are never deleted.
+RESILIENCE_KEEP_LAST_N = "keep_last_n"
+RESILIENCE_KEEP_LAST_N_DEFAULT = 0
+# Exponential-backoff-with-jitter retry for transient storage errors
+# (GCS-FUSE/NFS flakes). max_attempts counts total tries (1 = no retry).
+RESILIENCE_RETRY = "retry"
+RESILIENCE_RETRY_MAX_ATTEMPTS = "max_attempts"
+RESILIENCE_RETRY_MAX_ATTEMPTS_DEFAULT = 3
+RESILIENCE_RETRY_BACKOFF_BASE = "backoff_base"
+RESILIENCE_RETRY_BACKOFF_BASE_DEFAULT = 0.1
+RESILIENCE_RETRY_BACKOFF_MAX = "backoff_max"
+RESILIENCE_RETRY_BACKOFF_MAX_DEFAULT = 5.0
+RESILIENCE_RETRY_JITTER = "jitter"
+RESILIENCE_RETRY_JITTER_DEFAULT = 0.25
+# Preemption drain: SIGTERM/SIGINT arms a save-at-next-step-boundary
+# flag; the engine commits one final checkpoint and exits by re-raising
+# the original signal. save_dir "" => the last directory the engine
+# saved to or loaded from.
+RESILIENCE_PREEMPTION = "preemption"
+RESILIENCE_PREEMPTION_ENABLED = "enabled"
+RESILIENCE_PREEMPTION_ENABLED_DEFAULT = False
+RESILIENCE_PREEMPTION_SIGNALS = "signals"
+RESILIENCE_PREEMPTION_SIGNALS_DEFAULT = ("SIGTERM", "SIGINT")
+RESILIENCE_PREEMPTION_SAVE_DIR = "save_dir"
+RESILIENCE_PREEMPTION_SAVE_DIR_DEFAULT = ""
+RESILIENCE_PREEMPTION_TAG_PREFIX = "tag_prefix"
+RESILIENCE_PREEMPTION_TAG_PREFIX_DEFAULT = "preempt"
+RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE = "exit_after_save"
+RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE_DEFAULT = True
+
 #############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,
 # which delegated model parallelism to an external mpu object)

@@ -215,7 +215,11 @@ def _metadata_get(attribute, timeout=2.0):
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.read().decode()
-    except Exception:  # noqa: BLE001 — any failure means "not on a TPU VM"
+    except Exception as e:  # noqa: BLE001 — any failure means "not on a TPU VM"
+        # debug, not warning: off-platform this fires on every probe, but a
+        # MISdetected TPU VM (firewalled metadata server, proxy in the way)
+        # is undiagnosable without the actual error
+        logger.debug("TPU metadata probe %r failed: %r", attribute, e)
         return None
 
 
@@ -230,7 +234,10 @@ def _gcloud_describe(tpu_name):
             stderr=subprocess.DEVNULL,
         )
         return json.loads(out)
-    except Exception:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001
+        logger.debug(
+            "gcloud tpu-vm describe %r failed: %r", tpu_name, e
+        )
         return None
 
 

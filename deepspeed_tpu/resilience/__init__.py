@@ -1,0 +1,42 @@
+"""Fault-tolerance layer for the save/load/run lifecycle (docs/resilience.md).
+
+Five pieces, configured under the ``"resilience"`` config block and wired
+through the engine:
+
+- **Atomic commit protocol** (atomic_io, manifest): every checkpoint file
+  is written tmp + fsync + ``os.replace``; a per-file sha256
+  ``MANIFEST.json`` is written last and the ``latest`` pointer publishes
+  only after the manifest re-verifies — a kill at any instant leaves the
+  old checkpoint or a complete new one, never a torn one.
+- **Verified transactional load** (runtime/checkpointing.py): everything
+  is parsed on host before the engine mutates; corrupt or missing
+  candidates fall back to the newest valid tag.
+- **Retryable I/O** (atomic_io.RetryPolicy): exponential backoff with
+  jitter around transient storage errors.
+- **Preemption drain** (preemption): SIGTERM/SIGINT arms a
+  save-at-next-step-boundary flag the engine honors in ``step()``.
+- **Retention GC** (retention): ``keep_last_n`` pruning that never
+  deletes the newest valid checkpoint.
+"""
+
+from .atomic_io import RetryPolicy, with_retries
+from .manager import ResilienceManager, build_resilience
+from .manifest import (
+    CheckpointCorruptionError,
+    MANIFEST_FILE,
+    verify_checkpoint,
+)
+from .preemption import PreemptionHandler
+from .retention import prune_checkpoints
+
+__all__ = [
+    "CheckpointCorruptionError",
+    "MANIFEST_FILE",
+    "PreemptionHandler",
+    "ResilienceManager",
+    "RetryPolicy",
+    "build_resilience",
+    "prune_checkpoints",
+    "verify_checkpoint",
+    "with_retries",
+]

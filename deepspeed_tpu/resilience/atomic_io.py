@@ -1,0 +1,143 @@
+"""Atomic, durable, retryable filesystem primitives for checkpoint I/O.
+
+The commit-protocol building blocks (docs/resilience.md):
+
+- :func:`atomic_write_bytes` / :func:`atomic_write_text` — write to a
+  hidden temp file in the destination directory, flush + ``fsync``, then
+  ``os.replace``. POSIX rename atomicity means a kill at ANY instant
+  leaves either the old file or the complete new one on disk — never a
+  torn mix. The directory entry is fsynced afterwards so the rename
+  itself survives a power loss.
+- :class:`RetryPolicy` + :func:`with_retries` — exponential backoff with
+  full jitter around transient ``OSError`` from flaky network filesystems
+  (GCS-FUSE, NFS). Only ``OSError`` retries: a parse error or checksum
+  mismatch is corruption, and re-reading corrupt bytes harder does not
+  help.
+
+Checkpointing calls these through the module namespace
+(``atomic_io.atomic_write_bytes(...)``) so tests can monkeypatch a failing
+filesystem here — the single choke point for fault injection.
+"""
+
+import logging
+import os
+import random
+import time
+
+from ..utils.logging import log_dist
+
+
+class RetryPolicy:
+    """Exponential backoff with full jitter.
+
+    ``max_attempts`` counts TOTAL tries (1 = no retries). Delay before
+    retry ``k`` (1-based) is ``min(backoff_max, backoff_base * 2**(k-1))``
+    scaled by ``1 + jitter * U[0,1)`` — jitter decorrelates the retry
+    storms of many pod workers hitting the same flaky mount.
+    """
+
+    def __init__(self, max_attempts=3, backoff_base=0.1, backoff_max=5.0,
+                 jitter=0.25):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        if backoff_base <= 0 or backoff_max <= 0:
+            raise ValueError("backoff_base and backoff_max must be > 0")
+        if not 0 <= jitter <= 1:
+            raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        self.max_attempts = int(max_attempts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+
+    def delay(self, failures):
+        """Seconds to sleep after ``failures`` (1-based) failed tries."""
+        base = min(self.backoff_max, self.backoff_base * 2 ** (failures - 1))
+        return base * (1.0 + self.jitter * random.random())
+
+
+DEFAULT_RETRY = RetryPolicy()
+
+
+def with_retries(fn, policy=None, op_name="io", on_retry=None,
+                 sleep=time.sleep, retry_on=(OSError,)):
+    """Run ``fn()`` with the policy's backoff; re-raise after the last try.
+
+    ``on_retry(op_name, attempt, exc)`` fires before each sleep — the
+    metrics hook. ``sleep`` is injectable so tests run at full speed.
+    """
+    policy = policy or DEFAULT_RETRY
+    failures = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            failures += 1
+            if failures >= policy.max_attempts:
+                raise
+            if on_retry is not None:
+                try:
+                    on_retry(op_name, failures, e)
+                except Exception:
+                    pass  # a metrics hook must never mask the real error
+            log_dist(
+                f"transient I/O failure in {op_name} "
+                f"(attempt {failures}/{policy.max_attempts}): {e!r} — "
+                "retrying with backoff",
+                ranks=[-1], level=logging.WARNING,
+            )
+            sleep(policy.delay(failures))
+
+
+def fsync_dir(dirpath):
+    """fsync a directory entry so a completed rename survives power loss.
+    Best-effort: some filesystems (and platforms) refuse O_RDONLY dir
+    fsync — atomicity still holds, only power-loss durability narrows."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path, data, fsync=True):
+    """tmp + fsync + ``os.replace`` publish of ``data`` at ``path``."""
+    dirpath = os.path.dirname(path) or "."
+    # pid-suffixed and dot-prefixed: concurrent writers never collide, and
+    # manifest/GC scans skip leftovers from a killed writer
+    tmp = os.path.join(
+        dirpath, f".{os.path.basename(path)}.tmp.{os.getpid()}"
+    )
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        fsync_dir(dirpath)
+
+
+def atomic_write_text(path, text, fsync=True):
+    atomic_write_bytes(path, text.encode("utf-8"), fsync=fsync)
+
+
+def read_bytes(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def read_text(path):
+    with open(path, "r") as f:
+        return f.read()

@@ -1,0 +1,168 @@
+"""ResilienceManager: one object carrying the fault-tolerance policy.
+
+Built by the engine from the config's ``"resilience"`` block
+(:func:`build_resilience`), handed to the checkpoint save/load paths, and
+instrumented through the telemetry registry (the same ``MetricsRegistry``
+the exporters serialize, so retry storms and corruption fallbacks land in
+the jsonl/Prometheus sinks next to loss curves). With no telemetry block
+the instruments still exist on a private registry — counting is cheap and
+the watchdog/test surface can read them either way.
+"""
+
+import time
+
+from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS, MetricsRegistry
+from ..utils.logging import log_dist, warn_once
+from .atomic_io import RetryPolicy, with_retries
+from .preemption import DEFAULT_SIGNALS, PreemptionHandler
+
+
+class ResilienceManager:
+    def __init__(
+        self,
+        enabled=True,
+        fsync=True,
+        verify_on_load=True,
+        fallback_on_corruption=True,
+        keep_last_n=0,
+        retry=None,
+        preemption_enabled=False,
+        preemption_signals=DEFAULT_SIGNALS,
+        preemption_save_dir="",
+        preemption_tag_prefix="preempt",
+        preemption_exit_after_save=True,
+        registry=None,
+    ):
+        self.enabled = bool(enabled)
+        self.fsync = bool(fsync)
+        self.verify_on_load = bool(verify_on_load)
+        self.fallback_on_corruption = bool(fallback_on_corruption)
+        self.keep_last_n = int(keep_last_n or 0)
+        self.retry = retry or RetryPolicy()
+        self.preemption_save_dir = preemption_save_dir or ""
+        self.preemption_tag_prefix = preemption_tag_prefix
+        self.preemption_exit_after_save = bool(preemption_exit_after_save)
+        self.preemption = (
+            PreemptionHandler(
+                signals=preemption_signals,
+                exit_after_save=preemption_exit_after_save,
+            )
+            if preemption_enabled
+            else None
+        )
+        self.registry = registry if registry is not None else MetricsRegistry()
+        reg = self.registry
+        self._retries = reg.counter(
+            "resilience/io_retries",
+            help="transient checkpoint-I/O failures retried with backoff",
+        )
+        self._fallbacks = reg.counter(
+            "resilience/corruption_fallbacks",
+            help="corrupt/missing checkpoint candidates skipped on load",
+        )
+        self._preemption_saves = reg.counter(
+            "resilience/preemption_saves",
+            help="final checkpoints committed by the preemption drain",
+        )
+        self._pruned = reg.counter(
+            "resilience/checkpoints_pruned",
+            help="checkpoint directories deleted by retention GC",
+        )
+        self._save_ms = reg.histogram(
+            "resilience/save_time_ms", buckets=DEFAULT_TIME_BUCKETS_MS,
+            help="wall time of save_checkpoint, end to end",
+        )
+        self._load_ms = reg.histogram(
+            "resilience/load_time_ms", buckets=DEFAULT_TIME_BUCKETS_MS,
+            help="wall time of load_checkpoint, end to end",
+        )
+
+    # -- retryable I/O --------------------------------------------------
+    def retrying(self, fn, op_name="ckpt_io"):
+        """Run ``fn`` under this manager's backoff policy, counting each
+        retry into ``resilience/io_retries``."""
+        return with_retries(
+            fn, policy=self.retry, op_name=op_name, on_retry=self.on_retry
+        )
+
+    def on_retry(self, op_name, attempt, exc):
+        del op_name, attempt, exc
+        self._retries.inc()
+
+    # -- metric hooks ---------------------------------------------------
+    def count_corruption_fallback(self):
+        self._fallbacks.inc()
+
+    def count_pruned(self, tag):
+        del tag
+        self._pruned.inc()
+
+    def observe_save(self, started_monotonic):
+        self._save_ms.observe((time.monotonic() - started_monotonic) * 1e3)
+
+    def observe_load(self, started_monotonic):
+        self._load_ms.observe((time.monotonic() - started_monotonic) * 1e3)
+
+    # -- preemption facade ----------------------------------------------
+    def install_preemption(self):
+        if self.preemption is not None:
+            self.preemption.install()
+
+    @property
+    def preemption_armed(self):
+        return self.preemption is not None and self.preemption.armed
+
+    def finish_preemption_save(self):
+        """Called by the engine after the drain checkpoint committed:
+        count it, then either exit via the original signal disposition
+        (the default) or disarm and keep training (exit_after_save
+        false — sweeps that checkpoint on SIGUSR1-style nudges)."""
+        self._preemption_saves.inc()
+        if self.preemption is None:
+            return
+        if self.preemption_exit_after_save:
+            log_dist(
+                "preemption drain complete: final checkpoint committed; "
+                "exiting",
+                ranks=[-1],
+            )
+            self.preemption.resignal()
+        self.preemption.disarm()
+
+
+def build_resilience(config, telemetry=None):
+    """Construct the engine's manager from a validated DeepSpeedConfig.
+
+    The telemetry registry is shared when available so resilience streams
+    export through the configured sinks; otherwise instruments live on a
+    private registry.
+    """
+    registry = None
+    if telemetry is not None and getattr(telemetry, "enabled", False):
+        registry = telemetry.registry
+    if not hasattr(config, "resilience_enabled"):
+        # standalone/legacy config objects (tests, tools) get the defaults
+        warn_once(
+            "resilience-default-config",
+            "config has no resilience block attributes; using defaults",
+        )
+        return ResilienceManager(registry=registry)
+    return ResilienceManager(
+        enabled=config.resilience_enabled,
+        fsync=config.resilience_fsync,
+        verify_on_load=config.resilience_verify_on_load,
+        fallback_on_corruption=config.resilience_fallback_on_corruption,
+        keep_last_n=config.resilience_keep_last_n,
+        retry=RetryPolicy(
+            max_attempts=config.resilience_retry_max_attempts,
+            backoff_base=config.resilience_retry_backoff_base,
+            backoff_max=config.resilience_retry_backoff_max,
+            jitter=config.resilience_retry_jitter,
+        ),
+        preemption_enabled=config.resilience_preemption_enabled,
+        preemption_signals=config.resilience_preemption_signals,
+        preemption_save_dir=config.resilience_preemption_save_dir,
+        preemption_tag_prefix=config.resilience_preemption_tag_prefix,
+        preemption_exit_after_save=config.resilience_preemption_exit_after_save,
+        registry=registry,
+    )

@@ -1,0 +1,208 @@
+"""Checkpoint manifest: the commit record of the atomic-save protocol.
+
+``MANIFEST.json`` is written into a checkpoint directory LAST, after every
+state file landed (atomically), and the ``latest`` pointer is published
+only after the manifest re-verifies. The manifest therefore certifies
+"this checkpoint is complete": per-file sha256 + size for every state
+file, plus the tag and step counter the retention/fallback ordering keys
+off.
+
+Format (``format_version`` 1)::
+
+    {
+      "format_version": 1,
+      "tag": "global_step40",
+      "global_steps": 40,
+      "created_unix": 1754092800.0,
+      "files": {
+        "mp_rank_00_model_states.msgpack": {"sha256": "...", "size": 123},
+        "zero_pp_rank_0_mp_rank_00optim_states.msgpack": {...}
+      }
+    }
+
+Verification is a four-state verdict, not a boolean, because legacy
+checkpoints (saved before this subsystem, or with resilience disabled)
+have no manifest yet must stay loadable:
+
+- ``valid``   — manifest present, every listed file exists with matching
+  size and sha256.
+- ``legacy``  — no manifest, but the model-states file exists; the
+  transactional load's parse staging is the only guard.
+- ``corrupt`` — manifest unreadable, a listed file missing, or a
+  size/sha256 mismatch.
+- ``missing`` — no checkpoint here at all.
+"""
+
+import hashlib
+import json
+import os
+import time
+
+from . import atomic_io
+
+MANIFEST_FILE = "MANIFEST.json"
+FORMAT_VERSION = 1
+
+VALID = "valid"
+LEGACY = "legacy"
+CORRUPT = "corrupt"
+MISSING = "missing"
+
+
+class CheckpointCorruptionError(Exception):
+    """A checkpoint failed post-save verification (the save must not
+    publish) or an explicitly requested tag failed load verification."""
+
+
+def file_sha256(path, chunk_size=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _is_state_file(dirpath, name):
+    """Checkpoint payload files: everything except the manifest itself and
+    writer temp files (dot-prefixed; see atomic_io)."""
+    if name == MANIFEST_FILE or name.startswith("."):
+        return False
+    return os.path.isfile(os.path.join(dirpath, name))
+
+
+def write_manifest(ckpt_dir, tag, meta=None, fsync=True, retry=None,
+                   on_retry=None):
+    """Hash every state file in ``ckpt_dir`` and publish the manifest
+    atomically. Returns the manifest dict. Reads go through the retry
+    wrapper too — on a flaky mount the hash pass is as exposed as the
+    writes."""
+    files = {}
+    for name in sorted(os.listdir(ckpt_dir)):
+        if not _is_state_file(ckpt_dir, name):
+            continue
+        path = os.path.join(ckpt_dir, name)
+        digest = atomic_io.with_retries(
+            lambda p=path: file_sha256(p), policy=retry,
+            op_name="manifest_hash", on_retry=on_retry,
+        )
+        files[name] = {"sha256": digest, "size": os.path.getsize(path)}
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "tag": str(tag),
+        "created_unix": time.time(),
+        "files": files,
+    }
+    manifest.update(meta or {})
+    blob = json.dumps(manifest, indent=2, sort_keys=True)
+    atomic_io.with_retries(
+        lambda: atomic_io.atomic_write_text(
+            os.path.join(ckpt_dir, MANIFEST_FILE), blob, fsync=fsync
+        ),
+        policy=retry, op_name="manifest_write", on_retry=on_retry,
+    )
+    return manifest
+
+
+def load_manifest(ckpt_dir):
+    """Parsed manifest dict, or None when absent. Raises ValueError on an
+    unparseable or malformed manifest — that is corruption, not absence."""
+    path = os.path.join(ckpt_dir, MANIFEST_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        manifest = json.loads(atomic_io.read_text(path))
+    except (OSError, ValueError) as e:
+        raise ValueError(f"unreadable manifest {path}: {e}") from e
+    if not isinstance(manifest, dict) or not isinstance(
+        manifest.get("files"), dict
+    ):
+        raise ValueError(f"malformed manifest {path}: no files map")
+    return manifest
+
+
+def verify_checkpoint(ckpt_dir, model_file_hint="model_states", deep=True):
+    """Verdict for one checkpoint directory: ``(status, reason)`` with
+    status one of VALID / LEGACY / CORRUPT / MISSING.
+
+    ``deep=False`` skips the sha256 pass (existence + size only) — the
+    cheap scan retention/fallback ordering uses; loads verify deep.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return MISSING, f"no checkpoint directory at {ckpt_dir}"
+    try:
+        manifest = load_manifest(ckpt_dir)
+    except ValueError as e:
+        return CORRUPT, str(e)
+    if manifest is None:
+        has_model = any(
+            model_file_hint in name
+            for name in os.listdir(ckpt_dir)
+            if _is_state_file(ckpt_dir, name)
+        )
+        if has_model:
+            return LEGACY, "no manifest (pre-resilience checkpoint)"
+        return MISSING, f"no manifest and no model-states file in {ckpt_dir}"
+    for name, entry in sorted(manifest["files"].items()):
+        path = os.path.join(ckpt_dir, name)
+        if not os.path.exists(path):
+            return CORRUPT, f"manifest lists {name} but it is missing"
+        size = os.path.getsize(path)
+        if size != entry.get("size"):
+            return CORRUPT, (
+                f"{name}: size {size} != manifest {entry.get('size')}"
+            )
+        if deep:
+            try:
+                digest = file_sha256(path)
+            except OSError as e:
+                return CORRUPT, f"{name}: unreadable ({e})"
+            if digest != entry.get("sha256"):
+                return CORRUPT, f"{name}: sha256 mismatch"
+    return VALID, "manifest verified"
+
+
+def ordered_tags(save_dir):
+    """Candidate tags in ``save_dir``, newest first.
+
+    Ordering key: the manifest's ``global_steps`` (then ``created_unix``)
+    when a readable manifest exists, else the directory mtime — so
+    post-resilience checkpoints order by training progress and legacy
+    directories still slot in sensibly. Corrupt-manifest directories sort
+    by mtime like legacy ones (fallback verification rejects them later).
+    """
+    if not os.path.isdir(save_dir):
+        return []
+    entries = []
+    for name in os.listdir(save_dir):
+        path = os.path.join(save_dir, name)
+        if not os.path.isdir(path):
+            continue
+        steps, created = -1, None
+        try:
+            manifest = load_manifest(path)
+        except ValueError:
+            manifest = None
+        if manifest is not None:
+            # malformed-but-parseable values (null/strings) degrade to the
+            # mtime ordering of a corrupt manifest, never crash the scan —
+            # one bad sibling tag must not take down every save and load
+            try:
+                steps = int(manifest.get("global_steps", -1))
+            except (TypeError, ValueError):
+                steps = -1
+            created = manifest.get("created_unix")
+            if not isinstance(created, (int, float)) or isinstance(
+                created, bool
+            ):
+                created = None
+        if created is None:
+            try:
+                created = os.path.getmtime(path)
+            except OSError:
+                created = 0.0
+        entries.append((steps, float(created), name))
+    entries.sort(reverse=True)
+    return [name for _, _, name in entries]

@@ -1,0 +1,127 @@
+"""Preemption drain: turn SIGTERM/SIGINT into one final committed save.
+
+TPU-VM preemption (and most pod schedulers) delivers SIGTERM and then
+kills the process after a grace window. The handler here does NOT save
+from signal context — async-dispatched device state is not at a step
+boundary, and a checkpoint written mid-window would be garbage. Instead
+the signal ARMS a flag; the engine checks it at the next optimizer-step
+boundary (``_finish_step``), runs a normal atomic ``save_checkpoint``,
+and then lets the process exit by re-delivering the original signal with
+its original disposition restored.
+
+A second signal while armed means the operator (or scheduler) insists:
+the handler uninstalls itself and re-raises immediately, skipping the
+drain.
+"""
+
+import logging
+import os
+import signal
+import threading
+
+from ..utils.logging import log_dist
+
+DEFAULT_SIGNALS = ("SIGTERM", "SIGINT")
+
+
+def resolve_signals(names):
+    """Map config signal names to module constants, rejecting unknowns."""
+    sigs = []
+    for name in names:
+        num = getattr(signal, str(name), None)
+        if not isinstance(num, signal.Signals):
+            raise ValueError(f"unknown signal name {name!r}")
+        sigs.append(num)
+    return sigs
+
+
+class PreemptionHandler:
+    def __init__(self, signals=DEFAULT_SIGNALS, exit_after_save=True):
+        self.signals = resolve_signals(signals)
+        self.exit_after_save = bool(exit_after_save)
+        self._armed = threading.Event()
+        self._received = None
+        self._previous = {}
+        self._installed = False
+
+    @property
+    def armed(self):
+        return self._armed.is_set()
+
+    def arm(self, signum=None):
+        """Arm the save-at-next-step-boundary flag (the handler body; also
+        the cooperative entry point for schedulers that notify out-of-band
+        instead of signalling)."""
+        self._received = signum
+        self._armed.set()
+
+    def disarm(self):
+        self._armed.clear()
+        self._received = None
+
+    def _on_signal(self, signum, frame):
+        del frame
+        if self.armed:
+            # second delivery: stop draining, die the intended way
+            log_dist(
+                f"second {signal.Signals(signum).name} while draining — "
+                "exiting without waiting for the step boundary",
+                ranks=[-1], level=logging.WARNING,
+            )
+            self.resignal(signum)
+            return
+        self.arm(signum)
+        log_dist(
+            f"received {signal.Signals(signum).name}: will save a final "
+            "checkpoint at the next optimizer-step boundary, then exit",
+            ranks=[-1], level=logging.WARNING,
+        )
+
+    def install(self):
+        """Register the handlers; returns True on success. Signal handlers
+        can only live on the main thread — off-main construction (tests,
+        odd launchers) degrades to cooperative ``arm()`` with a log line
+        instead of crashing the engine."""
+        if self._installed:
+            return True
+        if threading.current_thread() is not threading.main_thread():
+            log_dist(
+                "preemption drain requested off the main thread; signal "
+                "handlers not installed (cooperative arm() still works)",
+                ranks=[-1], level=logging.WARNING,
+            )
+            return False
+        try:
+            for sig in self.signals:
+                self._previous[sig] = signal.signal(sig, self._on_signal)
+        except (ValueError, OSError) as e:
+            self.uninstall()
+            log_dist(
+                f"could not install preemption signal handlers: {e}",
+                ranks=[-1], level=logging.WARNING,
+            )
+            return False
+        self._installed = True
+        return True
+
+    def uninstall(self):
+        """Restore the original dispositions (only for handlers we own)."""
+        for sig, prev in list(self._previous.items()):
+            try:
+                if signal.getsignal(sig) == self._on_signal:
+                    signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+            del self._previous[sig]
+        self._installed = False
+
+    def resignal(self, signum=None):
+        """Restore original dispositions and re-deliver the captured
+        signal so the process exits exactly as the sender intended (exit
+        code, core-dump policy, parent's waitpid status all match a
+        non-draining process)."""
+        signum = signum if signum is not None else self._received
+        self.uninstall()
+        self.disarm()
+        if signum is not None:
+            os.kill(os.getpid(), signum)

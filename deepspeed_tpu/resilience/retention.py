@@ -1,0 +1,83 @@
+"""Retention GC: bound checkpoint disk usage without risking the restore
+path.
+
+``prune_checkpoints(save_dir, keep_last_n)`` keeps the newest
+``keep_last_n`` loadable checkpoints (manifest-valid or legacy) and
+deletes everything older. Two hard safety rules:
+
+- The newest valid checkpoint is NEVER deleted, whatever ``keep_last_n``
+  says — a retention bug must not be able to strand a job with nothing to
+  resume from.
+- The tag the ``latest`` pointer names is never deleted, even when
+  corruption pushed it out of the keep window: the pointer must never
+  dangle because of GC (fallback handles corruption; GC must not race
+  it).
+
+Corrupt or unverifiable directories do NOT consume keep slots: the scan
+walks newest-first until ``keep_last_n`` loadable checkpoints are found,
+leaving any corrupt directories interleaved among them in place (the
+restore path, not GC, owns deciding their fate); everything older than
+the last kept loadable checkpoint is deleted like any expired tag.
+"""
+
+import os
+import shutil
+
+from ..utils.logging import log_dist
+from . import atomic_io
+from . import manifest as manifest_lib
+
+
+def prune_checkpoints(save_dir, keep_last_n, protect=(), on_delete=None):
+    """Delete expired checkpoint directories; returns the deleted tags.
+
+    ``keep_last_n <= 0`` keeps everything (the default). ``protect`` is a
+    set of tag names exempt from deletion (the just-published tag and the
+    ``latest`` target). ``on_delete(tag)`` is the metrics hook.
+    """
+    if not keep_last_n or keep_last_n <= 0:
+        return []
+    protected = {str(t) for t in protect}
+    latest_path = os.path.join(save_dir, "latest")
+    if os.path.exists(latest_path):
+        try:
+            protected.add(atomic_io.read_text(latest_path).strip())
+        except OSError:
+            pass
+    kept_valid = 0
+    deleted = []
+    for tag in manifest_lib.ordered_tags(save_dir):
+        ckpt_dir = os.path.join(save_dir, tag)
+        # shallow verify: ordering + GC must stay cheap next to the save
+        # itself; deep sha verification belongs to the load path
+        status, _ = manifest_lib.verify_checkpoint(ckpt_dir, deep=False)
+        loadable = status in (manifest_lib.VALID, manifest_lib.LEGACY)
+        if kept_valid < keep_last_n:
+            if loadable:
+                kept_valid += 1
+            # corrupt dirs interleaved here ride along without consuming
+            # a keep slot (module docstring)
+            continue
+        if tag in protected:
+            continue
+        try:
+            shutil.rmtree(ckpt_dir)
+        except OSError as e:
+            log_dist(
+                f"retention: could not delete checkpoint {tag}: {e}",
+                ranks=[0],
+            )
+            continue
+        deleted.append(tag)
+        if on_delete is not None:
+            try:
+                on_delete(tag)
+            except Exception:
+                pass
+    if deleted:
+        log_dist(
+            f"retention: pruned {len(deleted)} checkpoint(s) "
+            f"(keep_last_n={keep_last_n}): {', '.join(sorted(deleted))}",
+            ranks=[0],
+        )
+    return deleted

@@ -1,4 +1,5 @@
-"""Checkpoint save/load with elastic data-parallel resharding.
+"""Checkpoint save/load with elastic data-parallel resharding and a
+crash-safe commit protocol.
 
 File-layout parity with the reference (reference:
 deepspeed/pt/deepspeed_light.py:1095-1360):
@@ -8,7 +9,24 @@ deepspeed/pt/deepspeed_light.py:1095-1360):
       sizes, client state (the reference's extra dict keys ride along).
   <dir>/<tag>/zero_pp_rank_{DP}_mp_rank_{MP:02d}optim_states.msgpack
       — this dp rank's shard of the optimizer state (one file at stage 0).
+  <dir>/<tag>/MANIFEST.json                           — per-file sha256
+      commit record (resilience/manifest.py; absent on legacy saves).
   <dir>/latest                                        — tag pointer.
+
+Commit protocol (deepspeed_tpu/resilience/, docs/resilience.md): every
+file is written tmp + fsync + ``os.replace``; after the cross-host
+barrier, process 0 hashes the completed directory into ``MANIFEST.json``
+(written last, atomically), re-verifies it, and only then publishes the
+``latest`` pointer — so a kill at ANY instant leaves either the previous
+checkpoint or a complete new one, never a torn one. The reference's
+barrier-then-tag sequencing (deepspeed_light.py:1315-1360) protected
+against racing writers but not against torn writes or mid-save kills.
+
+Loads are TRANSACTIONAL: every file is read and parsed into host memory
+(manifest-verified first when present) before a single engine field
+mutates — a truncated optimizer shard can no longer leave the engine
+half-loaded. When the ``latest``-driven tag is corrupt or missing, the
+load walks back to the newest valid tag instead of crashing.
 
 Elastic semantics (the subtlest part of the reference,
 deepspeed_zero_optimizer.py:1360-1538 / zero_optimizer_stage1.py:821-996):
@@ -25,18 +43,62 @@ Master weights are always saved in fp32 (the engine keeps fp32 masters), so
 implicitly the lossless path.
 """
 
+import logging
 import os
+import time
 
 import jax
 import numpy as np
 from flax import serialization
 
 from ..parallel import mesh as mesh_lib
-from ..utils.logging import log_dist
+from ..resilience import atomic_io
+from ..resilience import manifest as manifest_lib
+from ..resilience import retention
+from ..resilience.manager import ResilienceManager
+from ..utils.logging import log_dist, warn_once
 
 MODEL_FILE = "mp_rank_{mp:02d}_model_states.msgpack"
 OPTIM_FILE = "zero_pp_rank_{dp}_mp_rank_{mp:02d}optim_states.msgpack"
 LATEST_FILE = "latest"
+
+# engines built before the resilience wiring (or bare test doubles) share
+# one default-policy manager rather than growing one per call
+_default_manager = None
+
+
+def _resilience_of(engine):
+    global _default_manager
+    manager = getattr(engine, "resilience", None)
+    if manager is not None:
+        return manager
+    if _default_manager is None:
+        _default_manager = ResilienceManager()
+    return _default_manager
+
+
+def _write_blob(res, path, data):
+    """One checkpoint file write under the active protocol: atomic +
+    fsynced + retried when resilience is enabled, the legacy bare write
+    otherwise."""
+    if res.enabled:
+        res.retrying(
+            lambda: atomic_io.atomic_write_bytes(path, data, fsync=res.fsync),
+            op_name=f"write:{os.path.basename(path)}",
+        )
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+def _read_blob(res, path):
+    if res.enabled:
+        return res.retrying(
+            lambda: atomic_io.read_bytes(path),
+            op_name=f"read:{os.path.basename(path)}",
+        )
+    with open(path, "rb") as f:
+        return f.read()
 
 
 def _normalize_quant_padding(saved_tree, template_tree):
@@ -133,12 +195,17 @@ def _canonical_opt_state(engine):
 
 
 def save_checkpoint(engine, save_dir, tag=None, client_state=None):
-    """Multi-host write discipline (reference deepspeed_light.py:1282-1360):
-    process 0 writes the model-states file; optimizer shard files are
-    distributed round-robin over processes (the analog of every dp rank
-    writing its own zero_pp_rank file); everyone barriers; process 0 then
-    publishes the ``latest`` tag — so a tag never points at a half-written
-    checkpoint."""
+    """Multi-host write discipline (reference deepspeed_light.py:1282-1360)
+    hardened into a commit protocol: process 0 writes the model-states
+    file; optimizer shard files are distributed round-robin over processes
+    (the analog of every dp rank writing its own zero_pp_rank file);
+    everyone barriers; process 0 then writes + verifies ``MANIFEST.json``
+    and only afterwards publishes the ``latest`` tag — so the tag never
+    points at a half-written OR torn checkpoint. Raises
+    :class:`~deepspeed_tpu.resilience.CheckpointCorruptionError` when the
+    post-save verification fails (the tag is not published)."""
+    res = _resilience_of(engine)
+    started = time.monotonic()
     if tag is None:
         tag = f"global_step{engine.global_steps}"
     mp_rank = 0  # tensor-parallel state is global under GSPMD: one file
@@ -173,8 +240,7 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None):
     }
     if proc == 0:
         model_path = os.path.join(ckpt_dir, MODEL_FILE.format(mp=mp_rank))
-        with open(model_path, "wb") as f:
-            f.write(serialization.msgpack_serialize(state))
+        _write_blob(res, model_path, serialization.msgpack_serialize(state))
 
     # ---- optimizer shard files (round-robin over processes) ---------
     # Gather ONE leaf at a time and slice it into every owned rank's
@@ -221,39 +287,130 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None):
             "leaves": {str(i): a for i, a in enumerate(rank_leaves[rank])},
         }
         path = os.path.join(ckpt_dir, OPTIM_FILE.format(dp=rank, mp=mp_rank))
-        with open(path, "wb") as f:
-            f.write(serialization.msgpack_serialize(payload))
+        _write_blob(res, path, serialization.msgpack_serialize(payload))
 
     # every writer finishes before the tag becomes visible
     _barrier(f"ckpt_save_{tag}")
     if proc == 0:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(str(tag))
+        if res.enabled:
+            # commit record LAST: hash the completed directory, publish
+            # the manifest atomically, then re-verify the whole checkpoint
+            # from disk before the tag becomes reachable
+            manifest_lib.write_manifest(
+                ckpt_dir, tag,
+                meta={"global_steps": int(engine.global_steps)},
+                fsync=res.fsync, retry=res.retry, on_retry=res.on_retry,
+            )
+            status, reason = manifest_lib.verify_checkpoint(ckpt_dir)
+            if status != manifest_lib.VALID:
+                raise manifest_lib.CheckpointCorruptionError(
+                    f"post-save verification of {ckpt_dir} failed "
+                    f"({reason}); 'latest' not published — the previous "
+                    "checkpoint remains the resume point"
+                )
+            res.retrying(
+                lambda: atomic_io.atomic_write_text(
+                    os.path.join(save_dir, LATEST_FILE), str(tag),
+                    fsync=res.fsync,
+                ),
+                op_name="publish_latest",
+            )
+        else:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+        if res.enabled and res.keep_last_n > 0:
+            retention.prune_checkpoints(
+                save_dir, res.keep_last_n, protect={str(tag)},
+                on_delete=res.count_pruned,
+            )
+    res.observe_save(started)
     log_dist(f"Saved checkpoint {tag} to {save_dir}", ranks=[0])
     return True
 
 
-def load_checkpoint(
-    engine, load_dir, tag=None, load_optimizer_states=True,
-    load_lr_scheduler_states=True,
-):
-    if tag is None:
-        latest = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.exists(latest):
-            log_dist(f"No 'latest' file in {load_dir}", ranks=[0])
-            return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
+# ---------------------------------------------------------------------------
+# load: stage (parse everything on host) -> apply (mutate the engine)
+# ---------------------------------------------------------------------------
+class _Staged:
+    """Host-side parse of one checkpoint candidate: nothing here has
+    touched the engine yet."""
+
+    __slots__ = ("tag", "ckpt_dir", "state", "shards")
+
+    def __init__(self, tag, ckpt_dir, state, shards):
+        self.tag = tag
+        self.ckpt_dir = ckpt_dir
+        self.state = state
+        self.shards = shards  # list of shard payloads, or None
+
+
+def _stage_checkpoint(engine, load_dir, tag, load_optimizer_states, res):
+    """Read and parse EVERY file of checkpoint ``tag`` into host memory.
+
+    Raises on any verification/read/parse failure — the caller decides
+    whether that means fallback (latest-driven load) or a failed load
+    (explicitly requested tag). The engine is untouched either way.
+    """
     ckpt_dir = os.path.join(load_dir, str(tag))
     mp_rank = 0
+    if res.enabled and res.verify_on_load:
+        status, reason = manifest_lib.verify_checkpoint(ckpt_dir)
+        if status in (manifest_lib.CORRUPT, manifest_lib.MISSING):
+            raise manifest_lib.CheckpointCorruptionError(
+                f"checkpoint {tag}: {reason}"
+            )
+        if status == manifest_lib.LEGACY:
+            warn_once(
+                ("legacy-checkpoint", ckpt_dir),
+                "checkpoint %s has no manifest (pre-resilience save); "
+                "loading with parse-time validation only", ckpt_dir,
+            )
     model_path = os.path.join(ckpt_dir, MODEL_FILE.format(mp=mp_rank))
     if not os.path.exists(model_path):
-        log_dist(f"Checkpoint file {model_path} not found", ranks=[0])
-        return None, {}
+        raise manifest_lib.CheckpointCorruptionError(
+            f"checkpoint {tag}: model-states file {model_path} not found"
+        )
+    state = serialization.msgpack_restore(_read_blob(res, model_path))
 
-    with open(model_path, "rb") as f:
-        state = serialization.msgpack_restore(f.read())
+    shards = None
+    if load_optimizer_states:
+        saved_dp = (
+            int(state["dp_world_size"]) if state["zero_stage"] >= 1 else 1
+        )
+        rank0_path = os.path.join(
+            ckpt_dir, OPTIM_FILE.format(dp=0, mp=mp_rank)
+        )
+        if os.path.exists(rank0_path):
+            shards = []
+            for rank in range(saved_dp):
+                p = os.path.join(
+                    ckpt_dir, OPTIM_FILE.format(dp=rank, mp=mp_rank)
+                )
+                if not os.path.exists(p):
+                    # saved with fewer shard files (e.g. stage 0): stop
+                    break
+                shards.append(serialization.msgpack_restore(_read_blob(res, p)))
+            num_shards = int(shards[0]["num_shards"])
+            if len(shards) < num_shards:
+                # the payload itself declares how many rank files a
+                # complete save produces; fewer on disk means a kill
+                # between shard writes (legacy save) or deleted files —
+                # merging a partial set would concatenate short leaves
+                raise manifest_lib.CheckpointCorruptionError(
+                    f"checkpoint {tag}: optimizer state declares "
+                    f"{num_shards} shard files but only {len(shards)} "
+                    "are present"
+                )
+    return _Staged(str(tag), ckpt_dir, state, shards)
 
+
+def _apply_checkpoint(
+    engine, staged, load_optimizer_states, load_lr_scheduler_states
+):
+    """Mutate the engine from a fully staged checkpoint. Every input was
+    already parsed on host, so no file I/O (and no torn-state abort path)
+    exists past this point."""
+    state = staged.state
     # ---- module params ----------------------------------------------
     params_np = serialization.from_state_dict(
         jax.tree_util.tree_map(np.asarray, engine.params), state["module"]
@@ -307,18 +464,9 @@ def load_checkpoint(
         }
         can_leaves, can_treedef = _flatten(canonical_template)
         n_inner = len(jax.tree_util.tree_leaves(inner_template))
-        saved_dp = int(state["dp_world_size"]) if state["zero_stage"] >= 1 else 1
-        rank0_path = os.path.join(ckpt_dir, OPTIM_FILE.format(dp=0, mp=mp_rank))
         canonical = None
-        if os.path.exists(rank0_path):
-            shards = []
-            for rank in range(saved_dp):
-                p = os.path.join(ckpt_dir, OPTIM_FILE.format(dp=rank, mp=mp_rank))
-                if not os.path.exists(p):
-                    # saved with fewer shard files (e.g. stage 0): stop
-                    break
-                with open(p, "rb") as f:
-                    shards.append(serialization.msgpack_restore(f.read()))
+        shards = staged.shards
+        if shards:
             num_shards = int(shards[0]["num_shards"])
             axes = shards[0]["shard_axes"]
             splittable = shards[0]["splittable"]
@@ -410,5 +558,107 @@ def load_checkpoint(
             "inner": engine.optimizer_state["inner"],
         }
 
-    log_dist(f"Loaded checkpoint {tag} from {load_dir}", ranks=[0])
-    return os.path.join(ckpt_dir, ""), state.get("client_state", {})
+
+def load_checkpoint(
+    engine, load_dir, tag=None, load_optimizer_states=True,
+    load_lr_scheduler_states=True,
+):
+    res = _resilience_of(engine)
+    started = time.monotonic()
+    explicit_tag = tag is not None
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.exists(latest):
+            log_dist(f"No 'latest' file in {load_dir}", ranks=[0])
+            return None, {}
+        # same retry discipline as every other checkpoint read: one
+        # transient flake on the pointer must not fail the whole resume
+        if res.enabled:
+            tag = res.retrying(
+                lambda: atomic_io.read_text(latest), op_name="read:latest"
+            ).strip()
+        else:
+            tag = atomic_io.read_text(latest).strip()
+
+    # ---- candidate order --------------------------------------------
+    # The requested tag first; for latest-driven loads with fallback
+    # enabled, every other tag in the directory follows, newest first —
+    # corruption then degrades the resume point instead of killing the
+    # job. An EXPLICITLY requested tag never silently substitutes.
+    candidates = [str(tag)]
+    if not explicit_tag and res.enabled and res.fallback_on_corruption:
+        candidates += [
+            t for t in manifest_lib.ordered_tags(load_dir)
+            if t != str(tag)
+        ]
+
+    staged = None
+    for candidate in candidates:
+        try:
+            staged = _stage_checkpoint(
+                engine, load_dir, candidate, load_optimizer_states, res
+            )
+            break
+        except Exception as e:
+            level = (
+                logging.ERROR
+                if candidate == str(tag)
+                else logging.WARNING
+            )
+            log_dist(
+                f"checkpoint {candidate} in {load_dir} is not loadable: "
+                f"{e}",
+                ranks=[0], level=level,
+            )
+            res.count_corruption_fallback()
+            continue
+    if staged is None:
+        log_dist(
+            f"no loadable checkpoint found in {load_dir} "
+            f"(tried {len(candidates)} candidate tag(s))",
+            ranks=[0], level=logging.ERROR,
+        )
+        return None, {}
+    if staged.tag != str(tag):
+        log_dist(
+            f"FALLBACK: checkpoint {tag} was corrupt/missing; resuming "
+            f"from newest valid tag {staged.tag}",
+            ranks=[0], level=logging.WARNING,
+        )
+
+    # ---- cross-host agreement on the resume tag ---------------------
+    # The candidate walk is per-process; on a flaky shared mount hosts
+    # can see DIFFERENT corruption (stale attribute caches, partial
+    # visibility) and stage different tags — silently training on from
+    # mixed checkpoints. All hosts compare their staged tag and, on any
+    # mismatch, every host fails the load identically (the allgather
+    # gives all ranks the same view, so the outcome is consistent).
+    if jax.process_count() > 1:
+        import hashlib
+
+        from jax.experimental import multihost_utils
+
+        digest = hashlib.sha256(staged.tag.encode()).digest()[:8]
+        mine = np.frombuffer(digest, dtype=np.int64)
+        everyone = multihost_utils.process_allgather(mine)
+        if len(np.unique(everyone.reshape(-1))) > 1:
+            log_dist(
+                f"checkpoint tag disagreement across hosts (this host "
+                f"staged {staged.tag}); failing the load on every rank — "
+                "inspect the shared filesystem and retry",
+                ranks=[-1], level=logging.ERROR,
+            )
+            return None, {}
+
+    # ---- transactional apply ----------------------------------------
+    # everything parsed; only now does the engine mutate
+    _apply_checkpoint(
+        engine, staged, load_optimizer_states, load_lr_scheduler_states
+    )
+
+    res.observe_load(started)
+    log_dist(f"Loaded checkpoint {staged.tag} from {load_dir}", ranks=[0])
+    return (
+        os.path.join(staged.ckpt_dir, ""),
+        staged.state.get("client_state", {}),
+    )

@@ -47,7 +47,7 @@ from ..config.config import DeepSpeedConfig, DeepSpeedConfigError
 from ..ops.optimizers import Optimizer, build_optimizer
 from ..parallel import mesh as mesh_lib
 from ..parallel.mpu import TPUMpu
-from ..utils.logging import log_dist, logger
+from ..utils.logging import log_dist, logger, warn_once
 from ..utils.numerics import global_norm, has_overflow
 from ..utils.timers import SynchronizedWallClockTimer, ThroughputTimer
 from . import zero as zero_lib
@@ -478,6 +478,20 @@ class DeepSpeedEngine:
                 jax.tree_util.tree_leaves(self.optimizer_state)[0]
             ),
         )
+
+        # ---- resilience (docs/resilience.md) --------------------------
+        # Atomic-commit checkpoint protocol, retryable I/O, corruption
+        # fallback, retention GC, preemption drain — policy object handed
+        # to the checkpoint paths; metrics share the telemetry registry.
+        from ..resilience import build_resilience
+
+        self.resilience = build_resilience(self.config, telemetry=self.telemetry)
+        # SIGTERM/SIGINT arm a save-at-next-step-boundary flag checked in
+        # _finish_step (no-op unless the config enables preemption drain)
+        self.resilience.install_preemption()
+        # the drain's default save target when the config names none: the
+        # last directory this engine saved to or resumed from
+        self._last_checkpoint_dir = None
 
         # ---- dataloader -----------------------------------------------
         self.training_dataloader = None
@@ -1399,6 +1413,56 @@ class DeepSpeedEngine:
         # dispatched, so the device stays busy while we wait)
         if len(self._deferred_overflows) > 1:
             self._reconcile_deferred(keep_last=True)
+        # preemption drain: a SIGTERM/SIGINT received mid-window armed a
+        # flag; this step boundary is the first safe commit point
+        self._maybe_preemption_save()
+
+    def _maybe_preemption_save(self):
+        """Honor an armed preemption drain: commit one final checkpoint at
+        this step boundary, then exit via the original signal disposition
+        (resilience.preemption semantics, docs/resilience.md)."""
+        res = getattr(self, "resilience", None)
+        if res is None or res.preemption is None:
+            return
+        armed = res.preemption_armed
+        if jax.process_count() > 1:
+            # cross-host consensus on the drain decision: signal delivery
+            # is per-host and can straddle a step boundary, and the save
+            # path barriers — hosts entering save_checkpoint at different
+            # boundaries (or only some hosts entering) would deadlock the
+            # pod. A tiny 1-flag allgather per boundary (drain is opt-in,
+            # so this costs nothing unless preemption is enabled) makes
+            # every host see the OR of all local flags at the SAME step.
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(
+                np.asarray([armed], dtype=np.bool_)
+            )
+            armed = bool(np.any(flags))
+            if armed and not res.preemption_armed:
+                res.preemption.arm()  # mirror the remote host's signal
+        if not armed:
+            return
+        save_dir = res.preemption_save_dir or self._last_checkpoint_dir
+        if not save_dir:
+            warn_once(
+                "preemption-no-save-dir",
+                "preemption drain armed but no save target is known (no "
+                "resilience.preemption.save_dir configured and the engine "
+                "has not saved or loaded a checkpoint yet) — no final "
+                "checkpoint will be written",
+            )
+            return
+        tag = f"{res.preemption_tag_prefix}_global_step{self.global_steps}"
+        log_dist(
+            f"preemption drain: saving final checkpoint {tag} to "
+            f"{save_dir}",
+            ranks=[-1],
+        )
+        self.save_checkpoint(save_dir, tag=tag)
+        # counts the save, then exits by re-raising the captured signal
+        # (or just disarms when exit_after_save is off)
+        res.finish_preemption_save()
 
     @staticmethod
     def _monitor_scalars(lr, loss_scale, loss, gn):
@@ -1729,6 +1793,8 @@ class DeepSpeedEngine:
         # stall detection for its whole duration, not just a beat around it
         with self.telemetry.liveness_exempt():
             result = _save(self, save_dir, tag=tag, client_state=client_state or {})
+        # remember the save target: the preemption drain's default sink
+        self._last_checkpoint_dir = save_dir
         return result
 
     def load_checkpoint(
@@ -1762,4 +1828,8 @@ class DeepSpeedEngine:
             raise
         if result[0] is None:
             self._deferred_overflows = stale_flags
+        else:
+            # a successful resume makes this directory the drain's
+            # default save target too
+            self._last_checkpoint_dir = load_dir
         return result

@@ -56,6 +56,15 @@ ENGINE_METRICS = (
     ("gauge", "device/peak_bytes_in_use", "peak device HBM bytes in use"),
     ("gauge", "dataloader/queue_depth", "prefetch queue depth at the last batch handoff"),
     ("histogram", "train/window_time_ms", "host wall time per accumulation window"),
+    # resilience streams (deepspeed_tpu/resilience/, docs/resilience.md):
+    # the ResilienceManager registers into this same registry, so retry
+    # storms and corruption fallbacks export next to the loss curves
+    ("counter", "resilience/io_retries", "transient checkpoint-I/O failures retried with backoff"),
+    ("counter", "resilience/corruption_fallbacks", "corrupt/missing checkpoint candidates skipped on load"),
+    ("counter", "resilience/preemption_saves", "final checkpoints committed by the preemption drain"),
+    ("counter", "resilience/checkpoints_pruned", "checkpoint directories deleted by retention GC"),
+    ("histogram", "resilience/save_time_ms", "wall time of save_checkpoint, end to end"),
+    ("histogram", "resilience/load_time_ms", "wall time of load_checkpoint, end to end"),
 )
 
 

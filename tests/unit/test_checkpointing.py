@@ -165,4 +165,8 @@ def test_multihost_write_guard(tmp_path, monkeypatch):
     assert any("model_states" in f for f in files0), files0
     assert (d0 / "latest").read_text() == "t"
     even = {ckpt.OPTIM_FILE.format(dp=r, mp=0) for r in range(dp) if r % 2 == 0}
-    assert set(files0) == even | {ckpt.MODEL_FILE.format(mp=0)}, files0
+    # process 0 also writes the commit record (MANIFEST.json, after the
+    # barrier, before publishing `latest` — resilience commit protocol)
+    assert set(files0) == even | {
+        ckpt.MODEL_FILE.format(mp=0), "MANIFEST.json",
+    }, files0

@@ -1,0 +1,799 @@
+"""Crash-safe checkpointing / preemption resilience tests
+(deepspeed_tpu/resilience/, docs/resilience.md).
+
+Fault injection is a monkeypatched filesystem (resilience.atomic_io is
+the single I/O choke point) — no real kills: a "crash" is an exception
+raised at a chosen filesystem operation, which leaves exactly the on-disk
+state a SIGKILL at that instant would.
+
+Engine-integration tests use the smallest engine that exercises the real
+save/load paths (one Dense layer, one or two steps); the compile-heavy
+full matrix lives in test_checkpointing.py (slow-marked).
+"""
+
+import json
+import os
+import shutil
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.resilience import atomic_io, manifest, retention
+from deepspeed_tpu.resilience.atomic_io import RetryPolicy, with_retries
+from deepspeed_tpu.resilience.manager import ResilienceManager
+from deepspeed_tpu.resilience.preemption import (
+    PreemptionHandler,
+    resolve_signals,
+)
+from tests.unit.simple_model import SimpleModel, config_dict, init_model, random_dataset
+
+INPUT_DIM = 8
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+def test_retry_policy_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(backoff_base=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.5)
+
+
+def test_retry_policy_delay_doubles_and_caps():
+    p = RetryPolicy(max_attempts=5, backoff_base=1.0, backoff_max=3.0, jitter=0)
+    assert p.delay(1) == 1.0
+    assert p.delay(2) == 2.0
+    assert p.delay(3) == 3.0  # capped
+    assert p.delay(4) == 3.0
+
+
+def test_with_retries_recovers_from_transient_oserror():
+    calls = {"n": 0}
+    retries = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    result = with_retries(
+        flaky, policy=RetryPolicy(max_attempts=3, backoff_base=0.001),
+        on_retry=lambda op, attempt, e: retries.append(attempt),
+        sleep=lambda s: None,
+    )
+    assert result == "ok"
+    assert retries == [1, 2]
+
+
+def test_with_retries_exhausts_and_reraises():
+    def always_fails():
+        raise OSError("dead mount")
+
+    with pytest.raises(OSError):
+        with_retries(
+            always_fails,
+            policy=RetryPolicy(max_attempts=2, backoff_base=0.001),
+            sleep=lambda s: None,
+        )
+
+
+def test_with_retries_does_not_retry_corruption():
+    calls = {"n": 0}
+
+    def parse_error():
+        calls["n"] += 1
+        raise ValueError("truncated msgpack")
+
+    with pytest.raises(ValueError):
+        with_retries(parse_error, policy=RetryPolicy(max_attempts=5))
+    assert calls["n"] == 1  # corruption is not transient
+
+
+# ---------------------------------------------------------------------------
+# atomic writes
+# ---------------------------------------------------------------------------
+def test_atomic_write_roundtrip_no_temp_leftover(tmp_path):
+    path = tmp_path / "f.bin"
+    atomic_io.atomic_write_bytes(str(path), b"payload")
+    assert path.read_bytes() == b"payload"
+    assert [p.name for p in tmp_path.iterdir()] == ["f.bin"]
+
+
+def test_atomic_write_crash_preserves_old_content(tmp_path, monkeypatch):
+    path = tmp_path / "f.bin"
+    atomic_io.atomic_write_bytes(str(path), b"old")
+
+    def crash(src, dst):
+        raise OSError("killed mid-publish")
+
+    monkeypatch.setattr(atomic_io.os, "replace", crash)
+    with pytest.raises(OSError):
+        atomic_io.atomic_write_bytes(str(path), b"new-but-never-published")
+    monkeypatch.undo()
+    assert path.read_bytes() == b"old"  # never torn, never replaced
+    assert [p.name for p in tmp_path.iterdir()] == ["f.bin"]  # tmp cleaned
+
+
+# ---------------------------------------------------------------------------
+# manifest verdicts
+# ---------------------------------------------------------------------------
+def _fake_checkpoint(dirpath, tag="t", steps=5):
+    os.makedirs(dirpath, exist_ok=True)
+    for name, blob in (
+        ("mp_rank_00_model_states.msgpack", b"model" * 100),
+        ("zero_pp_rank_0_mp_rank_00optim_states.msgpack", b"optim" * 100),
+    ):
+        with open(os.path.join(dirpath, name), "wb") as f:
+            f.write(blob)
+    manifest.write_manifest(dirpath, tag, meta={"global_steps": steps})
+
+
+def test_manifest_verify_valid(tmp_path):
+    d = str(tmp_path / "t")
+    _fake_checkpoint(d)
+    status, reason = manifest.verify_checkpoint(d)
+    assert status == manifest.VALID, reason
+    m = json.load(open(os.path.join(d, manifest.MANIFEST_FILE)))
+    assert set(m["files"]) == {
+        "mp_rank_00_model_states.msgpack",
+        "zero_pp_rank_0_mp_rank_00optim_states.msgpack",
+    }
+    assert m["global_steps"] == 5
+
+
+def test_manifest_detects_truncation_and_bitflips(tmp_path):
+    d = str(tmp_path / "t")
+    _fake_checkpoint(d)
+    f = os.path.join(d, "mp_rank_00_model_states.msgpack")
+    blob = open(f, "rb").read()
+    open(f, "wb").write(blob[: len(blob) // 2])
+    status, reason = manifest.verify_checkpoint(d)
+    assert status == manifest.CORRUPT and "size" in reason
+    # same size, flipped byte: only the deep sha pass catches it
+    open(f, "wb").write(bytes([blob[0] ^ 0xFF]) + blob[1:])
+    status, reason = manifest.verify_checkpoint(d)
+    assert status == manifest.CORRUPT and "sha256" in reason
+    assert manifest.verify_checkpoint(d, deep=False)[0] == manifest.VALID
+
+
+def test_manifest_detects_missing_file(tmp_path):
+    d = str(tmp_path / "t")
+    _fake_checkpoint(d)
+    os.unlink(os.path.join(d, "zero_pp_rank_0_mp_rank_00optim_states.msgpack"))
+    status, reason = manifest.verify_checkpoint(d)
+    assert status == manifest.CORRUPT and "missing" in reason
+
+
+def test_manifest_legacy_and_missing_verdicts(tmp_path):
+    d = str(tmp_path / "legacy")
+    _fake_checkpoint(d)
+    os.unlink(os.path.join(d, manifest.MANIFEST_FILE))
+    assert manifest.verify_checkpoint(d)[0] == manifest.LEGACY
+    assert manifest.verify_checkpoint(str(tmp_path / "nope"))[0] == manifest.MISSING
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert manifest.verify_checkpoint(str(empty))[0] == manifest.MISSING
+
+
+def test_manifest_malformed_json_is_corrupt(tmp_path):
+    d = str(tmp_path / "t")
+    _fake_checkpoint(d)
+    open(os.path.join(d, manifest.MANIFEST_FILE), "w").write("{not json")
+    assert manifest.verify_checkpoint(d)[0] == manifest.CORRUPT
+
+
+def test_ordered_tags_survives_malformed_manifest_values(tmp_path):
+    """One sibling tag with a parseable-but-malformed manifest (null
+    global_steps, string created_unix) must degrade to mtime ordering,
+    not crash the scan every later save/load runs."""
+    _fake_checkpoint(str(tmp_path / "good"), tag="good", steps=3)
+    bad = str(tmp_path / "bad")
+    _fake_checkpoint(bad, tag="bad", steps=1)
+    m = json.load(open(os.path.join(bad, manifest.MANIFEST_FILE)))
+    m["global_steps"] = None
+    m["created_unix"] = "yesterday"
+    json.dump(m, open(os.path.join(bad, manifest.MANIFEST_FILE), "w"))
+    tags = manifest.ordered_tags(str(tmp_path))
+    assert set(tags) == {"good", "bad"}
+    assert tags[0] == "good"  # steps=3 outranks the degraded entry
+
+
+def test_ordered_tags_newest_first(tmp_path):
+    for i, tag in enumerate(["a", "b", "c"]):
+        _fake_checkpoint(str(tmp_path / tag), tag=tag, steps=i * 10)
+    assert manifest.ordered_tags(str(tmp_path)) == ["c", "b", "a"]
+    # files (e.g. `latest`) are not tags
+    (tmp_path / "latest").write_text("c")
+    assert manifest.ordered_tags(str(tmp_path)) == ["c", "b", "a"]
+
+
+# ---------------------------------------------------------------------------
+# retention GC
+# ---------------------------------------------------------------------------
+def test_retention_prunes_oldest_keeps_newest(tmp_path):
+    for i in range(5):
+        _fake_checkpoint(str(tmp_path / f"step{i}"), tag=f"step{i}", steps=i)
+    (tmp_path / "latest").write_text("step4")
+    deleted = retention.prune_checkpoints(str(tmp_path), keep_last_n=2)
+    assert sorted(deleted) == ["step0", "step1", "step2"]
+    assert sorted(p.name for p in tmp_path.iterdir() if p.is_dir()) == [
+        "step3", "step4",
+    ]
+
+
+def test_retention_zero_keeps_everything(tmp_path):
+    for i in range(3):
+        _fake_checkpoint(str(tmp_path / f"step{i}"), steps=i)
+    assert retention.prune_checkpoints(str(tmp_path), keep_last_n=0) == []
+    assert len(list(tmp_path.iterdir())) == 3
+
+
+def test_retention_never_deletes_newest_valid_or_latest_target(tmp_path):
+    # newest two tags are corrupt; the only valid one is oldest AND is the
+    # latest target — keep_last_n=1 must keep it and may drop the corrupt
+    # newer ones
+    _fake_checkpoint(str(tmp_path / "good"), tag="good", steps=0)
+    for i, tag in enumerate(["bad1", "bad2"]):
+        d = str(tmp_path / tag)
+        _fake_checkpoint(d, tag=tag, steps=10 + i)
+        os.unlink(os.path.join(d, "mp_rank_00_model_states.msgpack"))
+    (tmp_path / "latest").write_text("good")
+    retention.prune_checkpoints(str(tmp_path), keep_last_n=1)
+    assert (tmp_path / "good").is_dir()
+
+
+# ---------------------------------------------------------------------------
+# preemption handler
+# ---------------------------------------------------------------------------
+def test_resolve_signals_rejects_unknown():
+    assert resolve_signals(["SIGTERM", "SIGINT"]) == [
+        signal.SIGTERM, signal.SIGINT,
+    ]
+    with pytest.raises(ValueError):
+        resolve_signals(["SIGNOPE"])
+
+
+def test_preemption_arms_on_signal_and_disarms():
+    h = PreemptionHandler()
+    assert not h.armed
+    h._on_signal(signal.SIGTERM, None)  # handler body, no real delivery
+    assert h.armed
+    h.disarm()
+    assert not h.armed
+
+
+def test_preemption_second_signal_exits_immediately(monkeypatch):
+    h = PreemptionHandler()
+    kills = []
+    monkeypatch.setattr(
+        "deepspeed_tpu.resilience.preemption.os.kill",
+        lambda pid, sig: kills.append((pid, sig)),
+    )
+    h._on_signal(signal.SIGTERM, None)
+    assert h.armed and not kills
+    h._on_signal(signal.SIGTERM, None)  # operator insists
+    assert kills == [(os.getpid(), signal.SIGTERM)]
+    assert not h.armed
+
+
+def test_preemption_install_uninstall_restores_disposition():
+    h = PreemptionHandler(signals=("SIGUSR1",))
+    prev = signal.getsignal(signal.SIGUSR1)
+    assert h.install()
+    assert signal.getsignal(signal.SIGUSR1) == h._on_signal
+    h.uninstall()
+    assert signal.getsignal(signal.SIGUSR1) == prev
+
+
+# ---------------------------------------------------------------------------
+# config block
+# ---------------------------------------------------------------------------
+def _res_cfg(block):
+    return DeepSpeedConfig(
+        None,
+        param_dict={"train_batch_size": 8, "resilience": block},
+        world_size=1,
+    )
+
+
+def test_config_defaults():
+    cfg = DeepSpeedConfig(
+        None, param_dict={"train_batch_size": 8}, world_size=1
+    )
+    assert cfg.resilience_enabled is True
+    assert cfg.resilience_fsync is True
+    assert cfg.resilience_keep_last_n == 0
+    assert cfg.resilience_retry_max_attempts == 3
+    assert cfg.resilience_preemption_enabled is False
+    assert cfg.resilience_preemption_signals == ["SIGTERM", "SIGINT"]
+
+
+def test_config_rejects_bad_values():
+    with pytest.raises(DeepSpeedConfigError):
+        _res_cfg({"keep_last_n": -1})
+    with pytest.raises(DeepSpeedConfigError):
+        _res_cfg({"keep_last_n": True})  # bool is not a count
+    with pytest.raises(DeepSpeedConfigError):
+        _res_cfg({"retry": {"max_attempts": 0}})
+    with pytest.raises(DeepSpeedConfigError):
+        _res_cfg({"retry": {"backoff_base": 0}})
+    with pytest.raises(DeepSpeedConfigError):
+        _res_cfg({"retry": {"jitter": 2.0}})
+    with pytest.raises(DeepSpeedConfigError):
+        _res_cfg({"preemption": {"signals": ["SIGNOPE"]}})
+    with pytest.raises(DeepSpeedConfigError):
+        _res_cfg({"preemption": {"signals": "SIGTERM"}})  # bare string
+    with pytest.raises(DeepSpeedConfigError):
+        _res_cfg({"preemption": {"tag_prefix": "a/b"}})
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+def _make_engine(cfg_extra=None, seed=0):
+    model = SimpleModel(hidden_dim=8)
+    params = init_model(model, INPUT_DIM, seed=seed)
+    cfg = config_dict(batch_size=8, lr=1e-2, zero_stage=1)
+    cfg.update(cfg_extra or {})
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=cfg
+    )
+    return engine
+
+
+def _run_steps(engine, n=1, seed=0):
+    bs = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
+    x, y = random_dataset(bs * n, INPUT_DIM, seed=seed)
+    for b in range(n):
+        loss = engine(x[b * bs : (b + 1) * bs], y[b * bs : (b + 1) * bs])
+        engine.backward(loss)
+        engine.step()
+
+
+def _snapshot(engine):
+    return {
+        "params": jax.tree_util.tree_map(
+            lambda a: np.asarray(a).copy(), engine.params
+        ),
+        "opt": jax.tree_util.tree_map(
+            lambda a: np.asarray(a).copy(), engine.optimizer_state
+        ),
+        "global_steps": engine.global_steps,
+        "skipped_steps": engine.skipped_steps,
+        "micro_steps": engine.micro_steps,
+    }
+
+
+def _assert_matches(engine, snap):
+    for a, b in zip(
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, engine.params)
+        ),
+        jax.tree_util.tree_leaves(snap["params"]),
+    ):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, engine.optimizer_state)
+        ),
+        jax.tree_util.tree_leaves(snap["opt"]),
+    ):
+        np.testing.assert_array_equal(a, b)
+    assert engine.global_steps == snap["global_steps"]
+    assert engine.skipped_steps == snap["skipped_steps"]
+    assert engine.micro_steps == snap["micro_steps"]
+
+
+@pytest.fixture(scope="module")
+def saved_pair(tmp_path_factory):
+    """One engine advanced through two saves (tagA at step 1, tagB at
+    step 2) plus bitwise snapshots of the engine state at each save —
+    the corruption matrix copies this base tree per case."""
+    base = tmp_path_factory.mktemp("ckpt_base")
+    engine = _make_engine(seed=1)
+    _run_steps(engine, n=1, seed=0)
+    engine.save_checkpoint(str(base), tag="tagA")
+    snap_a = _snapshot(engine)
+    _run_steps(engine, n=1, seed=1)
+    engine.save_checkpoint(str(base), tag="tagB")
+    snap_b = _snapshot(engine)
+    return str(base), snap_a, snap_b
+
+
+@pytest.fixture(scope="module")
+def loader_engine():
+    """One reusable restore target (loads fully overwrite its state)."""
+    return _make_engine(seed=7)
+
+
+def _case_dir(tmp_path, saved_base):
+    dst = str(tmp_path / "case")
+    shutil.copytree(saved_base, dst)
+    return dst
+
+
+def test_save_writes_verified_manifest_and_latest(saved_pair):
+    base, _, _ = saved_pair
+    assert open(os.path.join(base, "latest")).read() == "tagB"
+    for tag in ("tagA", "tagB"):
+        status, reason = manifest.verify_checkpoint(os.path.join(base, tag))
+        assert status == manifest.VALID, (tag, reason)
+    m = json.load(
+        open(os.path.join(base, "tagB", manifest.MANIFEST_FILE))
+    )
+    # model file + one shard per dp rank, all hashed
+    assert len(m["files"]) == 1 + 8
+    assert all(
+        len(e["sha256"]) == 64 and e["size"] > 0 for e in m["files"].values()
+    )
+
+
+def test_clean_load_is_bitwise_identical(saved_pair, loader_engine):
+    base, _, snap_b = saved_pair
+    path, _ = loader_engine.load_checkpoint(base)
+    assert path is not None
+    _assert_matches(loader_engine, snap_b)
+
+
+# ---- the corruption matrix ------------------------------------------------
+def _corrupt_truncate(path):
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) // 3])
+
+
+def test_corrupt_truncated_model_falls_back(tmp_path, saved_pair, loader_engine):
+    base, snap_a, _ = saved_pair
+    d = _case_dir(tmp_path, base)
+    _corrupt_truncate(os.path.join(d, "tagB", "mp_rank_00_model_states.msgpack"))
+    path, _ = loader_engine.load_checkpoint(d)
+    assert path is not None and "tagA" in path
+    _assert_matches(loader_engine, snap_a)
+    snap = loader_engine.resilience.registry.snapshot()
+    assert snap["resilience/corruption_fallbacks"] >= 1
+
+
+def test_corrupt_missing_optim_shard_falls_back(tmp_path, saved_pair, loader_engine):
+    base, snap_a, _ = saved_pair
+    d = _case_dir(tmp_path, base)
+    os.unlink(
+        os.path.join(d, "tagB", "zero_pp_rank_3_mp_rank_00optim_states.msgpack")
+    )
+    path, _ = loader_engine.load_checkpoint(d)
+    assert path is not None and "tagA" in path
+    _assert_matches(loader_engine, snap_a)
+
+
+def test_corrupt_truncated_optim_shard_falls_back(tmp_path, saved_pair, loader_engine):
+    base, snap_a, _ = saved_pair
+    d = _case_dir(tmp_path, base)
+    _corrupt_truncate(
+        os.path.join(d, "tagB", "zero_pp_rank_0_mp_rank_00optim_states.msgpack")
+    )
+    path, _ = loader_engine.load_checkpoint(d)
+    assert path is not None and "tagA" in path
+    _assert_matches(loader_engine, snap_a)
+
+
+def test_latest_pointing_at_deleted_tag_falls_back(tmp_path, saved_pair, loader_engine):
+    base, snap_a, _ = saved_pair
+    d = _case_dir(tmp_path, base)
+    shutil.rmtree(os.path.join(d, "tagB"))  # latest still says tagB
+    path, _ = loader_engine.load_checkpoint(d)
+    assert path is not None and "tagA" in path
+    _assert_matches(loader_engine, snap_a)
+
+
+def test_kill_between_shard_write_and_tag_publish(tmp_path, saved_pair, loader_engine):
+    """A save killed after the shard writes but before the manifest/tag
+    publish: the torn tagC directory exists with no manifest, `latest`
+    still names tagB — the next load must resume tagB untouched."""
+    base, _, snap_b = saved_pair
+    d = _case_dir(tmp_path, base)
+    torn = os.path.join(d, "tagC")
+    shutil.copytree(os.path.join(d, "tagB"), torn)
+    os.unlink(os.path.join(torn, manifest.MANIFEST_FILE))
+    _corrupt_truncate(
+        os.path.join(torn, "zero_pp_rank_7_mp_rank_00optim_states.msgpack")
+    )
+    path, _ = loader_engine.load_checkpoint(d)
+    assert path is not None and "tagB" in path
+    _assert_matches(loader_engine, snap_b)
+
+
+def test_explicit_tag_never_silently_substitutes(tmp_path, saved_pair, loader_engine):
+    base, _, _ = saved_pair
+    d = _case_dir(tmp_path, base)
+    _corrupt_truncate(os.path.join(d, "tagB", "mp_rank_00_model_states.msgpack"))
+    path, client = loader_engine.load_checkpoint(d, tag="tagB")
+    assert path is None and client == {}
+
+
+def test_no_loadable_checkpoint_returns_none(tmp_path, saved_pair, loader_engine):
+    base, _, _ = saved_pair
+    d = _case_dir(tmp_path, base)
+    for tag in ("tagA", "tagB"):
+        _corrupt_truncate(
+            os.path.join(d, tag, "mp_rank_00_model_states.msgpack")
+        )
+    snap_before = _snapshot(loader_engine)
+    path, client = loader_engine.load_checkpoint(d)
+    assert path is None and client == {}
+    _assert_matches(loader_engine, snap_before)
+
+
+# ---- partial-restore regression (ISSUE satellite) -------------------------
+def test_partial_restore_leaves_engine_untouched(tmp_path, saved_pair, loader_engine):
+    """Regression for the pre-resilience bug: load_checkpoint overwrote
+    engine.params before optimizer shards were parsed, so a truncated
+    shard raised mid-restore and left the engine half-loaded. The
+    transactional load must leave EVERY engine field untouched when any
+    file fails to parse — including on the legacy (manifest-less) path,
+    where the failure only surfaces at msgpack parse time."""
+    base, _, _ = saved_pair
+    d = _case_dir(tmp_path, base)
+    shutil.rmtree(os.path.join(d, "tagA"))  # no fallback candidate
+    torn = os.path.join(d, "tagB")
+    os.unlink(os.path.join(torn, manifest.MANIFEST_FILE))  # legacy path
+    _corrupt_truncate(
+        os.path.join(torn, "zero_pp_rank_2_mp_rank_00optim_states.msgpack")
+    )
+    snap_before = _snapshot(loader_engine)
+    path, client = loader_engine.load_checkpoint(d)
+    assert path is None and client == {}
+    _assert_matches(loader_engine, snap_before)
+
+
+# ---- crash sweep: kill at EVERY filesystem publish during save ------------
+def test_save_crash_sweep_never_publishes_torn_checkpoint(
+    tmp_path, saved_pair, loader_engine
+):
+    """Acceptance: a simulated crash at any point during save_checkpoint
+    never leaves `latest` pointing at an incomplete checkpoint, and the
+    next load resumes a valid tag with engine state bitwise-identical to
+    that tag's save. Every checkpoint file (and the manifest and the
+    `latest` pointer) publishes through atomic_io's os.replace — crashing
+    at the k-th replace, for every k, covers every commit-order prefix."""
+    base, snap_a, snap_b = saved_pair
+    engine = _make_engine(seed=3)
+    _run_steps(engine, n=1, seed=5)
+
+    class SimulatedKill(BaseException):
+        """Not an Exception: nothing on the save path may swallow it."""
+
+    real_replace = atomic_io.os.replace
+    # count the publish ops of one full save (model + dp shards +
+    # manifest + latest) so the sweep tracks layout changes automatically
+    probe_calls = {"n": 0}
+
+    def counting_replace(src, dst):
+        probe_calls["n"] += 1
+        return real_replace(src, dst)
+
+    atomic_io.os.replace = counting_replace
+    try:
+        engine.save_checkpoint(str(tmp_path / "probe"), tag="probe")
+    finally:
+        atomic_io.os.replace = real_replace
+    n_ops = probe_calls["n"]
+    assert n_ops == 1 + engine.dp_world_size + 1 + 1
+    for k in range(n_ops):
+        workdir = str(tmp_path / f"crash{k}")
+        shutil.copytree(base, workdir)
+        calls = {"n": 0}
+
+        def crashing_replace(src, dst, _k=k, _calls=calls):
+            if _calls["n"] == _k:
+                raise SimulatedKill(f"killed at publish op {_k}")
+            _calls["n"] += 1
+            return real_replace(src, dst)
+
+        atomic_io.os.replace = crashing_replace
+        try:
+            with pytest.raises(SimulatedKill):
+                engine.save_checkpoint(workdir, tag="tagC")
+        finally:
+            atomic_io.os.replace = real_replace
+        # latest must still name a COMPLETE checkpoint...
+        latest = open(os.path.join(workdir, "latest")).read().strip()
+        status, reason = manifest.verify_checkpoint(
+            os.path.join(workdir, latest)
+        )
+        assert status == manifest.VALID, (k, latest, reason)
+        assert latest == "tagB", (k, latest)
+        # ...and the next load resumes it bitwise-identically
+        path, _ = loader_engine.load_checkpoint(workdir)
+        assert path is not None and latest in path, (k, path)
+        _assert_matches(loader_engine, snap_b)
+        shutil.rmtree(workdir)
+    # the un-crashed save publishes tagC and becomes the resume point
+    workdir = str(tmp_path / "clean")
+    shutil.copytree(base, workdir)
+    engine.save_checkpoint(workdir, tag="tagC")
+    snap_c = _snapshot(engine)
+    assert open(os.path.join(workdir, "latest")).read().strip() == "tagC"
+    path, _ = loader_engine.load_checkpoint(workdir)
+    assert path is not None and "tagC" in path
+    _assert_matches(loader_engine, snap_c)
+
+
+# ---- retry integration ----------------------------------------------------
+def test_save_retries_transient_write_failures(tmp_path, saved_pair):
+    engine = _make_engine(
+        cfg_extra={
+            "resilience": {
+                "retry": {"max_attempts": 3, "backoff_base": 0.001}
+            }
+        },
+        seed=2,
+    )
+    _run_steps(engine, n=1, seed=2)
+    real_replace = atomic_io.os.replace
+    fails = {"n": 2}  # first two publishes flake, then the mount recovers
+
+    def flaky_replace(src, dst):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("transient fuse error")
+        return real_replace(src, dst)
+
+    atomic_io.os.replace = flaky_replace
+    try:
+        assert engine.save_checkpoint(str(tmp_path), tag="t") is True
+    finally:
+        atomic_io.os.replace = real_replace
+    assert manifest.verify_checkpoint(str(tmp_path / "t"))[0] == manifest.VALID
+    snap = engine.resilience.registry.snapshot()
+    assert snap["resilience/io_retries"] == 2
+    assert snap["resilience/save_time_ms/count"] == 1
+
+
+# ---- retention integration ------------------------------------------------
+def test_keep_last_n_prunes_after_save(tmp_path):
+    engine = _make_engine(
+        cfg_extra={"resilience": {"keep_last_n": 2}}, seed=4
+    )
+    _run_steps(engine, n=1, seed=3)
+    for i in range(4):
+        engine.save_checkpoint(str(tmp_path), tag=f"s{i}")
+    dirs = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert dirs == ["s2", "s3"]
+    assert open(tmp_path / "latest").read() == "s3"
+    snap = engine.resilience.registry.snapshot()
+    assert snap["resilience/checkpoints_pruned"] == 2
+
+
+# ---- preemption drain integration -----------------------------------------
+def test_preemption_drain_saves_at_step_boundary(tmp_path):
+    engine = _make_engine(
+        cfg_extra={
+            "resilience": {
+                "preemption": {
+                    "enabled": True,
+                    "save_dir": str(tmp_path),
+                    "exit_after_save": False,  # keep the test process alive
+                }
+            }
+        },
+        seed=5,
+    )
+    try:
+        assert engine.resilience.preemption is not None
+        _run_steps(engine, n=1, seed=4)
+        assert not list(tmp_path.iterdir())  # unarmed: no drain save
+        # a SIGTERM lands mid-window: the handler only arms a flag...
+        engine.resilience.preemption._on_signal(signal.SIGTERM, None)
+        assert engine.resilience.preemption_armed
+        # ...and the next step boundary commits the final checkpoint
+        _run_steps(engine, n=1, seed=6)
+        tag = f"preempt_global_step{engine.global_steps}"
+        status, reason = manifest.verify_checkpoint(str(tmp_path / tag))
+        assert status == manifest.VALID, reason
+        assert open(tmp_path / "latest").read() == tag
+        assert not engine.resilience.preemption_armed  # disarmed after save
+        snap = engine.resilience.registry.snapshot()
+        assert snap["resilience/preemption_saves"] == 1
+        # snapshot state matches the engine bitwise (resume-ready)
+        loader = _make_engine(seed=6)
+        loader.load_checkpoint(str(tmp_path))
+        _assert_matches(loader, _snapshot(engine))
+    finally:
+        if engine.resilience.preemption is not None:
+            engine.resilience.preemption.uninstall()
+
+
+def test_preemption_exit_after_save_resignals(tmp_path, monkeypatch):
+    engine = _make_engine(
+        cfg_extra={
+            "resilience": {
+                "preemption": {"enabled": True, "save_dir": str(tmp_path)}
+            }
+        },
+        seed=8,
+    )
+    kills = []
+    monkeypatch.setattr(
+        "deepspeed_tpu.resilience.preemption.os.kill",
+        lambda pid, sig: kills.append(sig),
+    )
+    try:
+        _run_steps(engine, n=1, seed=7)
+        engine.resilience.preemption.arm(signal.SIGTERM)
+        _run_steps(engine, n=1, seed=8)
+        assert kills == [signal.SIGTERM]  # original disposition re-raised
+        tag = f"preempt_global_step{engine.global_steps}"
+        assert manifest.verify_checkpoint(str(tmp_path / tag))[0] == manifest.VALID
+    finally:
+        engine.resilience.preemption.uninstall()
+
+
+def test_preemption_without_save_target_warns_not_crashes():
+    engine = _make_engine(
+        cfg_extra={
+            "resilience": {
+                "preemption": {"enabled": True, "exit_after_save": False}
+            }
+        },
+        seed=9,
+    )
+    try:
+        engine.resilience.preemption.arm()
+        _run_steps(engine, n=1, seed=9)  # no save dir known: warns, trains on
+        assert engine.global_steps == 1
+    finally:
+        engine.resilience.preemption.uninstall()
+
+
+# ---- disabled resilience keeps the legacy write path -----------------------
+def test_resilience_disabled_writes_bare_files(tmp_path):
+    engine = _make_engine(
+        cfg_extra={"resilience": {"enabled": False}}, seed=10
+    )
+    _run_steps(engine, n=1, seed=10)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    files = sorted(p.name for p in (tmp_path / "t").iterdir())
+    assert manifest.MANIFEST_FILE not in files  # legacy layout
+    assert any("model_states" in f for f in files)
+    # and the legacy checkpoint still loads (as LEGACY, parse-validated)
+    loader = _make_engine(seed=11)
+    path, _ = loader.load_checkpoint(str(tmp_path))
+    assert path is not None
+    _assert_matches(loader, _snapshot(engine))
+
+
+# ---- telemetry integration -------------------------------------------------
+def test_resilience_shares_telemetry_registry(tmp_path):
+    engine = _make_engine(
+        cfg_extra={
+            "telemetry": {
+                "enabled": True,
+                "output_path": str(tmp_path),
+                "job_name": "job",
+                "watchdog": {"enabled": False},
+            }
+        },
+        seed=12,
+    )
+    try:
+        assert engine.resilience.registry is engine.telemetry.registry
+        _run_steps(engine, n=1, seed=12)
+        engine.save_checkpoint(str(tmp_path / "ck"))
+        engine.flush_monitor()
+        lines = [
+            json.loads(l)
+            for l in open(
+                tmp_path / "job" / "metrics.jsonl"
+            ).read().splitlines()
+        ]
+        tags = {l["tag"] for l in lines}
+        assert "resilience/io_retries" in tags
+        assert "resilience/save_time_ms" in tags
+    finally:
+        engine.telemetry.close()
