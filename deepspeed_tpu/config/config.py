@@ -341,6 +341,36 @@ class DeepSpeedConfig:
             C.RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE_DEFAULT,
         )
 
+        # data_pipeline block (runtime/staging.py, docs/performance.md)
+        dp_dict = get_dict_param(pd, C.DATA_PIPELINE)
+        self.data_pipeline_enabled = get_scalar_param(
+            dp_dict, C.DATA_PIPELINE_ENABLED, C.DATA_PIPELINE_ENABLED_DEFAULT
+        )
+        self.data_pipeline_staging_buffers = get_scalar_param(
+            dp_dict,
+            C.DATA_PIPELINE_STAGING_BUFFERS,
+            C.DATA_PIPELINE_STAGING_BUFFERS_DEFAULT,
+        )
+        self.data_pipeline_stage_to_device = get_scalar_param(
+            dp_dict,
+            C.DATA_PIPELINE_STAGE_TO_DEVICE,
+            C.DATA_PIPELINE_STAGE_TO_DEVICE_DEFAULT,
+        )
+
+        # compile_cache block (runtime/compile_cache.py)
+        cc_dict = get_dict_param(pd, C.COMPILE_CACHE)
+        self.compile_cache_enabled = get_scalar_param(
+            cc_dict, C.COMPILE_CACHE_ENABLED, C.COMPILE_CACHE_ENABLED_DEFAULT
+        )
+        self.compile_cache_dir = get_scalar_param(
+            cc_dict, C.COMPILE_CACHE_DIR, C.COMPILE_CACHE_DIR_DEFAULT
+        )
+        self.compile_cache_min_compile_time_secs = get_scalar_param(
+            cc_dict,
+            C.COMPILE_CACHE_MIN_COMPILE_SECS,
+            C.COMPILE_CACHE_MIN_COMPILE_SECS_DEFAULT,
+        )
+
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
         self.data_parallel_size = get_scalar_param(
@@ -437,6 +467,7 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(f"loss_scale must be >= 0, got {self.loss_scale}")
         self._check_telemetry()
         self._check_resilience()
+        self._check_data_pipeline()
         amp_dict = get_dict_param(self._param_dict, C.AMP)
         if amp_dict.get(C.AMP_ENABLED, bool(amp_dict)):
             # apex amp (reference deepspeed_light.py:516-521) has no TPU
@@ -612,6 +643,49 @@ class DeepSpeedConfig:
                 f"{C.RESILIENCE}.{C.RESILIENCE_PREEMPTION}."
                 f"{C.RESILIENCE_PREEMPTION_TAG_PREFIX} must be a non-empty "
                 f"path-component-safe string, got {prefix!r}"
+            )
+
+    def _check_data_pipeline(self):
+        """Validate the data_pipeline and compile_cache blocks: a typo'd
+        buffer count or cache threshold must fail at init, not as a
+        wedged staging thread / silently-disabled cache at step 1."""
+        for field, value in (
+            (f"{C.DATA_PIPELINE}.{C.DATA_PIPELINE_ENABLED}",
+             self.data_pipeline_enabled),
+            (f"{C.DATA_PIPELINE}.{C.DATA_PIPELINE_STAGE_TO_DEVICE}",
+             self.data_pipeline_stage_to_device),
+            (f"{C.COMPILE_CACHE}.{C.COMPILE_CACHE_ENABLED}",
+             self.compile_cache_enabled),
+        ):
+            if not isinstance(value, bool):
+                raise DeepSpeedConfigError(
+                    f"{field} must be a boolean, got {value!r}"
+                )
+        if (
+            not isinstance(self.data_pipeline_staging_buffers, int)
+            or isinstance(self.data_pipeline_staging_buffers, bool)
+            or self.data_pipeline_staging_buffers < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.DATA_PIPELINE}.{C.DATA_PIPELINE_STAGING_BUFFERS} must "
+                f"be an integer >= 1 (2 = double buffering), got "
+                f"{self.data_pipeline_staging_buffers!r}"
+            )
+        if not isinstance(self.compile_cache_dir, str):
+            raise DeepSpeedConfigError(
+                f"{C.COMPILE_CACHE}.{C.COMPILE_CACHE_DIR} must be a path "
+                f"string ('' for the default), got {self.compile_cache_dir!r}"
+            )
+        secs = self.compile_cache_min_compile_time_secs
+        if (
+            not isinstance(secs, (int, float))
+            or isinstance(secs, bool)
+            or secs < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.COMPILE_CACHE}.{C.COMPILE_CACHE_MIN_COMPILE_SECS} must "
+                f"be a number >= 0 seconds (0 caches everything), got "
+                f"{secs!r}"
             )
 
     def _do_warning_check(self):

@@ -366,6 +366,39 @@ RESILIENCE_PREEMPTION_TAG_PREFIX_DEFAULT = "preempt"
 RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE = "exit_after_save"
 RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE_DEFAULT = True
 
+# Overlapped input staging (deepspeed_tpu/runtime/staging.py,
+# docs/performance.md "Input pipeline & compile cache"). While window N
+# computes on device, a background worker pulls window N+1's micro-batches,
+# host-stacks them into the [accum, ...] layout, and issues the async
+# device_put into the target shardings — the TPU analog of the reference's
+# pinned-memory DeepSpeedDataLoader workers (deepspeed_dataloader.py).
+DATA_PIPELINE = "data_pipeline"
+DATA_PIPELINE_ENABLED = "enabled"
+DATA_PIPELINE_ENABLED_DEFAULT = False
+# Max staged-but-unconsumed windows (2 = double buffering). Each buffered
+# window holds one accumulation window of inputs on device — size against
+# input HBM, not host RAM.
+DATA_PIPELINE_STAGING_BUFFERS = "staging_buffers"
+DATA_PIPELINE_STAGING_BUFFERS_DEFAULT = 2
+# Issue the device_put on the staging worker (true) or only overlap the
+# host pull+stack and place on the consuming thread (false).
+DATA_PIPELINE_STAGE_TO_DEVICE = "stage_to_device"
+DATA_PIPELINE_STAGE_TO_DEVICE_DEFAULT = True
+
+# Persistent XLA compilation cache (deepspeed_tpu/runtime/compile_cache.py):
+# armed at initialize() so post-preemption restarts reuse compiled programs
+# instead of paying minutes of recompiles. cache_dir "" =>
+# ~/.cache/deepspeed_tpu/jax_cache.
+COMPILE_CACHE = "compile_cache"
+COMPILE_CACHE_ENABLED = "enabled"
+COMPILE_CACHE_ENABLED_DEFAULT = False
+COMPILE_CACHE_DIR = "cache_dir"
+COMPILE_CACHE_DIR_DEFAULT = ""
+# Programs that compile faster than this are not persisted (cache I/O would
+# cost more than the recompile). 0 caches everything — useful in tests.
+COMPILE_CACHE_MIN_COMPILE_SECS = "min_compile_time_secs"
+COMPILE_CACHE_MIN_COMPILE_SECS_DEFAULT = 1.0
+
 #############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,
 # which delegated model parallelism to an external mpu object)

@@ -18,6 +18,8 @@ Accepted datasets: torch-style map datasets (__len__/__getitem__), tuples of
 numpy/jnp arrays (sliced along dim 0), or any iterable of ready batches.
 """
 
+import weakref
+
 import numpy as np
 
 from ..parallel import mesh as mesh_lib
@@ -34,6 +36,31 @@ def _default_collate(samples):
     return (np.stack([np.asarray(s) for s in samples]),)
 
 
+class _StagedEpochIterator:
+    """Iterator over one staged epoch. ``already_staged`` tells
+    engine.train_batch the batches are device-resident already (the
+    loader's staging worker placed them), so it must not layer a SECOND
+    stager on top — that would add another worker thread and double-
+    buffer duplicate copies of every window. (The fused dispatch still
+    pays a device-side [1, ...]-stack + reshard of the placed batch;
+    this path only exists at accum == 1, where that is one cheap
+    device-to-device op, not a host retransfer.)"""
+
+    already_staged = True
+
+    def __init__(self, gen):
+        self._gen = gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self):
+        self._gen.close()
+
+
 class DeepSpeedDataLoader:
     def __init__(
         self,
@@ -47,6 +74,9 @@ class DeepSpeedDataLoader:
         tput_timer=None,
         prefetch=2,
         telemetry=None,
+        stage_to_device=False,
+        staging_buffers=2,
+        device_place=True,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -58,11 +88,27 @@ class DeepSpeedDataLoader:
         self.tput_timer = tput_timer
         self.prefetch = prefetch
         # telemetry (engine's Telemetry facade): the dataloader/queue_depth
-        # gauge reads the prefetch queue at each batch handoff — a queue
-        # pinned at 0 means the host data path, not the device, bounds
-        # throughput
+        # gauge samples the prefetch queue at each batch handoff AND from
+        # the producer side (each enqueue), so the refill at an epoch
+        # boundary is visible instead of the gauge sticking at the
+        # previous epoch's drained 0. A queue pinned at 0 means the host
+        # data path, not the device, bounds throughput.
         self.telemetry = telemetry
+        # data_pipeline staging (runtime/staging.py): assemble AND
+        # device_put on the worker thread — the window stager with
+        # accum=1. Requires a mesh (placement is the whole point).
+        self.stage_to_device = stage_to_device
+        self.staging_buffers = staging_buffers
+        # device_place=False yields HOST batches even with a mesh: the
+        # consumer (the engine's fused window stager at accum > 1) will
+        # stack and place the whole window itself — pre-placed batches
+        # would make it restack device-side and transfer twice.
+        self.device_place = device_place or stage_to_device
         self._epoch = 0
+        # ALL live staged epoch iterators (a user can hold a partially
+        # consumed epoch while starting another): close_staging must
+        # reach every worker, not just the newest
+        self._live_staged_iters = weakref.WeakSet()
 
         import jax
 
@@ -109,9 +155,32 @@ class DeepSpeedDataLoader:
         self._epoch = epoch
 
     def __iter__(self):
+        it = self._iter_impl()
+        if self.stage_to_device and self.mesh is not None:
+            # marker wrapper: batches are already device-placed by this
+            # loader's staging worker; engine.train_batch sees the
+            # attribute and skips its own window stager instead of
+            # re-stacking placed arrays device-side and re-transferring
+            it = _StagedEpochIterator(it)
+            self._live_staged_iters.add(it)
+        return it
+
+    def close_staging(self):
+        """Stop this loader's staging workers mid-epoch (idempotent;
+        no-op for exhausted epochs and unstaged loaders). The engine's
+        close_data_pipeline()/preemption-exit drain calls this so a
+        loader-owned worker cannot outlive the teardown."""
+        for it in list(self._live_staged_iters):
+            it.close()
+        self._live_staged_iters.clear()
+
+    def _iter_impl(self):
         if self.tput_timer is not None:
             self.tput_timer.update_epoch_count()
         if self._mode == "iterable":
+            if self.stage_to_device and self.mesh is not None:
+                yield from self._iter_staged(iter(self.dataset))
+                return
             for batch in self.dataset:
                 yield self._place(batch)
             return
@@ -152,14 +221,34 @@ class DeepSpeedDataLoader:
                 )
             return self.collate_fn([self.dataset[int(i)] for i in idx])
 
+        if self.stage_to_device and self.mesh is not None:
+            yield from self._iter_staged(assemble(b) for b in range(nb))
+            return
+
         if self.prefetch and self.prefetch > 0:
             counter = iter(range(nb))
+            qref = []
 
             def producer():
                 b = next(counter)  # StopIteration ends the stream
-                return assemble(b)
+                batch = assemble(b)
+                if self.telemetry is not None:
+                    # producer-side depth sample (+1 for the batch about
+                    # to enqueue): the handoff-only sampling left the
+                    # gauge stuck at 0 between epochs while the new
+                    # epoch's queue was in fact refilling. The worker
+                    # thread starts inside make_prefetch_queue, so the
+                    # first batches can be produced before qref is
+                    # populated — report the one-in-flight batch then
+                    # rather than skip the refill burst entirely.
+                    q = qref[0] if qref else None
+                    self.telemetry.set_dataloader_depth(
+                        q.qsize() + 1 if q is not None else 1
+                    )
+                return batch
 
             q = host_ops.make_prefetch_queue(producer, capacity=self.prefetch)
+            qref.append(q)
             try:
                 timeouts = 0
                 while True:
@@ -203,10 +292,56 @@ class DeepSpeedDataLoader:
             for b in range(nb):
                 yield self._place(assemble(b))
 
+    def _iter_staged(self, host_batches):
+        """Serve one epoch through the window stager (runtime/staging.py)
+        with accum=1: batch assembly AND the sharded device_put run on
+        the staging worker, so the consuming train loop receives
+        device-resident batches. Drains cleanly on early exit (a break
+        mid-epoch closes the worker via the finally)."""
+        from .staging import WindowStager
+
+        # like the engine path, withhold a DISABLED facade entirely so the
+        # worker skips per-batch nbytes bookkeeping (duck-typed stubs
+        # without an `enabled` attribute still pass through)
+        tel = self.telemetry
+        if tel is not None and not getattr(tel, "enabled", True):
+            tel = None
+        stager = WindowStager(
+            # 1-tuple-wrap so the stager never re-wraps: the raw batch
+            # (tuple OR bare array) round-trips unchanged through the
+            # identity stack below
+            source=((b,) for b in host_batches),
+            accum=1,
+            stack_fn=lambda batches: batches[0][0],
+            place_fn=self._place_arrays,
+            buffers=self.staging_buffers,
+            stage_to_device=True,
+            telemetry=tel,
+            name="dataloader",
+        )
+        try:
+            while True:
+                try:
+                    window = stager.get_window()
+                except StopIteration:
+                    break
+                if self.telemetry is not None:
+                    # mirror the stager's buffer occupancy onto the legacy
+                    # prefetch-depth gauge so dashboards read one stream
+                    self.telemetry.set_dataloader_depth(stager.occupancy())
+                if self.tput_timer is not None:
+                    self.tput_timer.start()
+                yield window.arrays
+        finally:
+            stager.close()
+
     def _place(self, batch):
         if self.tput_timer is not None:
             self.tput_timer.start()
-        if self.mesh is None:
+        return self._place_arrays(batch)
+
+    def _place_arrays(self, batch):
+        if self.mesh is None or not self.device_place:
             return batch
         import jax
 

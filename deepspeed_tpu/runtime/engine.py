@@ -37,6 +37,7 @@ here, by design):
 """
 
 import inspect
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -71,6 +72,15 @@ TRAIN_BATCH_TIMER = "train_batch_window"
 # sentinel: forward() already folded this micro-step's grads into the
 # donated accumulation buffer (fwd_bwd_into); backward() only bookkeeps
 _GRADS_ACCUMULATED = object()
+
+
+def _split_window_keys(rng, accum):
+    """One window's RNG advance: ``(new_rng, [accum] keys)``. The single
+    authority for BOTH the unstaged dispatch and the window stager's
+    pre-split (runtime/staging.py) — staged and unstaged runs must
+    produce bit-identical key streams."""
+    rng, sub = jax.random.split(rng)
+    return rng, jax.random.split(sub, accum)
 
 
 def _split_model_output(out):
@@ -180,6 +190,14 @@ class DeepSpeedEngine:
 
         self.dp_world_size = dp_size
         self.mp_world_size = dict(self._mesh.shape).get(mesh_lib.MODEL_AXIS, 1)
+
+        # ---- persistent compile cache ---------------------------------
+        # Armed BEFORE any engine compile so restarts (incl. preemption
+        # restarts) reuse compiled programs (runtime/compile_cache.py,
+        # docs/performance.md). No-op unless the config block enables it.
+        from .compile_cache import configure_compile_cache
+
+        configure_compile_cache(self.config)
 
         # ---- model ----------------------------------------------------
         self.module = model
@@ -492,6 +510,28 @@ class DeepSpeedEngine:
         # the drain's default save target when the config names none: the
         # last directory this engine saved to or resumed from
         self._last_checkpoint_dir = None
+
+        # ---- input staging pipeline (runtime/staging.py) --------------
+        # Double-buffered async window staging: while window N computes,
+        # window N+1 is pulled/stacked/device_put on a background worker.
+        # The stager is created lazily at the first iterator-fed
+        # train_batch() and torn down on source change, exhaustion, or
+        # preemption drain.
+        self._staging_enabled = self.config.data_pipeline_enabled
+        self._staging_buffers = self.config.data_pipeline_staging_buffers
+        self._stage_to_device = self.config.data_pipeline_stage_to_device
+        self._stager = None
+        self._stager_source = None
+        self._stager_finalizer = None
+        # consecutive source replacements whose stager served <= 1 window:
+        # the fingerprint of fresh per-call iterators (iter(list) each
+        # step), where staging is pure thread churn — see _ensure_stager
+        self._stager_churn = 0
+        self._last_unstaged_source = None
+        # loaders built by deepspeed_io, weakly held: close_data_pipeline
+        # must reach LOADER-owned staging workers (the accum==1
+        # stage_to_device path) too, not only the engine-owned stager
+        self._data_loaders = []
 
         # ---- dataloader -----------------------------------------------
         self.training_dataloader = None
@@ -1453,6 +1493,17 @@ class DeepSpeedEngine:
                 "checkpoint will be written",
             )
             return
+        if res.preemption_exit_after_save:
+            # the process exits after this save: stop the staging workers
+            # (the engine's window stager AND loader-owned ones) so none
+            # is mid-device_put at exit (bounded waits only — close()
+            # cannot stall the drain). Staged-but-unconsumed windows are
+            # dropped; the restart replays the data order from this
+            # checkpoint. When the drain KEEPS training (exit_after_save
+            # false), the pipeline stays attached — closing it would
+            # silently skip the windows already pulled from the live
+            # iterator.
+            self.close_data_pipeline()
         tag = f"{res.preemption_tag_prefix}_global_step{self.global_steps}"
         log_dist(
             f"preemption drain: saving final checkpoint {tag} to "
@@ -1557,12 +1608,38 @@ class DeepSpeedEngine:
         """Native fast path: run a full accumulation window (forward,
         accumulate, update) as ONE compiled program and return the mean
         unscaled loss. Semantically equivalent to
-        gradient_accumulation_steps x (forward()+backward()) + step()."""
+        gradient_accumulation_steps x (forward()+backward()) + step().
+
+        With the ``data_pipeline`` config block enabled and a PERSISTENT
+        iterator passed (the same iterator object across calls — a
+        generator, ``itertools.cycle``, a dataloader iterator), the
+        window is served by the background stager (runtime/staging.py):
+        window N+1 is pulled, stacked, and device_put while window N
+        computes, so its host-side assembly leaves the critical path.
+        Numerics (params, loss, RNG stream) are identical either way.
+        """
         accum = self.gradient_accumulation_steps()
+        if self._staging_enabled and not self.host_offload:
+            stager = self._ensure_stager(batch_iter_or_batches)
+            if stager is not None:
+                return self._train_batch_staged(stager, accum)
         it = iter(batch_iter_or_batches)
         batches = []
         for _ in range(accum):
-            batch = next(it)
+            try:
+                batch = next(it)
+            except StopIteration:
+                if not batches:
+                    # clean end-of-data AT a window boundary: the natural
+                    # end-of-stream signal, propagated for callers looping
+                    # "until the data runs out"
+                    raise
+                # mid-window dry is a data-sizing bug: a bare
+                # StopIteration here would silently terminate any
+                # enclosing generator instead of surfacing the raggedness
+                from .staging import ragged_window_error
+
+                raise ragged_window_error(len(batches), accum) from None
             if not isinstance(batch, (tuple, list)):
                 batch = (batch,)
             batches.append(tuple(batch))
@@ -1577,14 +1654,6 @@ class DeepSpeedEngine:
             self.step()
             return jnp.mean(jnp.stack(losses))
 
-        def stack_leaf(*xs):
-            # Stack host leaves on host so the window goes to devices ONCE,
-            # directly in its target sharding; a device-side jnp.stack would
-            # stage the whole unsharded window through the default device.
-            if any(isinstance(x, jax.Array) for x in xs):
-                return jnp.stack([jnp.asarray(x) for x in xs])
-            return np.stack([np.asarray(x) for x in xs])
-
         if self.telemetry.enabled:
             self.telemetry.on_window_start()
             for batch in batches:
@@ -1593,11 +1662,172 @@ class DeepSpeedEngine:
             # whole-window wall clock (start() fences outstanding device
             # work); the async fast path is untouched when breakdown is off
             self.timers(TRAIN_BATCH_TIMER).start()
-        stacked = jax.tree_util.tree_map(stack_leaf, *batches)
+        stacked = self._stack_window(batches)
         stacked = self._shard_window_batch(stacked)
-        self._rng, sub = jax.random.split(self._rng)
-        keys = jax.random.split(sub, accum)
+        self._rng, keys = _split_window_keys(self._rng, accum)
+        return self._run_window(stacked, keys, accum)
 
+    @staticmethod
+    def _stack_window(batches):
+        """Host-stack a window's micro-batches into the [accum, ...]
+        layout. Stacking host leaves on host means the window goes to
+        devices ONCE, directly in its target sharding; a device-side
+        jnp.stack would stage the whole unsharded window through the
+        default device."""
+        def stack_leaf(*xs):
+            if any(isinstance(x, jax.Array) for x in xs):
+                return jnp.stack([jnp.asarray(x) for x in xs])
+            return np.stack([np.asarray(x) for x in xs])
+
+        return jax.tree_util.tree_map(stack_leaf, *batches)
+
+    def _ensure_stager(self, source):
+        """Return the window stager serving ``source``, creating it on
+        first sight. Returns None (= run unstaged) when staging cannot
+        help: non-iterator sources, batches a loader already staged, or
+        a caller passing a FRESH iterator object every window (detected
+        by churn) — those give the stager nothing to pull ahead from, so
+        staging would only add thread churn."""
+        if self._stager is not None:
+            if source is self._stager_source:
+                return self._stager
+            # new source: the old stream's staged windows belong to a
+            # dead timeline. Count it toward the churn guard, and make
+            # any discarded pulled-ahead data visible — it was consumed
+            # from the PREVIOUS iterator and will not be trained on.
+            dropped = self._stager.unconsumed_micro_batches()
+            if dropped:
+                warn_once(
+                    "stager-source-changed-dropped-data",
+                    "window stager torn down on a source change with %d "
+                    "staged-but-unconsumed micro-batches (already pulled "
+                    "from the previous iterator) — alternating live "
+                    "iterators across train_batch() calls loses their "
+                    "prefetched items; exhaust one stream before "
+                    "switching, or disable data_pipeline staging",
+                    dropped,
+                )
+            churned = self._stager.windows_served <= 1
+            self._close_stager()
+            self._stager_churn = self._stager_churn + 1 if churned else 0
+        if self._stager_churn >= 2:
+            # two consecutive single-window stagers: the caller passes a
+            # fresh iterator per call — stop paying a thread per window.
+            # NOT a permanent latch: seeing the SAME source twice means
+            # the caller switched to a persistent iterator (e.g. fresh-
+            # iterator compile warmups followed by the real loop), so
+            # staging re-engages.
+            if source is not self._last_unstaged_source:
+                self._last_unstaged_source = source
+                warn_once(
+                    "stager-fresh-iterator-churn",
+                    "data_pipeline staging paused for this engine: "
+                    "train_batch() keeps receiving a NEW iterator object "
+                    "per window, so nothing can be staged ahead — pass "
+                    "one persistent iterator (a generator / "
+                    "itertools.cycle / a dataloader iterator) to overlap "
+                    "input staging",
+                )
+                return None
+            self._stager_churn = 0
+            self._last_unstaged_source = None
+        if getattr(source, "already_staged", False):
+            # the loader's staging worker already assembled AND placed
+            # these batches (accum == 1 only); a second stager here would
+            # double-buffer duplicate windows on another thread. Dispatch
+            # still restacks the placed batch to [1, ...] on device — a
+            # cheap device-to-device op at accum == 1.
+            return None
+        try:
+            if iter(source) is not source:
+                return None
+        except TypeError:
+            return None
+        from .staging import WindowStager
+
+        # The stager owns the RNG chain while attached: keys are
+        # pre-split at staging time and the post-split state rides each
+        # window back into self._rng at consume time. telemetry/meta are
+        # withheld entirely when telemetry is off — the unstaged path
+        # counts tokens only under the same condition, and the worker
+        # skips the bookkeeping tree walks for a no-op facade.
+        # The worker must not pin this engine (params + optimizer state)
+        # beyond its life: place_fn holds a WEAK engine ref, and the
+        # finalizer below closes the stager when the engine is collected
+        # — an abandoned engine (sweep, notebook rebuild) cannot leak its
+        # staging thread or its memory.
+        tel_on = self.telemetry.enabled
+        eref = weakref.ref(self)
+
+        def place_fn(stacked):
+            engine = eref()
+            if engine is None:  # pragma: no cover - finalizer races this
+                raise RuntimeError("engine dropped while staging")
+            return engine._shard_window_batch(stacked)
+
+        self._stager = WindowStager(
+            source=source,
+            accum=self.gradient_accumulation_steps(),
+            stack_fn=self._stack_window,
+            place_fn=place_fn,
+            rng=self._rng,
+            split_fn=_split_window_keys,
+            meta_fn=self._batch_tokens if tel_on else None,
+            buffers=self._staging_buffers,
+            stage_to_device=self._stage_to_device,
+            telemetry=self.telemetry if tel_on else None,
+        )
+        self._stager_source = source
+        self._stager_finalizer = weakref.finalize(self, self._stager.close)
+        return self._stager
+
+    def close_data_pipeline(self):
+        """Public teardown for the staged input pipeline: stop the
+        background staging workers — the engine's window stager AND any
+        staging worker owned by a deepspeed_io-built loader — and drop
+        staged-but-unconsumed windows. Runs automatically on source
+        exhaustion, source change, engine garbage collection, and
+        preemption exit — call it explicitly when abandoning an engine
+        mid-stream to release the workers immediately."""
+        self._close_stager()
+        for ref in self._data_loaders:
+            loader = ref()
+            if loader is not None:
+                loader.close_staging()
+
+    def _close_stager(self):
+        if self._stager is not None:
+            if self._stager_finalizer is not None:
+                self._stager_finalizer.detach()
+                self._stager_finalizer = None
+            self._stager.close()
+            self._stager = None
+            self._stager_source = None
+
+    def _train_batch_staged(self, stager, accum):
+        """Consume one pre-staged window: inputs are already host-stacked
+        (and, with stage_to_device, already on device in their target
+        shardings) — dispatch is all that's left on the critical path."""
+        try:
+            window = stager.get_window()
+        except Exception:
+            # clean exhaustion (StopIteration) and staging failures alike
+            # end this stream
+            self._close_stager()
+            raise
+        if self.telemetry.enabled:
+            self.telemetry.on_window_start()
+            self.telemetry.count_batch(window.tokens, window.samples)
+        if self.wall_clock_breakdown:
+            self.timers(TRAIN_BATCH_TIMER).start()
+        # adopt the pre-split chain (see _split_window_keys)
+        self._rng = window.rng_after
+        return self._run_window(window.arrays, window.keys, accum)
+
+    def _run_window(self, stacked, keys, accum):
+        """Dispatch one stacked window through the fused program and do
+        the post-update bookkeeping — the shared tail of the staged and
+        unstaged train_batch paths."""
         lr = jnp.float32(self._current_lr())
         mom = jnp.float32(self._current_mom())
         (
@@ -1740,7 +1970,21 @@ class DeepSpeedEngine:
         if batch_size is None:
             batch_size = self.train_micro_batch_size_per_gpu() * self.dp_world_size
         is_train = route == C.ROUTE_TRAIN
-        return DeepSpeedDataLoader(
+        # data_pipeline staging (runtime/staging.py): the loader runs the
+        # window stager itself with accum=1 ONLY when one micro-batch IS
+        # the window AND the config stages to device — then its batches
+        # arrive pre-placed and train_batch skips its own stager (the
+        # already_staged marker). In EVERY other staging-enabled train
+        # case the engine's window stager consumes the loader, so the
+        # loader must yield HOST batches: pre-placed ones would make the
+        # window restack through the default device and transfer twice.
+        # (The unfused loop places per micro-batch in forward(), same as
+        # a mesh-less loader.)
+        loader_stages = (
+            is_train and self._staging_enabled and self._stage_to_device
+            and self.gradient_accumulation_steps() == 1
+        )
+        loader = DeepSpeedDataLoader(
             dataset,
             batch_size=batch_size,
             mesh=self._mesh,
@@ -1748,7 +1992,16 @@ class DeepSpeedEngine:
             shuffle=is_train,  # the reference's DistributedSampler shuffles
             tput_timer=self.tput_timer if is_train else None,
             telemetry=self.telemetry if is_train else None,
+            stage_to_device=loader_stages,
+            staging_buffers=self._staging_buffers,
+            device_place=(
+                loader_stages or not (is_train and self._staging_enabled)
+            ),
         )
+        # weak: tracking for close_data_pipeline must not pin the
+        # loader (and its dataset) to the engine's lifetime
+        self._data_loaders.append(weakref.ref(loader))
+        return loader
 
     # ------------------------------------------------------------------
     # profiling (the TPU analog of the reference's wall-clock breakdown +

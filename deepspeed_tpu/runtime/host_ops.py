@@ -12,6 +12,7 @@ plain source checkout.
 import logging
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -137,12 +138,23 @@ class _PyPrefetchQueue:
 
     def stop(self):
         self._stop.set()
-        # drain so the producer thread is not blocked on put()
-        try:
-            while True:
-                self._q.get_nowait()
-        except queue.Empty:
-            pass
+        # drain so the producer thread is not blocked on put(), and JOIN
+        # (bounded) so stop() normally means stopped: callers checking
+        # for leaked worker threads (preemption drain, tests) must not
+        # race a producer that re-enqueued between one drain pass and
+        # the stop check. The deadline stays SHORT: a producer stuck in
+        # a slow user __getitem__ would otherwise stall every
+        # early-terminated epoch's teardown here — it is a daemon
+        # thread, so giving up on the join leaks nothing past process
+        # exit.
+        deadline = time.monotonic() + 1.0
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
 
 
 def make_prefetch_queue(producer, capacity=4):

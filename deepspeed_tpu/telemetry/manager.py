@@ -27,6 +27,7 @@ from .profiling import ProfilerWindow
 from .registry import (
     DEFAULT_TIME_BUCKETS_MS,
     MetricsRegistry,
+    install_compile_cache_hook,
     install_recompile_hook,
 )
 from .watchdog import StepHeartbeatWatchdog
@@ -52,9 +53,18 @@ ENGINE_METRICS = (
     ("gauge", "train/skipped_steps", "windows skipped by overflow/non-finite grad norm"),
     ("gauge", "train/micro_steps", "micro-steps (forward+backward) run"),
     ("counter", "jax/recompiles", "XLA backend compiles (growth after warmup = recompile storm)"),
+    ("counter", "jax/compile_cache_hits", "persistent-compile-cache hits (programs loaded instead of recompiled; runtime/compile_cache.py)"),
+    ("counter", "jax/compile_cache_misses", "persistent-compile-cache misses (programs compiled and written to the cache)"),
     ("gauge", "device/bytes_in_use", "device HBM bytes in use (0 when the platform reports none)"),
     ("gauge", "device/peak_bytes_in_use", "peak device HBM bytes in use"),
-    ("gauge", "dataloader/queue_depth", "prefetch queue depth at the last batch handoff"),
+    # dataloader/* is the data-pipeline namespace (docs/performance.md
+    # "Input pipeline & compile cache"): the loader's prefetch queue and
+    # the window stager (runtime/staging.py) export here together
+    ("gauge", "dataloader/queue_depth", "prefetch queue depth (sampled at batch handoff AND from the producer, so epoch-boundary refill is visible)"),
+    ("gauge", "dataloader/staging_occupancy", "staged-but-unconsumed windows in the staging buffers"),
+    ("counter", "dataloader/h2d_bytes", "host->device bytes dispatched by the input-staging pipeline"),
+    ("histogram", "dataloader/staging_wait_ms", "critical-path wait for a staged window at dispatch (near-zero = staging fully overlapped with device compute)"),
+    ("histogram", "dataloader/staging_time_ms", "background wall time to assemble one window (pull + stack + device_put dispatch)"),
     ("histogram", "train/window_time_ms", "host wall time per accumulation window"),
     # resilience streams (deepspeed_tpu/resilience/, docs/resilience.md):
     # the ResilienceManager registers into this same registry, so retry
@@ -98,6 +108,10 @@ class Telemetry:
         for kind, name, help_text in ENGINE_METRICS:
             getattr(self.registry, kind)(name, help=help_text)
         install_recompile_hook(self.registry.counter("jax/recompiles"))
+        install_compile_cache_hook(
+            self.registry.counter("jax/compile_cache_hits"),
+            self.registry.counter("jax/compile_cache_misses"),
+        )
         if self.watchdog is not None:
             self.watchdog.start()
             # the polling thread keeps the watchdog itself alive, so a
@@ -212,6 +226,33 @@ class Telemetry:
         if not self.enabled:
             return
         self.registry.gauge("dataloader/queue_depth").set(depth)
+
+    # -- window-stager hooks (runtime/staging.py; called from BOTH the
+    # consuming thread and the staging worker — registry ops are
+    # thread-safe attribute updates) -----------------------------------
+    def set_staging_occupancy(self, depth):
+        if not self.enabled:
+            return
+        self.registry.gauge("dataloader/staging_occupancy").set(depth)
+
+    def observe_staging_wait(self, ms):
+        if not self.enabled:
+            return
+        self.registry.histogram(
+            "dataloader/staging_wait_ms", buckets=DEFAULT_TIME_BUCKETS_MS
+        ).observe(ms)
+
+    def observe_staging_time(self, ms):
+        if not self.enabled:
+            return
+        self.registry.histogram(
+            "dataloader/staging_time_ms", buckets=DEFAULT_TIME_BUCKETS_MS
+        ).observe(ms)
+
+    def count_h2d_bytes(self, nbytes):
+        if not self.enabled:
+            return
+        self.registry.counter("dataloader/h2d_bytes").inc(nbytes)
 
     # -- internals ------------------------------------------------------
     def _materialize(self, loss, grad_norm, loss_scale, lr, now):

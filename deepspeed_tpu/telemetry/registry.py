@@ -175,8 +175,18 @@ class MetricsRegistry:
 # ---------------------------------------------------------------------------
 BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
+# Persistent-compile-cache accounting (runtime/compile_cache.py): jax
+# records a plain event on every cache read hit, and on every compiled
+# program written to (or rejected by) the cache — the hit counter rising
+# across a restart is the "warm binaries" signal next to jax/recompiles.
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
 _recompile_counters = weakref.WeakSet()
 _listener_installed = False
+_cache_hit_counters = weakref.WeakSet()
+_cache_miss_counters = weakref.WeakSet()
+_cache_listener_installed = False
 
 
 def install_recompile_hook(counter):
@@ -207,4 +217,38 @@ def install_recompile_hook(counter):
         return True
     except Exception as e:  # pragma: no cover - jax.monitoring is stable
         logger.info("jax.monitoring unavailable; recompile counter off: %s", e)
+        return False
+
+
+def install_compile_cache_hook(hit_counter, miss_counter):
+    """Count persistent-compile-cache hits/misses into the two counters.
+
+    Same one-global-listener/WeakSet pattern as the recompile hook: the
+    jax.monitoring listener lives for the process, counters from
+    garbage-collected telemetry instances drop out of the sets.
+    """
+    global _cache_listener_installed
+    _cache_hit_counters.add(hit_counter)
+    _cache_miss_counters.add(miss_counter)
+    if _cache_listener_installed:
+        return True
+    try:
+        from jax import monitoring as jax_monitoring
+
+        def _on_event(event, **kwargs):
+            del kwargs
+            if event == CACHE_HIT_EVENT:
+                for c in list(_cache_hit_counters):
+                    c.inc()
+            elif event == CACHE_MISS_EVENT:
+                for c in list(_cache_miss_counters):
+                    c.inc()
+
+        jax_monitoring.register_event_listener(_on_event)
+        _cache_listener_installed = True
+        return True
+    except Exception as e:  # pragma: no cover - jax.monitoring is stable
+        logger.info(
+            "jax.monitoring unavailable; compile-cache counters off: %s", e
+        )
         return False

@@ -1,0 +1,699 @@
+"""Overlapped window staging (runtime/staging.py) + persistent compile
+cache (runtime/compile_cache.py).
+
+Coverage per the PR's acceptance criteria: staged vs. unstaged
+``train_batch`` bitwise equivalence (params, losses, RNG stream) over
+multi-window runs at accum 1 and 4; the ragged-final-window RuntimeError
+on both paths; epoch-boundary refill (a fresh iterator rebuilds the
+stager and the stream continues deterministically); preemption-drain
+shutdown; thread-leak checks; the data-pipeline telemetry streams; the
+staged dataloader ``_place`` path; config validation; and compile-cache
+hits on a second ``initialize()``.
+
+Models are bare ``loss_fn(params, batch, rng)`` callables (no flax) so
+the jit programs stay tiny — this file runs in tier-1, not under the
+``slow`` marker.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime import compile_cache
+from deepspeed_tpu.runtime.staging import WindowStager, ragged_window_error
+
+INPUT_DIM = 8
+
+
+def loss_fn(params, batch, rng):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    # the additive noise makes the loss DEPEND on the rng key, so the
+    # equivalence tests prove the staged pre-split reproduces the
+    # unstaged key stream bit-for-bit, not merely the data order
+    noise = 0.01 * jax.random.normal(rng, pred[:, 0].shape)
+    return jnp.mean((pred[:, 0] + noise - y) ** 2)
+
+
+def make_params(seed=0):
+    r = np.random.default_rng(seed)
+    return {
+        "w": r.standard_normal((INPUT_DIM, 1)).astype(np.float32),
+        "b": np.zeros((1,), np.float32),
+    }
+
+
+def make_batches(n, rows, seed=1):
+    r = np.random.default_rng(seed)
+    return [
+        (
+            r.standard_normal((rows, INPUT_DIM)).astype(np.float32),
+            r.standard_normal((rows,)).astype(np.float32),
+        )
+        for _ in range(n)
+    ]
+
+
+def build_engine(accum=1, staged=True, extra=None):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": accum,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+        "data_pipeline": {"enabled": staged},
+    }
+    cfg.update(extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=make_params(), config_params=cfg
+    )
+    return engine
+
+
+def global_rows(engine):
+    return engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
+
+
+def stager_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith("ds-window-stager")
+    ]
+
+
+def rng_state(engine):
+    return np.asarray(jax.random.key_data(engine._rng))
+
+
+# ---------------------------------------------------------------------------
+# equivalence: staged == unstaged, bitwise
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("accum", [1, 4])
+def test_staged_equals_unstaged_bitwise(accum):
+    def run(staged):
+        engine = build_engine(accum=accum, staged=staged)
+        batches = make_batches(4 * accum, global_rows(engine))
+        it = iter(batches)
+        losses = [float(engine.train_batch(it)) for _ in range(4)]
+        params = jax.tree_util.tree_map(np.asarray, engine.params)
+        rng = rng_state(engine)
+        used_stager = engine._stager is not None
+        engine.close_data_pipeline()
+        return losses, params, rng, used_stager
+
+    losses_u, params_u, rng_u, stager_u = run(False)
+    losses_s, params_s, rng_s, stager_s = run(True)
+    assert not stager_u and stager_s
+    assert losses_u == losses_s  # float-exact, not approx
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params_u), jax.tree_util.tree_leaves(params_s)
+    ):
+        assert np.array_equal(a, b)
+    # the staged pre-split left the engine's RNG chain exactly where the
+    # unstaged dispatch chain lands
+    assert np.array_equal(rng_u, rng_s)
+
+
+def test_staged_run_converges():
+    import itertools
+
+    engine = build_engine(accum=2, staged=True)
+    # one fixed window cycled: the regression target is learnable, so the
+    # staged loop must actually descend
+    it = itertools.cycle(make_batches(2, global_rows(engine)))
+    losses = [float(engine.train_batch(it)) for _ in range(12)]
+    assert losses[-1] < losses[0]
+    assert engine.global_steps == 12
+    engine.close_data_pipeline()
+
+
+# ---------------------------------------------------------------------------
+# ragged final window (satellite: the bare-StopIteration fix)
+# ---------------------------------------------------------------------------
+def test_ragged_window_raises_runtime_error_unstaged():
+    engine = build_engine(accum=4, staged=False)
+    batches = make_batches(2, global_rows(engine))  # 2 of 4 micro-batches
+    with pytest.raises(RuntimeError, match=r"2 of gradient_accumulation_steps=4"):
+        engine.train_batch(iter(batches))
+
+
+def test_ragged_window_raises_runtime_error_staged():
+    engine = build_engine(accum=4, staged=True)
+    batches = make_batches(6, global_rows(engine))  # 1 full window + 2 ragged
+    it = iter(batches)
+    float(engine.train_batch(it))
+    with pytest.raises(RuntimeError, match=r"2 of gradient_accumulation_steps=4"):
+        engine.train_batch(it)
+    # the failed stream tore its stager down
+    assert engine._stager is None
+    assert stager_threads() == []
+
+
+def test_clean_exhaustion_raises_stop_iteration_both_paths():
+    for staged in (False, True):
+        engine = build_engine(accum=2, staged=staged)
+        batches = make_batches(4, global_rows(engine))  # exactly 2 windows
+        it = iter(batches)
+        float(engine.train_batch(it))
+        float(engine.train_batch(it))
+        with pytest.raises(StopIteration):
+            engine.train_batch(it)
+        assert engine._stager is None
+
+
+# ---------------------------------------------------------------------------
+# epoch-boundary refill
+# ---------------------------------------------------------------------------
+def test_epoch_boundary_refill_matches_single_stream():
+    """Two epochs fed as two fresh iterators (stager torn down and
+    rebuilt at the boundary) produce the same params as one staged stream
+    over the concatenated data — the RNG chain hands off through the
+    rebuild."""
+    def run(two_epochs):
+        engine = build_engine(accum=2, staged=True)
+        batches = make_batches(8, global_rows(engine))  # 4 windows
+        if two_epochs:
+            for epoch in (batches[:4], batches[4:]):
+                it = iter(epoch)
+                float(engine.train_batch(it))
+                float(engine.train_batch(it))
+        else:
+            it = iter(batches)
+            for _ in range(4):
+                float(engine.train_batch(it))
+        params = jax.tree_util.tree_map(np.asarray, engine.params)
+        engine.close_data_pipeline()
+        return params
+
+    single = run(False)
+    double = run(True)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(single), jax.tree_util.tree_leaves(double)
+    ):
+        assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# shutdown: preemption drain + thread leaks
+# ---------------------------------------------------------------------------
+def _preemption_engine(tmp_path, exit_after_save):
+    return build_engine(
+        accum=2,
+        staged=True,
+        extra={
+            "resilience": {
+                "preemption": {
+                    "enabled": True,
+                    "save_dir": str(tmp_path),
+                    "exit_after_save": exit_after_save,
+                },
+            },
+        },
+    )
+
+
+def test_preemption_drain_exit_closes_stager(tmp_path, monkeypatch):
+    """exit_after_save (the preemption default): the stager is closed
+    before the final checkpoint commits — no worker mid-device_put at
+    exit, no leaked threads blocking the drain."""
+    kills = []
+    monkeypatch.setattr(
+        "deepspeed_tpu.resilience.preemption.os.kill",
+        lambda pid, sig: kills.append((pid, sig)),
+    )
+    engine = _preemption_engine(tmp_path, exit_after_save=True)
+    batches = make_batches(2 * 8, global_rows(engine))
+    it = iter(batches)
+    float(engine.train_batch(it))
+    assert engine._stager is not None
+    import signal
+
+    engine.resilience.preemption.arm(signal.SIGTERM)
+    # the next step boundary honors the drain: stager torn down, final
+    # checkpoint committed, original signal re-delivered (stubbed)
+    float(engine.train_batch(it))
+    assert engine._stager is None
+    assert stager_threads() == []
+    tags = {p.name for p in tmp_path.iterdir()}
+    assert any(t.startswith("preempt_global_step") for t in tags)
+    assert kills  # the drain re-raised to exit
+
+
+def test_preemption_drain_exit_closes_loader_stager(tmp_path, monkeypatch):
+    """At accum=1 the staging worker is LOADER-owned (train_batch skips
+    its own stager on the marked iterator) — the exit drain must reach it
+    through close_data_pipeline(), not only the engine-owned stager."""
+    import signal
+
+    monkeypatch.setattr(
+        "deepspeed_tpu.resilience.preemption.os.kill",
+        lambda pid, sig: None,
+    )
+    engine = build_engine(
+        accum=1,
+        staged=True,
+        extra={
+            "resilience": {
+                "preemption": {
+                    "enabled": True,
+                    "save_dir": str(tmp_path),
+                    "exit_after_save": True,
+                },
+            },
+        },
+    )
+    loader = _loader_for(engine, 8)
+    it = iter(loader)
+    float(engine.train_batch(it))
+    assert engine._stager is None  # loader-owned staging served it
+    assert stager_threads()  # the loader's worker is live mid-epoch
+    engine.resilience.preemption.arm(signal.SIGTERM)
+    float(engine.train_batch(it))
+    assert stager_threads() == []  # drain reached the loader's worker
+    tags = {p.name for p in tmp_path.iterdir()}
+    assert any(t.startswith("preempt_global_step") for t in tags)
+
+
+def test_preemption_drain_keep_training_loses_no_data(tmp_path):
+    """exit_after_save=false (checkpoint-and-continue): the stager stays
+    attached — closing it would silently drop the windows it already
+    pulled from the live iterator. The whole run must stay bitwise equal
+    to an undrained staged run."""
+    def run(drain):
+        engine = _preemption_engine(tmp_path / f"d{int(drain)}",
+                                    exit_after_save=False)
+        batches = make_batches(2 * 6, global_rows(engine))
+        it = iter(batches)
+        losses = [float(engine.train_batch(it))]
+        if drain:
+            engine.resilience.preemption.arm()
+        for _ in range(5):
+            losses.append(float(engine.train_batch(it)))
+        params = jax.tree_util.tree_map(np.asarray, engine.params)
+        stager_alive = engine._stager is not None
+        engine.close_data_pipeline()
+        return losses, params, stager_alive
+
+    losses_plain, params_plain, _ = run(False)
+    losses_drain, params_drain, alive = run(True)
+    assert alive  # the continue-drain kept the stager attached
+    assert losses_plain == losses_drain
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params_plain),
+        jax.tree_util.tree_leaves(params_drain),
+    ):
+        assert np.array_equal(a, b)
+    tags = {p.name for p in (tmp_path / "d1").iterdir()}
+    assert any(t.startswith("preempt_global_step") for t in tags)
+
+
+def test_no_thread_leak_across_stager_lifecycles():
+    before = len(stager_threads())
+    for _ in range(3):
+        engine = build_engine(accum=1, staged=True)
+        batches = make_batches(3, global_rows(engine))
+        it = iter(batches)
+        float(engine.train_batch(it))
+        # new source mid-stream: old stager must close, not leak
+        it2 = iter(make_batches(3, global_rows(engine), seed=7))
+        float(engine.train_batch(it2))
+        engine.close_data_pipeline()
+    for t in stager_threads():
+        t.join(timeout=5.0)
+    assert len(stager_threads()) == before
+
+
+def test_abandoned_engine_does_not_leak_stager():
+    """Dropping an engine mid-stream (sweep, notebook rebuild) must stop
+    the staging worker via the weakref finalizer: the worker holds only a
+    weak engine ref, so the engine is collectable and its collection
+    closes the stager."""
+    import gc
+    import itertools
+    import time
+
+    engine = build_engine(accum=1, staged=True)
+    it = itertools.cycle(make_batches(2, global_rows(engine)))
+    float(engine.train_batch(it))
+    assert stager_threads()
+    del engine
+    gc.collect()
+    deadline = time.monotonic() + 5.0
+    while stager_threads() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert stager_threads() == []
+
+
+def test_fresh_iterator_per_call_falls_back_unstaged():
+    """A NEW iterator object every call (iter(list) per window) passes
+    the iterator check but gives the stager nothing to pull ahead — after
+    two churned single-window stagers the engine stops paying a thread
+    per window and runs unstaged."""
+    engine = build_engine(accum=1, staged=True)
+    rows = global_rows(engine)
+    losses = []
+    for seed in range(5):
+        losses.append(
+            float(engine.train_batch(iter(make_batches(1, rows, seed=seed))))
+        )
+    assert all(np.isfinite(losses))
+    assert engine.global_steps == 5
+    # churn guard engaged: no stager attached, no worker threads
+    assert engine._stager is None
+    assert engine._stager_churn >= 2
+    assert stager_threads() == []
+    # ...but NOT a permanent latch: switching to one persistent iterator
+    # (fresh-iterator warmups then the real loop) re-engages staging on
+    # the second call with the same source
+    it = iter(make_batches(4, rows, seed=99))
+    float(engine.train_batch(it))  # same-source probe window (unstaged)
+    assert engine._stager is None
+    float(engine.train_batch(it))
+    assert engine._stager is not None
+    engine.close_data_pipeline()
+
+
+def _loader_for(engine, n_batches):
+    rows = global_rows(engine)
+    r = np.random.default_rng(0)
+    data = (
+        r.standard_normal((rows * n_batches, INPUT_DIM)).astype(np.float32),
+        r.standard_normal((rows * n_batches,)).astype(np.float32),
+    )
+    return engine.deepspeed_io(data, batch_size=rows)
+
+
+def test_staged_loader_accum1_skips_engine_stager():
+    """At accum=1 the loader's accum=1 stager IS the window stager: its
+    iterator is marked already_staged and train_batch must NOT layer a
+    second stager on top (double staging would re-stack placed arrays
+    device-side and re-transfer the window)."""
+    engine = build_engine(accum=1, staged=True)
+    loader = _loader_for(engine, 4)
+    it = iter(loader)
+    assert getattr(it, "already_staged", False)
+    float(engine.train_batch(it))
+    assert engine._stager is None  # loader staging served the window
+    float(engine.train_batch(it))
+    assert engine.global_steps == 2
+    # abandoning the epoch mid-stream: closing the marked iterator drains
+    # the loader's stager synchronously
+    it.close()
+    assert stager_threads() == []
+
+
+def test_loader_serves_host_batches_for_fused_windows_at_accum_gt_1():
+    """At accum>1 the loader must NOT device-place its batches: the fused
+    window stager needs host micro-batches to stack (device-resident ones
+    would restack through the default device and transfer twice) — so the
+    loader iterator is unmarked and the ENGINE stager engages over it."""
+    engine = build_engine(accum=2, staged=True)
+    loader = _loader_for(engine, 4)
+    assert loader.stage_to_device is False
+    assert loader.device_place is False
+    # the loader really yields host batches, not pre-placed jax.Arrays
+    first = next(iter(loader))
+    assert all(isinstance(leaf, np.ndarray) for leaf in first)
+    it = iter(loader)
+    assert not getattr(it, "already_staged", False)
+    float(engine.train_batch(it))
+    assert engine._stager is not None  # window staging over host batches
+    float(engine.train_batch(it))
+    assert engine.global_steps == 2
+    engine.close_data_pipeline()
+
+
+def test_loader_host_batches_when_stage_to_device_off_accum1():
+    """data_pipeline enabled with stage_to_device=false at accum=1: the
+    ENGINE stager places (on the consuming thread), so the loader must
+    yield host batches — device-placed ones would be restacked
+    device-side and transferred twice."""
+    engine = build_engine(
+        accum=1,
+        staged=True,
+        extra={"data_pipeline": {"enabled": True, "stage_to_device": False}},
+    )
+    loader = _loader_for(engine, 4)
+    assert loader.stage_to_device is False
+    assert loader.device_place is False
+    first = next(iter(loader))
+    assert all(isinstance(leaf, np.ndarray) for leaf in first)
+    it = iter(loader)
+    float(engine.train_batch(it))
+    float(engine.train_batch(it))
+    assert engine._stager is not None  # engine-side staging engaged
+    assert engine.global_steps == 2
+    engine.close_data_pipeline()
+
+
+def test_close_staging_reaches_all_live_epoch_iterators():
+    engine = build_engine(accum=1, staged=True)
+    loader = _loader_for(engine, 8)
+    it1 = iter(loader)
+    next(it1)  # partially consumed; worker live
+    it2 = iter(loader)
+    next(it2)
+    assert len(stager_threads()) >= 1
+    engine.close_data_pipeline()
+    assert stager_threads() == []
+
+
+def test_arm_compile_cache_reacts_to_threshold_change(tmp_path):
+    try:
+        d = str(tmp_path / "cc")
+        assert compile_cache.arm_compile_cache(d, 1.0) is not None
+        import jax
+
+        assert (
+            jax.config.jax_persistent_cache_min_compile_time_secs == 1.0
+        )
+        # same dir, new threshold: must re-arm, not early-return
+        assert compile_cache.arm_compile_cache(d, 0.0) is not None
+        assert (
+            jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+        )
+    finally:
+        compile_cache.disarm_compile_cache()
+
+
+def test_stager_close_is_idempotent_and_bounded():
+    src = iter(make_batches(64, 4))
+    stager = WindowStager(
+        source=src,
+        accum=2,
+        stack_fn=lambda batches: batches,
+        place_fn=lambda x: x,
+        buffers=2,
+        stage_to_device=False,
+    )
+    stager.get_window()
+    stager.close()
+    stager.close()
+    assert not stager.alive()
+    assert stager.occupancy() == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry streams
+# ---------------------------------------------------------------------------
+def test_staging_telemetry_streams(tmp_path):
+    engine = build_engine(
+        accum=2,
+        staged=True,
+        extra={
+            "telemetry": {
+                "enabled": True,
+                "output_path": str(tmp_path),
+                "job_name": "stage",
+                "watchdog": {"enabled": False},
+            },
+        },
+    )
+    batches = make_batches(2 * 3, global_rows(engine))
+    it = iter(batches)
+    for _ in range(3):
+        float(engine.train_batch(it))
+    snap = engine.telemetry.registry.snapshot()
+    assert snap["dataloader/staging_wait_ms/count"] == 3
+    assert snap["dataloader/staging_time_ms/count"] >= 3
+    assert snap["dataloader/h2d_bytes"] > 0
+    assert "dataloader/staging_occupancy" in snap
+    engine.close_data_pipeline()
+    engine.telemetry.close()
+
+
+def test_window_tokens_counted_like_unstaged(tmp_path):
+    """Throughput accounting parity: the stager's per-window (tokens,
+    samples) meta matches what the unstaged path counts micro-batch by
+    micro-batch."""
+    def run(staged):
+        engine = build_engine(
+            accum=2,
+            staged=staged,
+            extra={
+                "telemetry": {
+                    "enabled": True,
+                    "output_path": str(tmp_path),
+                    "job_name": f"tok{int(staged)}",
+                    "interval": 100,  # keep counts un-reset
+                    "watchdog": {"enabled": False},
+                },
+            },
+        )
+        batches = make_batches(2 * 2, global_rows(engine))
+        it = iter(batches)
+        float(engine.train_batch(it))
+        float(engine.train_batch(it))
+        counted = (
+            engine.telemetry._tokens_since_export,
+            engine.telemetry._samples_since_export,
+        )
+        engine.close_data_pipeline()
+        engine.telemetry.close()
+        return counted
+
+    assert run(False) == run(True)
+
+
+# ---------------------------------------------------------------------------
+# dataloader: staged _place path (accum=1 stager)
+# ---------------------------------------------------------------------------
+def test_dataloader_staged_place_matches_unstaged():
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    mesh = mesh_lib.build_mesh()
+    r = np.random.default_rng(0)
+    data = (
+        r.standard_normal((32, INPUT_DIM)).astype(np.float32),
+        r.integers(0, 10, 32).astype(np.int32),
+    )
+    plain = DeepSpeedDataLoader(data, batch_size=8, mesh=mesh)
+    staged = DeepSpeedDataLoader(
+        data, batch_size=8, mesh=mesh, stage_to_device=True
+    )
+    for _ in range(2):  # two epochs: the staged path refills per epoch
+        got_plain = list(plain)
+        got_staged = list(staged)
+        assert len(got_plain) == len(got_staged) == 4
+        for bp, bs in zip(got_plain, got_staged):
+            for lp, ls in zip(bp, bs):
+                assert isinstance(ls, jax.Array)
+                assert lp.sharding == ls.sharding
+                assert np.array_equal(np.asarray(lp), np.asarray(ls))
+    assert stager_threads() == []
+
+
+def test_dataloader_queue_depth_refills_between_epochs():
+    """The satellite fix: the producer side samples the gauge too, so the
+    new epoch's refill is visible instead of the gauge sticking at the
+    previous epoch's drained 0."""
+    class StubTelemetry:
+        def __init__(self):
+            self.depths = []
+
+        def set_dataloader_depth(self, depth):
+            self.depths.append(depth)
+
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    data = (np.arange(64, dtype=np.float32).reshape(16, 4),)
+    stub = StubTelemetry()
+    loader = DeepSpeedDataLoader(
+        data, batch_size=4, mesh=None, prefetch=2, telemetry=stub
+    )
+    list(loader)
+    first_epoch_samples = len(stub.depths)
+    # producer-side samples exist, not only the 4 handoffs
+    assert first_epoch_samples > 4
+    assert any(d > 0 for d in stub.depths)
+    list(loader)
+    # the second epoch reported refill depths > 0 again
+    assert any(d > 0 for d in stub.depths[first_epoch_samples:])
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "block",
+    [
+        {"data_pipeline": {"staging_buffers": 0}},
+        {"data_pipeline": {"staging_buffers": True}},
+        {"data_pipeline": {"staging_buffers": "2"}},
+        {"data_pipeline": {"enabled": "yes"}},
+        {"data_pipeline": {"stage_to_device": 1}},
+        {"compile_cache": {"enabled": "on"}},
+        {"compile_cache": {"cache_dir": 7}},
+        {"compile_cache": {"min_compile_time_secs": -1}},
+        {"compile_cache": {"min_compile_time_secs": "1"}},
+    ],
+)
+def test_config_rejects_bad_blocks(block):
+    cfg = {"train_batch_size": 8, **block}
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(None, param_dict=cfg, world_size=1)
+
+
+def test_config_defaults():
+    cfg = DeepSpeedConfig(
+        None, param_dict={"train_batch_size": 8}, world_size=1
+    )
+    assert cfg.data_pipeline_enabled is False
+    assert cfg.data_pipeline_staging_buffers == 2
+    assert cfg.data_pipeline_stage_to_device is True
+    assert cfg.compile_cache_enabled is False
+    assert cfg.compile_cache_min_compile_time_secs == 1.0
+
+
+def test_ragged_window_error_names_counts():
+    err = ragged_window_error(3, 8)
+    assert isinstance(err, RuntimeError)
+    assert "3 of gradient_accumulation_steps=8" in str(err)
+
+
+# ---------------------------------------------------------------------------
+# compile cache: second initialize() hits
+# ---------------------------------------------------------------------------
+def test_compile_cache_hits_on_second_initialize(tmp_path):
+    """Acceptance: with "compile_cache" enabled, a second initialize()
+    in the same configuration reuses the persisted programs — the hit
+    counter (exported next to jax/recompiles) moves."""
+    extra = {
+        "compile_cache": {
+            "enabled": True,
+            "cache_dir": str(tmp_path / "jax_cache"),
+            "min_compile_time_secs": 0.0,
+        },
+        "telemetry": {
+            "enabled": True,
+            "output_path": str(tmp_path),
+            "job_name": "cc",
+            "watchdog": {"enabled": False},
+        },
+    }
+    try:
+        for i in range(2):
+            engine = build_engine(accum=2, staged=True, extra=extra)
+            batches = make_batches(2 * 2, global_rows(engine))
+            it = iter(batches)
+            float(engine.train_batch(it))
+            snap = engine.telemetry.registry.snapshot()
+            engine.close_data_pipeline()
+            engine.telemetry.close()
+        assert snap["jax/compile_cache_hits"] > 0
+    finally:
+        # the tmp cache dir dies with the test; leaving the global cache
+        # armed would fail every later compile's cache write
+        compile_cache.disarm_compile_cache()
+
+
+def test_compile_cache_disabled_by_default():
+    cfg = DeepSpeedConfig(
+        None, param_dict={"train_batch_size": 8}, world_size=1
+    )
+    assert compile_cache.configure_compile_cache(cfg) is None

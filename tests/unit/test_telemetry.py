@@ -685,8 +685,12 @@ def test_dataloader_queue_depth_gauge():
     )
     batches = list(loader)
     assert len(batches) == 4
-    assert len(stub.depths) == 4  # one reading per handoff
-    assert all(0 <= d <= 2 for d in stub.depths)
+    # one reading per handoff PLUS producer-side enqueue samples (the
+    # epoch-boundary-refill fix: without the producer samples the gauge
+    # sticks at the previous epoch's drained 0 while the queue refills)
+    assert len(stub.depths) >= 4
+    # producer samples report qsize+1 for the batch about to enqueue
+    assert all(0 <= d <= 3 for d in stub.depths)
 
 
 def test_telemetry_disabled_is_inert(tmp_path):
