@@ -843,9 +843,114 @@ def smoke():
     }))
 
 
+def smoke_infer():
+    """CI fast path (``python bench.py --smoke-infer``): a tiny GPT-2 on
+    the CPU backend served end to end through the continuous-batching
+    inference engine (docs/inference.md) — two requests of DIFFERENT
+    prompt lengths submitted concurrently, a third joining mid-decode,
+    with the TTFT / tokens-per-sec telemetry streams asserted populated
+    and the fixed-shape no-recompile invariant checked. Prints one JSON
+    line and exits non-zero on any failed check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    tmp = tempfile.mkdtemp(prefix="ds_smoke_infer_")
+    cfg = GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False,
+    )
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    ids0 = jnp.asarray(rng.integers(0, 128, (1, 8)), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids0, ids0,
+    )["params"]
+
+    engine = deepspeed_tpu.init_inference(
+        model=model,
+        model_parameters=params,
+        config={
+            "inference": {
+                "max_batch_slots": 3,
+                "max_seq_len": 48,
+                "prefill_len": 16,
+                "sampling": {"greedy": True},
+            },
+            "telemetry": {
+                "enabled": True,
+                "output_path": os.path.join(tmp, "telemetry"),
+                "job_name": "smoke_infer",
+                "watchdog": {"enabled": False},
+            },
+        },
+    )
+    recompiles = engine.metrics.counter("jax/recompiles")
+
+    # two concurrent requests (different prompt lengths) share the decode
+    # batch from step one...
+    r1 = engine.submit(
+        [int(t) for t in rng.integers(0, 128, 9)], max_new_tokens=12
+    )
+    r2 = engine.submit(
+        [int(t) for t in rng.integers(0, 128, 5)], max_new_tokens=10
+    )
+    for _ in range(4):
+        engine.scheduler.step()
+    warm = recompiles.value
+    # ...and a third joins MID-DECODE without recompiling anything
+    r3 = engine.submit(
+        [int(t) for t in rng.integers(0, 128, 13)], max_new_tokens=8
+    )
+    engine.scheduler.run_until_idle()
+    assert r1.result(0) and r2.result(0) and r3.result(0)
+    assert len(r1.tokens) == 12 and len(r2.tokens) == 10 and len(r3.tokens) == 8
+    assert recompiles.value == warm, (
+        f"{recompiles.value - warm} recompiles after mid-decode join"
+    )
+
+    snap = engine.metrics.snapshot()
+    assert snap["infer/ttft_ms/count"] == 3, snap["infer/ttft_ms/count"]
+    assert snap["infer/tokens_per_sec"] > 0, "tokens/sec gauge stayed 0"
+    assert snap["infer/token_latency_ms/count"] >= 11
+    assert snap["infer/requests_completed"] == 3
+    assert snap["infer/slot_occupancy"] == 0
+    engine.close()
+    prom = open(
+        os.path.join(tmp, "telemetry", "smoke_infer", "metrics.prom")
+    ).read()
+    assert "infer_ttft_ms_bucket" in prom, "TTFT missing from the prom sink"
+
+    tokens = int(snap["infer/tokens_generated"])
+    print(json.dumps({
+        "metric": "smoke_continuous_batching_infer",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": {
+            "requests": 3,
+            "tokens_generated": tokens,
+            "mean_ttft_ms": round(
+                snap["infer/ttft_ms/sum"] / snap["infer/ttft_ms/count"], 3
+            ),
+            "decode_tokens_per_sec": round(snap["infer/tokens_per_sec"], 1),
+            "recompiles_after_join": int(recompiles.value - warm),
+        },
+    }))
+
+
 def main():
     if "--smoke" in sys.argv:
         smoke()
+        return
+    if "--smoke-infer" in sys.argv:
+        smoke_infer()
         return
     if os.environ.get("BENCH_WORKER"):
         _worker_main()

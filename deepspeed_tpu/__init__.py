@@ -76,6 +76,34 @@ def initialize(
     )
 
 
+def init_inference(
+    model=None,
+    config=None,
+    model_parameters=None,
+    mesh=None,
+    param_specs=None,
+    rng_seed=0,
+):
+    """Build a continuous-batching serving engine around ``model``
+    (deepspeed_tpu/inference/, docs/inference.md): KV-cache decode,
+    bounded-queue admission, slot-managed batching. Returns an
+    ``InferenceEngine`` with ``generate(prompts, max_new_tokens=...)``
+    and the ``submit``/``serve_forever`` server mode. The reference
+    stopped at training; this is the serving act on top of the same
+    sharded params, mesh, telemetry, and verified-checkpoint layers.
+    """
+    from .inference.engine import init_inference as _init_inference
+
+    return _init_inference(
+        model=model,
+        config=config,
+        model_parameters=model_parameters,
+        mesh=mesh,
+        param_specs=param_specs,
+        rng_seed=rng_seed,
+    )
+
+
 def _add_core_arguments(parser):
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
     group.add_argument(
@@ -116,6 +144,7 @@ def add_config_arguments(parser):
 
 __all__ = [
     "initialize",
+    "init_inference",
     "init_distributed",
     "add_config_arguments",
     "checkpointing",

@@ -371,6 +371,59 @@ class DeepSpeedConfig:
             C.COMPILE_CACHE_MIN_COMPILE_SECS_DEFAULT,
         )
 
+        # inference block (deepspeed_tpu/inference/, docs/inference.md)
+        inf_dict = get_dict_param(pd, C.INFERENCE)
+        self.inference_max_batch_slots = get_scalar_param(
+            inf_dict, C.INFERENCE_MAX_BATCH_SLOTS,
+            C.INFERENCE_MAX_BATCH_SLOTS_DEFAULT,
+        )
+        self.inference_max_seq_len = get_scalar_param(
+            inf_dict, C.INFERENCE_MAX_SEQ_LEN, C.INFERENCE_MAX_SEQ_LEN_DEFAULT
+        )
+        self.inference_prefill_len = get_scalar_param(
+            inf_dict, C.INFERENCE_PREFILL_LEN, C.INFERENCE_PREFILL_LEN_DEFAULT
+        )
+        self.inference_queue_depth = get_scalar_param(
+            inf_dict, C.INFERENCE_QUEUE_DEPTH, C.INFERENCE_QUEUE_DEPTH_DEFAULT
+        )
+        self.inference_queue_timeout = get_scalar_param(
+            inf_dict, C.INFERENCE_QUEUE_TIMEOUT,
+            C.INFERENCE_QUEUE_TIMEOUT_DEFAULT,
+        )
+        self.inference_eos_token_id = get_scalar_param(
+            inf_dict, C.INFERENCE_EOS_TOKEN_ID,
+            C.INFERENCE_EOS_TOKEN_ID_DEFAULT,
+        )
+        self.inference_dtype = get_scalar_param(
+            inf_dict, C.INFERENCE_DTYPE, C.INFERENCE_DTYPE_DEFAULT
+        )
+        samp_dict = get_dict_param(inf_dict, C.INFERENCE_SAMPLING)
+        self.inference_temperature = get_scalar_param(
+            samp_dict, C.INFERENCE_SAMPLING_TEMPERATURE,
+            C.INFERENCE_SAMPLING_TEMPERATURE_DEFAULT,
+        )
+        self.inference_top_k = get_scalar_param(
+            samp_dict, C.INFERENCE_SAMPLING_TOP_K,
+            C.INFERENCE_SAMPLING_TOP_K_DEFAULT,
+        )
+        self.inference_top_p = get_scalar_param(
+            samp_dict, C.INFERENCE_SAMPLING_TOP_P,
+            C.INFERENCE_SAMPLING_TOP_P_DEFAULT,
+        )
+        self.inference_greedy = get_scalar_param(
+            samp_dict, C.INFERENCE_SAMPLING_GREEDY,
+            C.INFERENCE_SAMPLING_GREEDY_DEFAULT,
+        )
+        ckpt_dict = get_dict_param(inf_dict, C.INFERENCE_CHECKPOINT)
+        self.inference_checkpoint_load_dir = get_scalar_param(
+            ckpt_dict, C.INFERENCE_CHECKPOINT_LOAD_DIR,
+            C.INFERENCE_CHECKPOINT_LOAD_DIR_DEFAULT,
+        )
+        self.inference_checkpoint_tag = get_scalar_param(
+            ckpt_dict, C.INFERENCE_CHECKPOINT_TAG,
+            C.INFERENCE_CHECKPOINT_TAG_DEFAULT,
+        )
+
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
         self.data_parallel_size = get_scalar_param(
@@ -468,6 +521,7 @@ class DeepSpeedConfig:
         self._check_telemetry()
         self._check_resilience()
         self._check_data_pipeline()
+        self._check_inference()
         amp_dict = get_dict_param(self._param_dict, C.AMP)
         if amp_dict.get(C.AMP_ENABLED, bool(amp_dict)):
             # apex amp (reference deepspeed_light.py:516-521) has no TPU
@@ -686,6 +740,106 @@ class DeepSpeedConfig:
                 f"{C.COMPILE_CACHE}.{C.COMPILE_CACHE_MIN_COMPILE_SECS} must "
                 f"be a number >= 0 seconds (0 caches everything), got "
                 f"{secs!r}"
+            )
+
+    def _check_inference(self):
+        """Validate the inference block (docs/inference.md): a typo'd slot
+        count or an out-of-range sampling default must fail at
+        init_inference(), not as a shape error in the first decode step or
+        a silently-degenerate sampler."""
+        slots = self.inference_max_batch_slots
+        if not isinstance(slots, int) or isinstance(slots, bool) or slots < 1:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_MAX_BATCH_SLOTS} must be an "
+                f"integer >= 1, got {slots!r}"
+            )
+        for field, value in (
+            (C.INFERENCE_MAX_SEQ_LEN, self.inference_max_seq_len),
+            (C.INFERENCE_PREFILL_LEN, self.inference_prefill_len),
+            (C.INFERENCE_SAMPLING_TOP_K, self.inference_top_k),
+        ):
+            if (
+                not isinstance(value, int)
+                or isinstance(value, bool)
+                or value < 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{field} must be an integer >= 0 "
+                    f"(0 = default/disabled), got {value!r}"
+                )
+        qd = self.inference_queue_depth
+        if not isinstance(qd, int) or isinstance(qd, bool) or qd < 1:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_QUEUE_DEPTH} must be an "
+                f"integer >= 1, got {qd!r}"
+            )
+        if (
+            self.inference_max_seq_len
+            and self.inference_prefill_len > self.inference_max_seq_len
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_PREFILL_LEN}="
+                f"{self.inference_prefill_len} exceeds "
+                f"{C.INFERENCE_MAX_SEQ_LEN}={self.inference_max_seq_len}"
+            )
+        timeout = self.inference_queue_timeout
+        if (
+            not isinstance(timeout, (int, float))
+            or isinstance(timeout, bool)
+            or timeout < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_QUEUE_TIMEOUT} must be a "
+                f"number >= 0 seconds (0 rejects immediately when full), "
+                f"got {timeout!r}"
+            )
+        eos = self.inference_eos_token_id
+        if eos is not None and (
+            not isinstance(eos, int) or isinstance(eos, bool)
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_EOS_TOKEN_ID} must be an "
+                f"integer token id or null, got {eos!r}"
+            )
+        if self.inference_dtype not in ("fp32", "bf16"):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_DTYPE} must be 'fp32' or "
+                f"'bf16', got {self.inference_dtype!r}"
+            )
+        temp = self.inference_temperature
+        if (
+            not isinstance(temp, (int, float))
+            or isinstance(temp, bool)
+            or temp < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SAMPLING}."
+                f"{C.INFERENCE_SAMPLING_TEMPERATURE} must be a number >= 0 "
+                f"(0 = greedy), got {temp!r}"
+            )
+        top_p = self.inference_top_p
+        if (
+            not isinstance(top_p, (int, float))
+            or isinstance(top_p, bool)
+            or not 0 < top_p <= 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SAMPLING}."
+                f"{C.INFERENCE_SAMPLING_TOP_P} must be a number in "
+                f"(0, 1] (1 = disabled), got {top_p!r}"
+            )
+        if not isinstance(self.inference_greedy, bool):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SAMPLING}."
+                f"{C.INFERENCE_SAMPLING_GREEDY} must be a boolean, got "
+                f"{self.inference_greedy!r}"
+            )
+        if not isinstance(self.inference_checkpoint_load_dir, str):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_CHECKPOINT}."
+                f"{C.INFERENCE_CHECKPOINT_LOAD_DIR} must be a path string "
+                f"('' = serve the passed-in parameters), got "
+                f"{self.inference_checkpoint_load_dir!r}"
             )
 
     def _do_warning_check(self):

@@ -400,6 +400,66 @@ COMPILE_CACHE_MIN_COMPILE_SECS = "min_compile_time_secs"
 COMPILE_CACHE_MIN_COMPILE_SECS_DEFAULT = 1.0
 
 #############################################
+# Inference serving (deepspeed_tpu/inference/, docs/inference.md): the
+# continuous-batching KV-cache decode engine behind init_inference().
+# Absent from the reference, which stopped at training.
+#############################################
+INFERENCE = "inference"
+# Decode slots: the fixed batch width of the jitted decode step. Every
+# admitted request occupies one slot until EOS/length; the KV cache is
+# [layers, slots, heads, max_seq_len, head_dim], so slots * max_seq_len
+# bounds cache HBM.
+INFERENCE_MAX_BATCH_SLOTS = "max_batch_slots"
+INFERENCE_MAX_BATCH_SLOTS_DEFAULT = 8
+# Hard cap on prompt + generated tokens per request (the KV cache's
+# position extent). 0 => the model's n_positions.
+INFERENCE_MAX_SEQ_LEN = "max_seq_len"
+INFERENCE_MAX_SEQ_LEN_DEFAULT = 0
+# Fixed prefill width: prompts are right-padded to this length so prefill
+# compiles ONCE (causality makes the padding columns inert). 0 =>
+# max_seq_len. Smaller values trade prompt-length headroom for prefill
+# FLOPs.
+INFERENCE_PREFILL_LEN = "prefill_len"
+INFERENCE_PREFILL_LEN_DEFAULT = 0
+# Bounded admission queue (the serving front door): submissions beyond
+# this depth are REJECTED (RequestRejected) rather than buffered without
+# bound — overload sheds at the door, not in HBM.
+INFERENCE_QUEUE_DEPTH = "queue_depth"
+INFERENCE_QUEUE_DEPTH_DEFAULT = 64
+# How long submit() may block waiting for queue room before rejecting.
+# 0 => reject immediately when full.
+INFERENCE_QUEUE_TIMEOUT = "queue_timeout_secs"
+INFERENCE_QUEUE_TIMEOUT_DEFAULT = 0.0
+# Token id that terminates a sequence (host-side check after each decode
+# step). null/-1 => generation runs to max_new_tokens/max_seq_len.
+INFERENCE_EOS_TOKEN_ID = "eos_token_id"
+INFERENCE_EOS_TOKEN_ID_DEFAULT = None
+# Param/cache storage dtype: "fp32" or "bf16" (bf16 halves weight+cache
+# HBM and is the TPU-native serving precision; fp32 keeps decode bitwise
+# against the training forward — the parity tests' mode).
+INFERENCE_DTYPE = "dtype"
+INFERENCE_DTYPE_DEFAULT = "fp32"
+# Sampling defaults (per-request temperature may override; top-k/top-p/
+# greedy are engine-wide — they are compiled into the decode program).
+INFERENCE_SAMPLING = "sampling"
+INFERENCE_SAMPLING_TEMPERATURE = "temperature"
+INFERENCE_SAMPLING_TEMPERATURE_DEFAULT = 1.0
+INFERENCE_SAMPLING_TOP_K = "top_k"
+INFERENCE_SAMPLING_TOP_K_DEFAULT = 0  # 0 = disabled
+INFERENCE_SAMPLING_TOP_P = "top_p"
+INFERENCE_SAMPLING_TOP_P_DEFAULT = 1.0  # 1.0 = disabled
+INFERENCE_SAMPLING_GREEDY = "greedy"
+INFERENCE_SAMPLING_GREEDY_DEFAULT = False
+# Optional checkpoint to serve from: loaded through the resilience
+# verified-load path (manifest check + host-side parse + newest-valid
+# fallback) before params pin to device shardings.
+INFERENCE_CHECKPOINT = "checkpoint"
+INFERENCE_CHECKPOINT_LOAD_DIR = "load_dir"
+INFERENCE_CHECKPOINT_LOAD_DIR_DEFAULT = ""
+INFERENCE_CHECKPOINT_TAG = "tag"
+INFERENCE_CHECKPOINT_TAG_DEFAULT = None  # None => the 'latest' pointer
+
+#############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,
 # which delegated model parallelism to an external mpu object)
 #############################################

@@ -1,0 +1,44 @@
+"""Continuous-batching inference: KV-cache decode + a serving front door.
+
+The repo's fourth subsystem (next to telemetry/, resilience/, and the
+runtime/staging input pipeline), docs/inference.md. Three layers:
+
+  decode.py    — KV-cache prefill + fixed-shape incremental decode over
+                 the GPT-2 parameter trees (ops/transformer.py grew the
+                 block-level ``return_kv`` / ``transformer_block_decode``
+                 modes this drives).
+  sampling.py  — jitted greedy/temperature/top-k/top-p sampling with
+                 explicit PRNG-key threading.
+  engine.py /  — ``init_inference()``: verified param load, device
+  scheduler.py   pinning, and the slot-managed continuous-batching
+                 scheduler behind ``generate``/``submit``.
+"""
+
+from .decode import (
+    KVCache,
+    gpt2_decode_step,
+    gpt2_prefill,
+    init_kv_cache,
+    write_prefill_to_cache,
+)
+from .engine import InferenceEngine, init_inference
+from .sampling import sample_tokens
+from .scheduler import (
+    ContinuousBatchingScheduler,
+    InferenceRequest,
+    RequestRejected,
+)
+
+__all__ = [
+    "KVCache",
+    "gpt2_decode_step",
+    "gpt2_prefill",
+    "init_kv_cache",
+    "write_prefill_to_cache",
+    "InferenceEngine",
+    "init_inference",
+    "sample_tokens",
+    "ContinuousBatchingScheduler",
+    "InferenceRequest",
+    "RequestRejected",
+]

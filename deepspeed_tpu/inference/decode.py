@@ -1,0 +1,152 @@
+"""GPT-2 KV-cache decode: prefill + fixed-shape incremental step.
+
+The training forward (models/gpt2.py:GPT2Model.__call__) recomputes the
+full sequence every call — O(S^2) attention FLOPs per generated token.
+Serving needs the standard two-phase split every modern stack converged
+on (Orca, vLLM — PAPERS.md):
+
+  PREFILL  runs the prompt once through the full-sequence forward and
+           keeps each layer's split-head key/value projections — exactly
+           the tensors attention consumed, captured via
+           ``transformer_block_apply(..., return_kv=True)`` so the logits
+           are bit-identical to the training forward's.
+  DECODE   feeds ONE token per sequence through
+           ``transformer_block_decode``: qkv for the new position, k/v
+           written into the cache, attention taken over the cache —
+           O(max_len) per token.
+
+Cache layout is ``[layers, slots, heads, max_len, head_dim]``: the
+leading ``layers`` axis matches the scanned parameter stack (one
+``lax.scan`` drives both), ``slots`` is the continuous-batching batch
+width (scheduler.py), and ``heads`` shards over the mesh's ``model``
+axis via :func:`models.gpt2.kv_cache_partition_specs` — the same
+Megatron head split the qkv weights carry.
+
+Every function here is pure and fixed-shape: tokens/positions are
+``[slots]`` arrays whatever subset of slots is live, so requests joining
+or leaving the batch NEVER retrigger compilation (pinned by
+tests/unit/test_inference.py via the jax/recompiles counter).
+"""
+
+import typing
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.transformer import (
+    transformer_block_apply,
+    transformer_block_decode,
+)
+
+
+class KVCache(typing.NamedTuple):
+    """Decode cache: ``k``/``v`` each [layers, slots, heads, max_len,
+    head_dim]. A NamedTuple so it is a pytree — jit-carried and donated
+    across decode steps without copies."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_slots(self):
+        return self.k.shape[1]
+
+    @property
+    def max_len(self):
+        return self.k.shape[3]
+
+
+def init_kv_cache(config, num_slots, max_len, dtype=jnp.float32):
+    """Zero-filled cache for a GPT2Config: [L, slots, heads, max_len, hd]."""
+    shape = (
+        config.n_layer,
+        int(num_slots),
+        config.n_head,
+        int(max_len),
+        config.n_embd // config.n_head,
+    )
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _final_norm_and_logits(config, tp, x):
+    """ln_f + tied LM head, via the SAME flax module the training model
+    applies — prefill logits must be bitwise against GPT2LMHeadModel."""
+    x = nn.LayerNorm(epsilon=config.layer_norm_eps).apply(
+        {"params": tp["ln_f"]}, x
+    )
+    return x @ tp["wte"].T
+
+
+def gpt2_prefill(config, params, tokens):
+    """Full-sequence forward over ``tokens`` [B, S] that ALSO returns each
+    layer's k/v projections for the cache.
+
+    Returns ``(logits [B, S, vocab_padded], k [L, B, heads, S, hd],
+    v [...])``. Eval-mode arithmetic identical to
+    ``GPT2LMHeadModel.apply(..., train=False)`` — same embedding lookup,
+    same scanned ``transformer_block_apply``, same flax ``ln_f`` — so the
+    parity test can assert bitwise-equal logits. Right-padded prompts are
+    safe without a mask: causality keeps padding columns out of every
+    real row, and the padding rows' cache entries sit beyond the row
+    length decode masks by (and are overwritten as generation advances).
+    """
+    tp = params["transformer"]
+    s = tokens.shape[1]
+    layer_cfg = config.layer_config()
+    x = tp["wte"][tokens] + tp["wpe"][None, :s, :]
+
+    def body(x, pl):
+        x, (k, v) = transformer_block_apply(
+            layer_cfg, pl, x, None,
+            causal=True, use_flash=config.use_flash, mesh=config.mesh,
+            train=False, dropout_rng=None, return_kv=True,
+        )
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, tp["h"])
+    logits = _final_norm_and_logits(config, tp, x)
+    return logits, ks, vs
+
+
+def write_prefill_to_cache(cache: KVCache, slot, ks, vs):
+    """Install one prefilled request's k/v ([L, 1, heads, S, hd]) into
+    ``slot`` of the cache, positions 0..S-1. ``slot`` may be traced (the
+    jitted admission path): dynamic_update_slice keeps the shape fixed."""
+    def place(cache_side, new):
+        # [L, slots, heads, max_len, hd] <- [L, 1, heads, S, hd] at
+        # (0, slot, 0, 0, 0)
+        return jax.lax.dynamic_update_slice(
+            cache_side, new.astype(cache_side.dtype), (0, slot, 0, 0, 0)
+        )
+
+    return KVCache(k=place(cache.k, ks), v=place(cache.v, vs))
+
+
+def gpt2_decode_step(config, params, tokens, positions, cache: KVCache):
+    """One incremental token for every slot.
+
+    ``tokens`` [slots] int32 (each slot's previous token), ``positions``
+    [slots] int32 (that token's position == tokens already cached for the
+    slot). Returns ``(logits [slots, vocab_padded], cache)`` with this
+    step's k/v written. Dead slots ride along (fixed shape); their writes
+    land at their stale position and their logits are discarded by the
+    scheduler.
+    """
+    tp = params["transformer"]
+    layer_cfg = config.layer_config()
+    x = tp["wte"][tokens] + tp["wpe"][positions]  # [slots, H]
+    x = x[:, None, :]  # [slots, 1, H]
+
+    def body(x, xs):
+        pl, kc, vc = xs
+        x, kc, vc = transformer_block_decode(
+            layer_cfg, pl, x, kc, vc, positions
+        )
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (tp["h"], cache.k, cache.v)
+    )
+    logits = _final_norm_and_logits(config, tp, x)
+    return logits[:, 0, :], KVCache(k=k_cache, v=v_cache)

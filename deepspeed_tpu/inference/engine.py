@@ -1,0 +1,422 @@
+"""InferenceEngine: the serving façade behind ``deepspeed_tpu.init_inference``.
+
+Glues the three layers together:
+
+  params     — taken from the caller (or loaded through the resilience
+               verified-load path when ``inference.checkpoint.load_dir``
+               is set: manifest check, host-side parse, newest-valid
+               fallback — runtime/checkpointing.load_module_state), cast
+               to the serving dtype and PINNED to device shardings
+               (tensor-parallel ``param_specs`` or replicated) before the
+               first compile, so decode steps never re-place weights.
+  decode     — jitted prefill / fixed-shape decode+sample programs over
+               inference/decode.py and inference/sampling.py, with the KV
+               cache donated through each step (no cache copies) and the
+               PRNG key threaded explicitly.
+  scheduling — a ContinuousBatchingScheduler (scheduler.py) owning the
+               bounded admission queue and the slot table; ``generate``
+               is the synchronous convenience over it, ``submit`` +
+               ``serve_forever`` the server mode.
+
+Telemetry (infer/* streams, docs/observability.md) registers into the
+config-built Telemetry registry when the ``telemetry`` block is enabled
+— TTFT and queue-depth export through the same jsonl/Prometheus sinks as
+the training engine's streams — and onto a private registry otherwise
+(counting is cheap; tests and the bench smoke read it either way).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import constants as C
+from ..config.config import DeepSpeedConfig, DeepSpeedConfigError
+from ..models.gpt2 import kv_cache_partition_specs
+from ..parallel import mesh as mesh_lib
+from ..telemetry.manager import build_telemetry, register_inference_metrics
+from ..telemetry.registry import MetricsRegistry
+from ..utils.logging import log_dist
+from .decode import (
+    gpt2_decode_step,
+    gpt2_prefill,
+    init_kv_cache,
+    write_prefill_to_cache,
+)
+from .sampling import sample_tokens
+from .scheduler import ContinuousBatchingScheduler, RequestRejected  # noqa: F401  (re-exported)
+
+_BATCH_KEYS = (
+    C.TRAIN_BATCH_SIZE,
+    C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+    C.GRADIENT_ACCUMULATION_STEPS,
+)
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        model=None,
+        config=None,
+        model_parameters=None,
+        mesh=None,
+        param_specs=None,
+        rng_seed=0,
+    ):
+        mcfg = getattr(model, "config", None)
+        if mcfg is None or not all(
+            hasattr(mcfg, a) for a in ("n_layer", "n_head", "n_embd",
+                                       "n_positions", "layer_config")
+        ):
+            raise DeepSpeedConfigError(
+                "init_inference serves the GPT-2 family: pass a "
+                "GPT2LMHeadModel (a module whose .config carries "
+                "n_layer/n_head/n_embd/n_positions)"
+            )
+        if getattr(mcfg, "moe_experts", 0) > 0:
+            raise DeepSpeedConfigError(
+                "KV-cache decode does not support MoE layers yet "
+                "(moe_experts > 0)"
+            )
+        if getattr(mcfg, "pipeline_stages", 1) > 1:
+            raise DeepSpeedConfigError(
+                "KV-cache decode does not support the pipelined stack yet "
+                "(pipeline_stages > 1)"
+            )
+        if model_parameters is None:
+            raise ValueError(
+                "model_parameters (the parameter pytree, e.g. freshly "
+                "initialized or about to be overwritten by the checkpoint "
+                "load) is required"
+            )
+        self.module = model
+        self.model_config = mcfg
+
+        # ---- config (training keys get inert defaults: the batch
+        # triangle is meaningless for serving but the shared validator
+        # requires one anchor) --------------------------------------
+        if config is None:
+            raw = {}
+        elif isinstance(config, dict):
+            raw = dict(config)
+        else:  # JSON path, same contract as initialize()
+            from ..config.config_utils import load_config_json
+
+            raw = load_config_json(config)
+        if not any(k in raw for k in _BATCH_KEYS):
+            raw[C.TRAIN_BATCH_SIZE] = 1
+        self._mesh = mesh
+        if self._mesh is None:
+            mesh_block = raw.get(C.MESH, {})
+            self._mesh = mesh_lib.build_mesh(
+                data_parallel_size=mesh_block.get(
+                    C.MESH_DATA_PARALLEL_SIZE
+                ),
+                model_parallel_size=mesh_block.get(
+                    C.MESH_MODEL_PARALLEL_SIZE, 1
+                ),
+            )
+        self.config = DeepSpeedConfig(None, param_dict=raw, world_size=1)
+        cfg = self.config
+
+        # ---- geometry -------------------------------------------------
+        self.max_seq_len = cfg.inference_max_seq_len or mcfg.n_positions
+        if self.max_seq_len > mcfg.n_positions:
+            raise DeepSpeedConfigError(
+                f"inference.max_seq_len={self.max_seq_len} exceeds the "
+                f"model's n_positions={mcfg.n_positions}"
+            )
+        self.prefill_len = cfg.inference_prefill_len or self.max_seq_len
+        if self.prefill_len > self.max_seq_len:
+            # config-level validation only sees an explicit max_seq_len;
+            # with the model-derived default the check lands here — fail
+            # at init, not as a wpe broadcast error in the first prefill
+            raise DeepSpeedConfigError(
+                f"inference.prefill_len={self.prefill_len} exceeds the "
+                f"resolved max_seq_len={self.max_seq_len} (model "
+                f"n_positions={mcfg.n_positions})"
+            )
+        self.num_slots = cfg.inference_max_batch_slots
+        self.compute_dtype = (
+            jnp.bfloat16 if cfg.inference_dtype == "bf16" else jnp.float32
+        )
+
+        # ---- telemetry + metrics --------------------------------------
+        n_params = sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(model_parameters)
+        )
+        self.telemetry = build_telemetry(
+            cfg, rank=jax.process_index(), n_params=n_params
+        )
+        registry = (
+            self.telemetry.registry
+            if self.telemetry.enabled else MetricsRegistry()
+        )
+        self.metrics = register_inference_metrics(registry)
+
+        # ---- params: verified load, cast, pin -------------------------
+        import types
+
+        from ..resilience.manager import build_resilience
+
+        # resilience instruments share the inference registry whether or
+        # not a telemetry block is configured, so corruption fallbacks on
+        # the serving load are observable next to the infer/* streams
+        self.resilience = build_resilience(
+            cfg,
+            telemetry=types.SimpleNamespace(
+                enabled=True, registry=self.metrics
+            ),
+        )
+        params = model_parameters
+        self.loaded_tag = None
+        if cfg.inference_checkpoint_load_dir:
+            from ..runtime.checkpointing import load_module_state
+
+            loaded, _, tag = load_module_state(
+                cfg.inference_checkpoint_load_dir,
+                params,
+                tag=cfg.inference_checkpoint_tag,
+                resilience=self.resilience,
+            )
+            if loaded is None:
+                raise RuntimeError(
+                    f"no loadable checkpoint under "
+                    f"{cfg.inference_checkpoint_load_dir!r} (see the "
+                    f"resilience/corruption_fallbacks counter and logs)"
+                )
+            params, self.loaded_tag = loaded, tag
+
+        from ..runtime import zero as zero_lib
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if param_specs is not None:
+            shardings = zero_lib.specs_to_shardings(param_specs, self._mesh)
+        else:
+            shardings = jax.tree_util.tree_map(
+                lambda _: NamedSharding(self._mesh, P()), params
+            )
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p, self.compute_dtype), params
+            ),
+            shardings,
+        )
+
+        # ---- KV cache + jitted programs -------------------------------
+        from .decode import KVCache
+
+        cache_sharding = NamedSharding(self._mesh, kv_cache_partition_specs())
+        self._cache = jax.device_put(
+            init_kv_cache(
+                mcfg, self.num_slots, self.max_seq_len, self.compute_dtype
+            ),
+            KVCache(k=cache_sharding, v=cache_sharding),
+        )
+        self._key = jax.random.PRNGKey(rng_seed)
+        self._lengths = np.zeros(self.num_slots, np.int32)
+        self._last_tokens = np.zeros(self.num_slots, np.int32)
+        self._temps = np.full(
+            self.num_slots,
+            0.0 if cfg.inference_greedy else cfg.inference_temperature,
+            np.float32,
+        )
+        self._sampling_statics = dict(
+            vocab_size=getattr(mcfg, "vocab_size", None)
+            or int(self.params["transformer"]["wte"].shape[0]),
+            top_k=int(cfg.inference_top_k),
+            top_p=float(cfg.inference_top_p),
+        )
+
+        # cache buffers are donated through every decode step (no copy per
+        # token) where the backend honors donation; CPU does not, and the
+        # per-call warning would bury test logs
+        platform = jax.devices()[0].platform
+        donate_cache = platform != "cpu"
+        self._jit_prefill = jax.jit(
+            lambda p, toks: gpt2_prefill(mcfg, p, toks)
+        )
+        self._jit_write_prefill = jax.jit(
+            write_prefill_to_cache,
+            donate_argnums=(0,) if donate_cache else (),
+        )
+        self._jit_decode = jax.jit(
+            lambda p, toks, pos, temps, key, cache: self._decode_and_sample(
+                p, toks, pos, temps, key, cache
+            ),
+            donate_argnums=(5,) if donate_cache else (),
+        )
+        # first token rides a traced last-prompt-row index so every prompt
+        # length reuses ONE compiled program (an eager logits[:, plen-1]
+        # slice would compile per distinct length and trip the
+        # no-recompile pin)
+        self._jit_first_token = jax.jit(
+            lambda logits, idx, key, temp: sample_tokens(
+                jax.lax.dynamic_slice_in_dim(logits, idx, 1, axis=1)[:, 0, :],
+                key, temp, **self._sampling_statics,
+            )
+        )
+
+        # ---- scheduler ------------------------------------------------
+        self.scheduler = ContinuousBatchingScheduler(
+            self,
+            num_slots=self.num_slots,
+            max_seq_len=self.max_seq_len,
+            queue_depth=cfg.inference_queue_depth,
+            queue_timeout=cfg.inference_queue_timeout,
+            eos_token_id=cfg.inference_eos_token_id,
+            temperature=(
+                0.0 if cfg.inference_greedy else cfg.inference_temperature
+            ),
+            registry=self.metrics,
+            telemetry=self.telemetry,
+            export_interval=getattr(self.telemetry, "interval", 1) * 16,
+        )
+        log_dist(
+            f"init_inference: {self.num_slots} decode slots x "
+            f"max_seq_len {self.max_seq_len} (prefill window "
+            f"{self.prefill_len}), dtype "
+            f"{cfg.inference_dtype}, queue depth "
+            f"{cfg.inference_queue_depth}"
+            + (f", serving checkpoint {self.loaded_tag}"
+               if self.loaded_tag else ""),
+            ranks=[0],
+        )
+
+    # -- device hooks (called by the scheduler) -------------------------
+    def _decode_and_sample(self, params, tokens, positions, temps, key,
+                           cache):
+        logits, cache = gpt2_decode_step(
+            self.model_config, params, tokens, positions, cache
+        )
+        next_tokens = sample_tokens(
+            logits, key, temps, **self._sampling_statics
+        )
+        return next_tokens, cache
+
+    def prefill_request(self, slot, prompt_tokens, temperature):
+        """Run one request's prefill into ``slot``: cache rows 0..P-1
+        written, first token sampled from the prompt's last logit row.
+        Returns the first generated token (a host int)."""
+        plen = len(prompt_tokens)
+        padded = np.zeros((1, self.prefill_len), np.int32)
+        padded[0, :plen] = prompt_tokens
+        logits, ks, vs = self._jit_prefill(self.params, jnp.asarray(padded))
+        self._cache = self._jit_write_prefill(
+            self._cache, jnp.int32(slot), ks, vs
+        )
+        self._key, sub = jax.random.split(self._key)
+        first = self._jit_first_token(
+            logits, jnp.int32(plen - 1), sub,
+            jnp.full((1,), temperature, jnp.float32),
+        )
+        first = int(np.asarray(first)[0])
+        self._lengths[slot] = plen
+        self._last_tokens[slot] = first
+        self._temps[slot] = temperature
+        return first
+
+    def decode_tokens(self, active_slots):
+        """One fixed-shape decode step over ALL slots; commits length /
+        last-token bookkeeping for ``active_slots`` and returns their
+        sampled tokens as host ints (same order)."""
+        self._key, sub = jax.random.split(self._key)
+        next_tokens, self._cache = self._jit_decode(
+            self.params,
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(self._lengths),
+            jnp.asarray(self._temps),
+            sub,
+            self._cache,
+        )
+        next_tokens = np.asarray(next_tokens)
+        out = []
+        for slot in active_slots:
+            token = int(next_tokens[slot])
+            self._lengths[slot] += 1
+            self._last_tokens[slot] = token
+            out.append(token)
+        return out
+
+    # -- serving API ----------------------------------------------------
+    def submit(self, prompt_tokens, **kwargs):
+        """Front-door submission; see
+        :meth:`ContinuousBatchingScheduler.submit`."""
+        return self.scheduler.submit(prompt_tokens, **kwargs)
+
+    def generate(self, prompts, max_new_tokens=32, temperature=None,
+                 eos_token_id=None):
+        """Synchronous batch generation: submit every prompt (token-id
+        lists), drive the scheduler until all finish, return the
+        generated token-id lists in prompt order."""
+        requests = []
+        try:
+            for p in prompts:
+                requests.append(self.submit(
+                    p, max_new_tokens=max_new_tokens,
+                    temperature=temperature, eos_token_id=eos_token_id,
+                ))
+        except Exception:
+            # a rejected/invalid later prompt must not orphan the earlier
+            # submissions in the queue (they would burn decode work on a
+            # future call with nobody holding their handles)
+            for r in requests:
+                r.cancel()
+            raise
+        if self.scheduler.driving:
+            # a serve_forever thread owns the step loop — driving it from
+            # this thread too would race the slot table and the donated
+            # cache buffers; just wait for the server to finish ours
+            results = [r.result() for r in requests]
+        else:
+            self.scheduler.run_until_idle()
+            results = [r.result() for r in requests]
+        for r in requests:
+            if r.finish_reason == "cancelled":
+                # a crashed driver / concurrent close() fail-finished the
+                # request mid-flight; partial tokens must not masquerade
+                # as a completed generation
+                raise RuntimeError(
+                    f"generation cancelled after {len(r.tokens)} of up to "
+                    f"{r.max_new_tokens} tokens (scheduler shut down or "
+                    "its driver crashed)"
+                )
+        return results
+
+    def serve_forever(self):
+        return self.scheduler.serve_forever()
+
+    def close(self):
+        self.scheduler.shutdown()
+        if self.telemetry.enabled:
+            self.telemetry.export()
+            self.telemetry.close()
+
+
+def init_inference(
+    model=None,
+    config=None,
+    model_parameters=None,
+    mesh=None,
+    param_specs=None,
+    rng_seed=0,
+):
+    """Build a serving engine around ``model`` (reference analog: the
+    training-side ``deepspeed.initialize``; early DeepSpeed had no
+    inference entry point — PAPER.md stops at training).
+
+    ``config`` is a dict or JSON path whose ``"inference"`` block sizes
+    the engine (docs/inference.md); ``model_parameters`` provides the
+    parameter pytree (overwritten in place of value — not structure —
+    when ``inference.checkpoint.load_dir`` names a checkpoint to serve).
+    Returns an :class:`InferenceEngine`.
+    """
+    return InferenceEngine(
+        model=model,
+        config=config,
+        model_parameters=model_parameters,
+        mesh=mesh,
+        param_specs=param_specs,
+        rng_seed=rng_seed,
+    )

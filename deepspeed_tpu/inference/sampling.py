@@ -1,0 +1,78 @@
+"""Jitted token sampling: greedy / temperature / top-k / top-p.
+
+One fixed-shape function over ``[slots, vocab_padded]`` logits so it
+fuses into the decode step's compiled program. PRNG discipline is
+explicit key threading: the engine splits its key once per decode step
+and passes the subkey in — no hidden state, so a generation replays
+bit-identically from the same seed regardless of how requests were
+interleaved by the scheduler.
+
+Per-slot ``temperature`` rides as an ARRAY (temperature scaling is
+row-local), with ``temperature <= 0`` meaning greedy for that slot — so
+one compiled program serves greedy and sampled requests side by side in
+the same continuous batch. ``top_k``/``top_p``/vocab size are engine-wide
+statics compiled into the program (a per-request top-k would change the
+lattice of every step).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import NEG_INF
+
+
+def mask_padded_vocab(logits, vocab_size):
+    """Kill the MXU-padding vocab columns (models pad vocab to a multiple
+    of 128; those rows of wte are random init, and argmax over them would
+    emit unreal token ids)."""
+    if logits.shape[-1] == vocab_size:
+        return logits
+    idx = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    return jnp.where(idx < vocab_size, logits, NEG_INF)
+
+
+def _apply_top_k(logits, top_k):
+    """Keep the k highest logits per row; the rest -> -inf."""
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits, NEG_INF)
+
+
+def _apply_top_p(logits, top_p):
+    """Nucleus filtering: keep the smallest prefix of the
+    probability-sorted vocab whose mass reaches ``top_p`` (the
+    highest-probability token always survives — the exclusive cumsum
+    guarantees it, so a peaked distribution cannot mask everything)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+    cutoff_mask = cum < top_p  # per sorted position: keep?
+    # threshold = smallest kept logit, mapped back to the unsorted layout
+    kept = jnp.where(cutoff_mask, sorted_logits, jnp.inf)
+    threshold = jnp.min(kept, axis=-1, keepdims=True)
+    return jnp.where(logits >= threshold, logits, NEG_INF)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("vocab_size", "top_k", "top_p")
+)
+def sample_tokens(
+    logits, key, temperature, *, vocab_size, top_k=0, top_p=1.0
+):
+    """Sample one token per row. ``logits`` [slots, vocab_padded], ``key``
+    a PRNG key consumed whole by this step, ``temperature`` [slots]
+    (<= 0 -> greedy for that row). ``top_k=0`` / ``top_p=1.0`` disable
+    the respective filter. Returns [slots] int32."""
+    logits = mask_padded_vocab(logits.astype(jnp.float32), vocab_size)
+    greedy_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temperature = jnp.asarray(temperature, jnp.float32)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+    if top_k and top_k < vocab_size:
+        scaled = _apply_top_k(scaled, int(top_k))
+    if top_p < 1.0:
+        scaled = _apply_top_p(scaled, float(top_p))
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy_tokens)

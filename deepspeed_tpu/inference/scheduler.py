@@ -1,0 +1,393 @@
+"""Continuous batching: slot-managed admission over a fixed decode width.
+
+The Orca-style iteration-level scheduler (PAPERS.md): instead of batching
+whole requests (a batch lives until its LONGEST member finishes, leaving
+finished rows as dead compute), requests are admitted into per-sequence
+KV-cache SLOTS at every decode-step boundary. A slot frees the moment its
+request hits EOS / max_new_tokens / the length cap, and the next queued
+request joins the running batch one step later — the decode program's
+shapes never change, so joins and leaves never recompile (the jit pin in
+tests/unit/test_inference.py).
+
+The front door is a bounded queue: ``submit`` rejects with
+:class:`RequestRejected` once ``queue_depth`` submissions are waiting
+(after ``queue_timeout_secs`` of grace), so overload sheds at admission
+instead of growing host memory. Everything here is host-side
+orchestration — device work happens through the two engine hooks
+(``prefill_request`` / ``decode_tokens``), keeping this module free of
+jax imports and independently testable.
+
+Slot lifecycle (docs/inference.md has the diagram):
+
+    FREE -> (admit: prefill writes cache rows 0..P-1, first token
+             sampled from the prompt's last logit row = TTFT)
+         -> DECODING (one token per step, position P, P+1, ...)
+         -> (EOS | max_new_tokens | position cap) -> FREE
+"""
+
+import itertools
+import queue
+import threading
+import time
+
+from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS
+from ..utils.logging import logger
+
+
+class RequestRejected(RuntimeError):
+    """The front door shed this request (queue full past the timeout)."""
+
+
+_FINISH_EOS = "eos"
+_FINISH_MAX_NEW = "max_new_tokens"
+_FINISH_LENGTH = "length"
+_FINISH_CANCELLED = "cancelled"
+
+
+class InferenceRequest:
+    """One generation request. ``result()`` blocks until the scheduler
+    finishes it and returns the generated token ids (prompt excluded)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_tokens, max_new_tokens, temperature,
+                 eos_token_id):
+        self.request_id = next(self._ids)
+        self.prompt_tokens = [int(t) for t in prompt_tokens]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self.tokens = []
+        self.finish_reason = None
+        self.submitted_at = time.monotonic()
+        self.first_token_at = None
+        self._done = threading.Event()
+        self._cancelled = False
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def cancel(self):
+        """Withdraw a still-queued request: it finishes with reason
+        ``"cancelled"`` the next time the scheduler reaches it instead of
+        occupying a slot (a request already decoding runs to
+        completion — its slot state lives on device)."""
+        self._cancelled = True
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished after {timeout}s"
+            )
+        return self.tokens
+
+    def _finish(self, reason):
+        self.finish_reason = reason
+        self._done.set()
+
+
+class ContinuousBatchingScheduler:
+    """Admission queue + slot table driving an InferenceEngine's jitted
+    prefill/decode hooks. Thread-safety: ``submit`` may be called from any
+    thread; ``step``/``run_until_idle`` must run on one driver thread
+    (``serve_forever`` provides one)."""
+
+    def __init__(self, engine, *, num_slots, max_seq_len, queue_depth,
+                 queue_timeout, eos_token_id, temperature, registry,
+                 telemetry=None, export_interval=16):
+        self._engine = engine
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(max_seq_len)
+        self._queue = queue.Queue(maxsize=int(queue_depth))
+        self._queue_timeout = float(queue_timeout)
+        self._eos_token_id = eos_token_id
+        self._default_temperature = float(temperature)
+        self._slots = [None] * self.num_slots
+        self._registry = registry
+        self._telemetry = telemetry
+        self._export_interval = max(1, int(export_interval))
+        self._steps = 0
+        self._tokens_since_rate = 0
+        self._rate_anchor = None
+        self._stop = threading.Event()
+        self._thread = None
+        # serializes DRIVERS (run_until_idle / the serve thread): two
+        # concurrent generate() calls must take turns, not race the slot
+        # table, the PRNG key, and the donated cache buffers
+        self._drive_lock = threading.Lock()
+
+        reg = registry
+        self._ttft_ms = reg.histogram(
+            "infer/ttft_ms", buckets=DEFAULT_TIME_BUCKETS_MS
+        )
+        self._token_latency_ms = reg.histogram(
+            "infer/token_latency_ms", buckets=DEFAULT_TIME_BUCKETS_MS
+        )
+        self._prefill_ms = reg.histogram(
+            "infer/prefill_time_ms", buckets=DEFAULT_TIME_BUCKETS_MS
+        )
+        self._queue_wait_ms = reg.histogram(
+            "infer/queue_wait_ms", buckets=DEFAULT_TIME_BUCKETS_MS
+        )
+        self._tokens_per_sec = reg.gauge("infer/tokens_per_sec")
+        self._queue_depth = reg.gauge("infer/queue_depth")
+        self._occupancy = reg.gauge("infer/slot_occupancy")
+        self._admitted = reg.counter("infer/requests_admitted")
+        self._rejected = reg.counter("infer/requests_rejected")
+        self._completed = reg.counter("infer/requests_completed")
+        self._tokens_generated = reg.counter("infer/tokens_generated")
+
+    # -- front door -----------------------------------------------------
+    def submit(self, prompt_tokens, max_new_tokens=32, temperature=None,
+               eos_token_id=None, timeout=None):
+        """Enqueue a request; returns the :class:`InferenceRequest`
+        handle. Raises :class:`RequestRejected` when the bounded queue
+        stays full past ``timeout`` (default: the config's
+        ``queue_timeout_secs``) and ``ValueError`` for prompts the engine
+        can never serve (longer than the prefill window, or leaving no
+        room to generate)."""
+        if self._stop.is_set():
+            self._rejected.inc()
+            raise RequestRejected("scheduler is shut down")
+        n = len(prompt_tokens)
+        if n == 0:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens!r} "
+                "(prefill always samples the first token)"
+            )
+        if n > self._engine.prefill_len:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds prefill_len="
+                f"{self._engine.prefill_len}; raise inference.prefill_len "
+                f"(or max_seq_len)"
+            )
+        if n >= self.max_seq_len:
+            raise ValueError(
+                f"prompt of {n} tokens leaves no room to generate under "
+                f"max_seq_len={self.max_seq_len}"
+            )
+        req = InferenceRequest(
+            prompt_tokens,
+            max_new_tokens=max_new_tokens,
+            temperature=(
+                self._default_temperature
+                if temperature is None else temperature
+            ),
+            eos_token_id=(
+                self._eos_token_id if eos_token_id is None else eos_token_id
+            ),
+        )
+        wait = self._queue_timeout if timeout is None else float(timeout)
+        try:
+            if wait > 0:
+                self._queue.put(req, timeout=wait)
+            else:
+                self._queue.put_nowait(req)
+        except queue.Full:
+            self._rejected.inc()
+            raise RequestRejected(
+                f"request queue full ({self._queue.maxsize} waiting); "
+                f"rejected after {wait:.3f}s"
+            ) from None
+        if self._stop.is_set():
+            # raced shutdown's outstanding-request drain: nobody will
+            # service this — fail it now so result() cannot hang
+            req.cancel()
+            req._finish(_FINISH_CANCELLED)
+            self._rejected.inc()
+            raise RequestRejected("scheduler is shut down")
+        self._admitted.inc()
+        self._queue_depth.set(self._queue.qsize())
+        return req
+
+    # -- scheduling -----------------------------------------------------
+    @property
+    def active_slots(self):
+        return [i for i, r in enumerate(self._slots) if r is not None]
+
+    def _admit(self):
+        """Fill free slots from the queue: prefill each admitted request
+        and sample its first token (TTFT ends here)."""
+        for slot, occupant in enumerate(self._slots):
+            if occupant is not None:
+                continue
+            req = None
+            while req is None:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._queue_depth.set(self._queue.qsize())
+                if req._cancelled:
+                    req._finish(_FINISH_CANCELLED)
+                    req = None  # withdrawn: keep the slot for the next one
+            if req is None:
+                break
+            t0 = time.monotonic()
+            self._queue_wait_ms.observe((t0 - req.submitted_at) * 1e3)
+            first = self._engine.prefill_request(
+                slot, req.prompt_tokens, req.temperature
+            )
+            now = time.monotonic()
+            self._prefill_ms.observe((now - t0) * 1e3)
+            req.first_token_at = now
+            self._ttft_ms.observe((now - req.submitted_at) * 1e3)
+            self._slots[slot] = req
+            # a 1-token request (or instant EOS) frees the slot right here
+            self._count_token(req, first)
+        self._occupancy.set(len(self.active_slots))
+
+    def _count_token(self, req, token):
+        """Record one generated token for ``req`` (slot state lives in the
+        engine's arrays); free the slot when the request is finished."""
+        req.tokens.append(int(token))
+        self._tokens_generated.inc()
+        self._tokens_since_rate += 1
+        reason = None
+        if req.eos_token_id is not None and int(token) == int(req.eos_token_id):
+            reason = _FINISH_EOS
+        elif len(req.tokens) >= req.max_new_tokens:
+            reason = _FINISH_MAX_NEW
+        elif len(req.prompt_tokens) + len(req.tokens) >= self.max_seq_len:
+            reason = _FINISH_LENGTH
+        if reason is not None:
+            slot = self._slots.index(req)
+            self._slots[slot] = None
+            self._completed.inc()
+            req._finish(reason)
+
+    def step(self):
+        """One scheduler iteration: admit into free slots, then one decode
+        step for every active slot. Returns the number of active slots
+        decoded (0 = idle)."""
+        # anchor the rate window BEFORE this step's work so its tokens
+        # divide by the time that produced them (anchoring after the fact
+        # inflated the gauge arbitrarily for sub-window runs)
+        if self._rate_anchor is None:
+            self._rate_anchor = time.monotonic()
+            self._tokens_since_rate = 0
+        self._admit()
+        active = self.active_slots
+        if not active:
+            self._flush_rate()  # settle the window's tail tokens
+            self._rate_anchor = None  # idle: don't dilute the next window
+            return 0
+        t0 = time.monotonic()
+        next_tokens = self._engine.decode_tokens(active)
+        self._token_latency_ms.observe((time.monotonic() - t0) * 1e3)
+        for slot, token in zip(active, next_tokens):
+            req = self._slots[slot]
+            if req is not None:
+                self._count_token(req, token)
+        self._occupancy.set(len(self.active_slots))
+        self._steps += 1
+        self._update_rate()
+        if (
+            self._telemetry is not None
+            and self._telemetry.enabled
+            and self._steps % self._export_interval == 0
+        ):
+            self._telemetry.export(step=self._steps)
+        return len(active)
+
+    def _update_rate(self):
+        if self._rate_anchor is None:
+            return
+        now = time.monotonic()
+        elapsed = now - self._rate_anchor
+        if elapsed >= 0.25:  # smooth over at least a quarter second
+            self._tokens_per_sec.set(self._tokens_since_rate / elapsed)
+            self._tokens_since_rate = 0
+            self._rate_anchor = now
+
+    def run_until_idle(self):
+        """Drive steps until no request is active or queued (the
+        synchronous ``generate()`` path). Serialized: concurrent callers
+        take turns as the driver instead of racing the slot table."""
+        with self._drive_lock:
+            while not self._stop.is_set() and (
+                self.step() or not self._queue.empty()
+            ):
+                pass
+            self._flush_rate()
+
+    def _flush_rate(self):
+        now = time.monotonic()
+        if self._rate_anchor is not None and self._tokens_since_rate:
+            elapsed = max(now - self._rate_anchor, 1e-9)
+            self._tokens_per_sec.set(self._tokens_since_rate / elapsed)
+            self._tokens_since_rate = 0
+            self._rate_anchor = now
+
+    # -- background serving ---------------------------------------------
+    @property
+    def driving(self):
+        """True while a LIVE ``serve_forever`` thread owns the step loop
+        (other threads must then WAIT on requests, never call step()). A
+        crashed driver reads as not driving — its requests were already
+        fail-finished."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def serve_forever(self, idle_sleep=0.005):
+        """Drive the scheduler on a daemon thread until :meth:`shutdown`
+        (the long-running server mode; ``submit`` from any thread). A
+        step that raises (device OOM, runtime error) stops the server and
+        fail-finishes everything outstanding — ``result()`` waiters get
+        their ``"cancelled"`` answer instead of hanging on a dead loop."""
+        if self.driving:
+            return self._thread
+
+        def loop():
+            try:
+                while not self._stop.is_set():
+                    with self._drive_lock:
+                        n = self.step()
+                    if n == 0:
+                        time.sleep(idle_sleep)
+            except Exception:
+                logger.exception(
+                    "inference scheduler driver crashed; rejecting new "
+                    "submissions and cancelling outstanding requests"
+                )
+                self._stop.set()
+                self._fail_finish_outstanding()
+
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=loop, name="ds-infer-scheduler", daemon=True
+        )
+        self._thread.start()
+        return self._thread
+
+    def shutdown(self, timeout=5.0):
+        """Stop the driver thread and FAIL-FINISH everything outstanding
+        (reason ``"cancelled"``) — a ``result()`` waiter must never hang
+        on a request the stopped loop will no longer advance. Subsequent
+        ``submit`` calls raise :class:`RequestRejected`."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        # under the drive lock: a step that outlived join(timeout) (e.g.
+        # a first-step compile) must not race the slot clear — waiters
+        # would tear-read tokens the live step still appends to
+        with self._drive_lock:
+            self._fail_finish_outstanding()
+        self._flush_rate()
+
+    def _fail_finish_outstanding(self):
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req._finish(_FINISH_CANCELLED)
+        for slot, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[slot] = None
+                req._finish(_FINISH_CANCELLED)
+        self._queue_depth.set(0)
+        self._occupancy.set(0)

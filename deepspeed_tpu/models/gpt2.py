@@ -353,6 +353,17 @@ class GPT2LMHeadModel(nn.Module):
         return lm_loss + moe_aux, lm_loss, moe_aux
 
 
+def kv_cache_partition_specs(mp_axis=MODEL_AXIS):
+    """PartitionSpec for a decode KV cache laid out
+    ``[layers, slots, heads, max_len, head_dim]`` (inference/decode.py):
+    heads shard over the mesh's ``model`` axis — the same Megatron head
+    split ``partition_specs`` applies to the qkv projections that produce
+    them, so prefill/decode write each head's cache rows on the chip that
+    owns that head's weights. Layers/slots/positions stay unsharded
+    (slots join and leave every step; resharding them would thrash)."""
+    return P(None, None, mp_axis, None, None)
+
+
 def partition_specs(params, mp_axis=MODEL_AXIS, pipeline=False):
     """Megatron-style tensor-parallel PartitionSpecs for a GPT2LMHeadModel
     param tree (same structure, PartitionSpec leaves).

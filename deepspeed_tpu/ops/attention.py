@@ -607,10 +607,10 @@ def flash_attention_sharded(
     over ``model`` (Megatron-style head split); the sequence axis stays
     local — sequence sharding goes through parallel/sequence.py instead.
     """
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..config.constants import DATA_AXIS, MODEL_AXIS
+    from ..runtime.dist import shard_map
 
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -641,7 +641,7 @@ def flash_attention_sharded(
         mesh=mesh,
         in_specs=(qspec, qspec, qspec, P(DATA_AXIS, None) if use_mask else P(), P()),
         out_specs=qspec,
-        check_rep=False,
+        check=False,
     )(q, k, v, kv_mask if use_mask else jnp.zeros((), jnp.int32), seed)
 
 

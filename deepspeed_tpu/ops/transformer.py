@@ -32,7 +32,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .attention import additive_mask_to_kv_valid, attention
+from .attention import NEG_INF, additive_mask_to_kv_valid, attention
 
 
 @dataclasses.dataclass
@@ -171,6 +171,27 @@ TRANSFORMER_PARAM_LAYOUT = (
 )
 
 
+def layer_norm_apply(cfg: DeepSpeedTransformerConfig, x, scale, bias):
+    """The block's LayerNorm (module-level so the KV-cache decode path
+    shares the exact arithmetic). stochastic_mode keeps LN statistics in
+    the compute dtype (the reference's __STOCHASTIC_MODE__ relaxed
+    kernel); default is fp32. bf16 only: it shares fp32's exponent range,
+    so x^2 cannot overflow the statistics — fp16 (range to 65504, eps
+    underflow) always takes the fp32 path."""
+    relaxed = cfg.stochastic_mode and x.dtype == jnp.bfloat16
+    xs = x if relaxed else x.astype(jnp.float32)
+    mean = jnp.mean(xs, axis=-1, keepdims=True)
+    var = jnp.var(xs, axis=-1, keepdims=True)
+    # eps joins in fp32 regardless: 1e-12 underflows in bf16/fp16
+    inv = jax.lax.rsqrt(
+        var.astype(jnp.float32) + cfg.layer_norm_eps
+    ).astype(xs.dtype)
+    y = (xs - mean) * inv
+    return (y * scale.astype(xs.dtype) + bias.astype(xs.dtype)).astype(
+        x.dtype
+    )
+
+
 def transformer_block_apply(
     cfg: DeepSpeedTransformerConfig,
     p: dict,
@@ -184,6 +205,7 @@ def transformer_block_apply(
     train=True,
     dropout_rng=None,
     ffn_fn=None,
+    return_kv=False,
 ):
     """Pure-function transformer block over the 12-tensor param dict ``p``
     (keys per TRANSFORMER_PARAM_LAYOUT). Shared by the flax layer module
@@ -196,7 +218,15 @@ def transformer_block_apply(
     Used by the MoE layer (ops/moe.py) to swap in an expert-parallel FFN
     while keeping the attention sublayer and LN/dropout/residual
     structure; when it returns an aux value (the router's load-balancing
-    loss) this function returns ``(out, aux)``."""
+    loss) this function returns ``(out, aux)``.
+
+    ``return_kv``: additionally return this block's split-head key/value
+    projections ``(k, v)`` each [B, heads, S, hd] — the KV-cache PREFILL
+    mode (inference/decode.py): the values attention consumed are exactly
+    the values the cache must hold, so no second projection pass runs.
+    Result becomes ``(out, (k, v))``; remat is skipped (no backward
+    exists to recompute for) and MoE aux / sequence parallelism do not
+    compose with it."""
     H = cfg.hidden_size
     heads = cfg.heads
     head_dim = H // heads
@@ -228,23 +258,7 @@ def transformer_block_apply(
         )
 
     def layer_norm(x, scale, bias):
-        # stochastic_mode keeps LN statistics in the compute dtype (the
-        # reference's __STOCHASTIC_MODE__ relaxed kernel); default is fp32.
-        # bf16 only: it shares fp32's exponent range, so x^2 cannot
-        # overflow the statistics — fp16 (range to 65504, eps underflow)
-        # always takes the fp32 path.
-        relaxed = cfg.stochastic_mode and x.dtype == jnp.bfloat16
-        xs = x if relaxed else x.astype(jnp.float32)
-        mean = jnp.mean(xs, axis=-1, keepdims=True)
-        var = jnp.var(xs, axis=-1, keepdims=True)
-        # eps joins in fp32 regardless: 1e-12 underflows in bf16/fp16
-        inv = jax.lax.rsqrt(
-            var.astype(jnp.float32) + cfg.layer_norm_eps
-        ).astype(xs.dtype)
-        y = (xs - mean) * inv
-        return (y * scale.astype(xs.dtype) + bias.astype(xs.dtype)).astype(
-            x.dtype
-        )
+        return layer_norm_apply(cfg, x, scale, bias)
 
     def block(x):
         b, s, _ = x.shape
@@ -267,9 +281,16 @@ def transformer_block_apply(
             mesh is not None
             and dict(mesh.shape).get(C.SEQUENCE_AXIS, 1) > 1
         )
+        qh, kh, vh = split_heads(q), split_heads(k_), split_heads(v)
         if seq_parallel:
             from ..parallel.sequence import sequence_parallel_attention
 
+            if return_kv:
+                raise ValueError(
+                    "return_kv (KV-cache prefill) does not compose with "
+                    "sequence-parallel attention; decode with a mesh whose "
+                    "sequence axis is 1"
+                )
             kv_valid = additive_mask_to_kv_valid(attention_mask)
             if attention_mask is not None and kv_valid is None:
                 raise ValueError(
@@ -277,7 +298,7 @@ def transformer_block_apply(
                     "masks only (broadcast over the query dim)"
                 )
             ctx = sequence_parallel_attention(
-                split_heads(q), split_heads(k_), split_heads(v),
+                qh, kh, vh,
                 mesh, kv_valid, impl=seq_parallel_impl,
                 use_flash=use_flash, causal=causal,
                 dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
@@ -287,7 +308,7 @@ def transformer_block_apply(
             # with a dp/mp mesh the dispatcher runs flash per-shard via
             # shard_map instead of falling back to O(S^2) attention
             ctx = attention(
-                split_heads(q), split_heads(k_), split_heads(v),
+                qh, kh, vh,
                 mask=attention_mask, causal=causal,
                 dropout_rate=cfg.attn_dropout_ratio if train else 0.0,
                 dropout_rng=attn_rng, use_flash=use_flash,
@@ -319,9 +340,16 @@ def transformer_block_apply(
         x = residual + h
         if not cfg.pre_layer_norm:
             x = layer_norm(x, p["norm_w"], p["norm_b"])
+        if return_kv:
+            if ffn_aux is not None:
+                raise ValueError(
+                    "return_kv does not compose with an aux-returning "
+                    "ffn_fn (MoE decode is not supported)"
+                )
+            return x, (kh, vh)
         return x if ffn_aux is None else (x, ffn_aux)
 
-    if cfg.use_remat:
+    if cfg.use_remat and not return_kv:
         if cfg.remat_policy == "full":
             block = jax.checkpoint(block)
         else:
@@ -329,6 +357,100 @@ def transformer_block_apply(
                 block, policy=resolve_remat_policy(cfg.remat_policy)
             )
     return block(hidden_states)
+
+
+def transformer_block_decode(
+    cfg: DeepSpeedTransformerConfig,
+    p: dict,
+    hidden_states,
+    k_cache,
+    v_cache,
+    positions,
+):
+    """One KV-cache incremental-decode step through the block.
+
+    ``hidden_states`` [B, 1, H] is the current token's hidden state per
+    sequence (B = decode slots), ``k_cache``/``v_cache`` [B, heads,
+    max_len, hd] hold every earlier position's projections, ``positions``
+    [B] int32 is this token's position (== tokens already in the cache for
+    that row). The block projects qkv for the single token, WRITES its k/v
+    at ``positions``, and attends the query over cache positions
+    ``<= positions`` — O(max_len) work instead of the O(S^2) full-sequence
+    recompute (the reason models/gpt2.py's training ``__call__`` cannot
+    serve decode traffic).
+
+    Inference-only: eval-mode arithmetic (no dropout), shares
+    ``layer_norm_apply`` and the reference 12-tensor layout with
+    :func:`transformer_block_apply` so a greedy decode rollout reproduces
+    the full-forward argmax trajectory (pinned by
+    tests/unit/test_inference.py). Returns ``(out [B,1,H], k_cache,
+    v_cache)`` with the updated caches.
+    """
+    H = cfg.hidden_size
+    heads = cfg.heads
+    head_dim = H // heads
+    b = hidden_states.shape[0]
+    max_len = k_cache.shape[2]
+
+    def ln(x, scale, bias):
+        return layer_norm_apply(cfg, x, scale, bias)
+
+    # ---- attention sublayer, incremental ------------------------------
+    residual = hidden_states
+    attn_in = (
+        ln(hidden_states, p["attn_nw"], p["attn_nb"])
+        if cfg.pre_layer_norm else hidden_states
+    )
+    qkv = attn_in @ p["attn_qkvw"] + p["attn_qkvb"]  # [B, 1, 3H]
+    q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, heads, head_dim)
+    k_new = k_new.reshape(b, heads, head_dim)
+    v_new = v_new.reshape(b, heads, head_dim)
+
+    # scatter this token's k/v into the cache at its position (advanced
+    # indexing pairs the two [B] index arrays, so row i writes
+    # cache[i, :, positions[i]]); positions are clamped by the caller's
+    # length accounting, and jit scatter drops OOB writes anyway
+    rows = jnp.arange(b)
+    k_cache = k_cache.at[rows, :, positions, :].set(
+        k_new.astype(k_cache.dtype)
+    )
+    v_cache = v_cache.at[rows, :, positions, :].set(
+        v_new.astype(v_cache.dtype)
+    )
+
+    # [B, heads, max_len] scores in f32 (MXU-accumulate dtype discipline
+    # of ops/attention.py); future positions masked by validity, so the
+    # garbage beyond each row's length never contributes
+    sm_scale = 1.0 / (head_dim ** 0.5)
+    s = jnp.einsum(
+        "bhd,bhkd->bhk", q, k_cache, preferred_element_type=jnp.float32
+    ) * sm_scale
+    valid = (
+        jax.lax.broadcasted_iota(jnp.int32, (b, 1, max_len), 2)
+        <= positions[:, None, None]
+    )
+    s = jnp.where(valid, s, NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum(
+        "bhk,bhkd->bhd", probs.astype(v_cache.dtype), v_cache
+    )
+    ctx = ctx.reshape(b, 1, H)
+    attn_out = ctx @ p["attn_ow"] + p["attn_ob"]
+    x = residual + attn_out
+    if not cfg.pre_layer_norm:
+        x = ln(x, p["attn_nw"], p["attn_nb"])
+
+    # ---- feed-forward sublayer (identical to the training block) ------
+    residual = x
+    ff_in = ln(x, p["norm_w"], p["norm_b"]) if cfg.pre_layer_norm else x
+    h = ff_in @ p["inter_w"] + p["inter_b"]
+    h = nn.gelu(h, approximate=True)
+    h = h @ p["output_w"] + p["output_b"]
+    x = residual + h
+    if not cfg.pre_layer_norm:
+        x = ln(x, p["norm_w"], p["norm_b"])
+    return x, k_cache, v_cache
 
 
 class DeepSpeedTransformerLayer(nn.Module):

@@ -157,7 +157,9 @@ def gpipe_spmd(stage_fn, stage_params, microbatches, mesh,
         )
         return out
 
-    return jax.shard_map(
+    from ..runtime.dist import shard_map
+
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(pipe_axis), P(), P()),

@@ -247,12 +247,14 @@ def _shard_mapped(local_fn, mesh, have_valid, have_rng, seq_axis, batch_axis, he
         in_specs.append(kvv_spec)
     if have_rng:
         in_specs.append(P())
-    return jax.shard_map(
+    from ..runtime.dist import shard_map
+
+    return shard_map(
         local_fn,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=qkv_spec,
-        check_vma=False,
+        check=False,
     )
 
 

@@ -344,7 +344,7 @@ class _Staged:
         self.shards = shards  # list of shard payloads, or None
 
 
-def _stage_checkpoint(engine, load_dir, tag, load_optimizer_states, res):
+def _stage_checkpoint(load_dir, tag, load_optimizer_states, res):
     """Read and parse EVERY file of checkpoint ``tag`` into host memory.
 
     Raises on any verification/read/parse failure — the caller decides
@@ -559,18 +559,19 @@ def _apply_checkpoint(
         }
 
 
-def load_checkpoint(
-    engine, load_dir, tag=None, load_optimizer_states=True,
-    load_lr_scheduler_states=True,
-):
-    res = _resilience_of(engine)
-    started = time.monotonic()
+def _stage_with_fallback(load_dir, tag, load_optimizer_states, res):
+    """Resolve ``tag`` (None => the 'latest' pointer), walk candidates
+    newest-first on corruption, stage the first loadable one entirely on
+    host, and agree on the staged tag across hosts. The shared verified-
+    load front half: the training engine's ``load_checkpoint`` applies the
+    result to engine state; the inference engine's ``load_module_state``
+    maps only the module tree. Returns a ``_Staged`` or None."""
     explicit_tag = tag is not None
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(latest):
             log_dist(f"No 'latest' file in {load_dir}", ranks=[0])
-            return None, {}
+            return None
         # same retry discipline as every other checkpoint read: one
         # transient flake on the pointer must not fail the whole resume
         if res.enabled:
@@ -596,7 +597,7 @@ def load_checkpoint(
     for candidate in candidates:
         try:
             staged = _stage_checkpoint(
-                engine, load_dir, candidate, load_optimizer_states, res
+                load_dir, candidate, load_optimizer_states, res
             )
             break
         except Exception as e:
@@ -618,7 +619,7 @@ def load_checkpoint(
             f"(tried {len(candidates)} candidate tag(s))",
             ranks=[0], level=logging.ERROR,
         )
-        return None, {}
+        return None
     if staged.tag != str(tag):
         log_dist(
             f"FALLBACK: checkpoint {tag} was corrupt/missing; resuming "
@@ -648,7 +649,19 @@ def load_checkpoint(
                 "inspect the shared filesystem and retry",
                 ranks=[-1], level=logging.ERROR,
             )
-            return None, {}
+            return None
+    return staged
+
+
+def load_checkpoint(
+    engine, load_dir, tag=None, load_optimizer_states=True,
+    load_lr_scheduler_states=True,
+):
+    res = _resilience_of(engine)
+    started = time.monotonic()
+    staged = _stage_with_fallback(load_dir, tag, load_optimizer_states, res)
+    if staged is None:
+        return None, {}
 
     # ---- transactional apply ----------------------------------------
     # everything parsed; only now does the engine mutate
@@ -662,3 +675,33 @@ def load_checkpoint(
         os.path.join(staged.ckpt_dir, ""),
         staged.state.get("client_state", {}),
     )
+
+
+def load_module_state(load_dir, params_template, tag=None, resilience=None):
+    """Verified MODEL-state load for serving (the init_inference() param
+    path): the same manifest-verify + host-side parse + newest-valid
+    fallback discipline as ``load_checkpoint``, but only the module tree
+    is read (no optimizer shards) and nothing mutates — the restored
+    params map onto ``params_template``'s structure and return as host
+    numpy arrays for the caller to cast/shard/pin.
+
+    Returns ``(params, client_state, tag)``; ``(None, {}, None)`` when no
+    loadable checkpoint exists.
+    """
+    res = resilience if resilience is not None else _resilience_of(None)
+    started = time.monotonic()
+    staged = _stage_with_fallback(
+        load_dir, tag, False, res  # load_optimizer_states=False
+    )
+    if staged is None:
+        return None, {}, None
+    params = serialization.from_state_dict(
+        jax.tree_util.tree_map(np.asarray, params_template),
+        staged.state["module"],
+    )
+    res.observe_load(started)
+    log_dist(
+        f"Loaded model state {staged.tag} from {load_dir} for inference",
+        ranks=[0],
+    )
+    return params, staged.state.get("client_state", {}), staged.tag

@@ -78,6 +78,37 @@ ENGINE_METRICS = (
 )
 
 
+# The inference engine's metric catalog (docs/inference.md,
+# docs/observability.md). Separate from ENGINE_METRICS — training engines
+# must not grow idle infer/* streams in their exports (the golden-catalog
+# test pins ENGINE_METRICS exactly); the InferenceEngine registers these
+# into its telemetry's registry via register_inference_metrics().
+INFERENCE_METRICS = (
+    ("histogram", "infer/ttft_ms", "time to first token: request admission through prefill + first sampled token"),
+    ("histogram", "infer/token_latency_ms", "wall time of one continuous-batching decode step (one token for every active slot)"),
+    ("histogram", "infer/prefill_time_ms", "wall time of one request's prefill (cache write + first-token logits)"),
+    ("histogram", "infer/queue_wait_ms", "time a request waited in the admission queue before a slot freed"),
+    ("gauge", "infer/tokens_per_sec", "decode tokens generated per second over the last export interval"),
+    ("gauge", "infer/queue_depth", "requests waiting in the admission queue"),
+    ("gauge", "infer/slot_occupancy", "decode slots currently serving a request"),
+    ("counter", "infer/requests_admitted", "requests accepted into the admission queue"),
+    ("counter", "infer/requests_rejected", "requests shed at the front door (queue full past the timeout)"),
+    ("counter", "infer/requests_completed", "requests finished (EOS, max_new_tokens, or length cap)"),
+    ("counter", "infer/tokens_generated", "decode tokens sampled across all requests"),
+)
+
+
+def register_inference_metrics(registry):
+    """Pre-register the full infer/* catalog on ``registry`` so every
+    inference export carries the golden set (an absent stream means a
+    broken emitter, not an idle one — the same contract ENGINE_METRICS
+    gives the training engine)."""
+    for kind, name, help_text in INFERENCE_METRICS:
+        getattr(registry, kind)(name, help=help_text)
+    install_recompile_hook(registry.counter("jax/recompiles"))
+    return registry
+
+
 class Telemetry:
     def __init__(
         self,

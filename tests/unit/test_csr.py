@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.runtime.dist import shard_map
 from deepspeed_tpu.runtime.sparse import (
     CSRTensor,
     sparse_all_reduce_local,
@@ -79,12 +80,12 @@ def test_sparse_all_reduce_local_inside_jit():
     from jax.sharding import PartitionSpec as P
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda i, v: sparse_all_reduce_local(i, v, csr.dense_size),
             mesh=mesh,
             in_specs=(P("data"), P("data")),
             out_specs=P(),
-            check_vma=False,
+            check=False,
         )
     )
     out = fn(idx, val)
