@@ -340,6 +340,56 @@ class DeepSpeedConfig:
             C.RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE,
             C.RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE_DEFAULT,
         )
+        fi_dict = get_dict_param(res_dict, C.RESILIENCE_FAULT_INJECTION)
+        self.resilience_fault_injection_enabled = get_scalar_param(
+            fi_dict,
+            C.RESILIENCE_FAULT_INJECTION_ENABLED,
+            C.RESILIENCE_FAULT_INJECTION_ENABLED_DEFAULT,
+        )
+        self.resilience_fault_injection_seed = get_scalar_param(
+            fi_dict,
+            C.RESILIENCE_FAULT_INJECTION_SEED,
+            C.RESILIENCE_FAULT_INJECTION_SEED_DEFAULT,
+        )
+        faults = fi_dict.get(
+            C.RESILIENCE_FAULT_INJECTION_FAULTS,
+            C.RESILIENCE_FAULT_INJECTION_FAULTS_DEFAULT,
+        )
+        # keep non-list values for _check_resilience to reject loudly
+        self.resilience_fault_injection_faults = (
+            list(faults) if isinstance(faults, (list, tuple)) else faults
+        )
+        sup_dict = get_dict_param(res_dict, C.RESILIENCE_SUPERVISOR)
+        self.resilience_supervisor_enabled = get_scalar_param(
+            sup_dict,
+            C.RESILIENCE_SUPERVISOR_ENABLED,
+            C.RESILIENCE_SUPERVISOR_ENABLED_DEFAULT,
+        )
+        self.resilience_supervisor_max_rollbacks = get_scalar_param(
+            sup_dict,
+            C.RESILIENCE_SUPERVISOR_MAX_ROLLBACKS,
+            C.RESILIENCE_SUPERVISOR_MAX_ROLLBACKS_DEFAULT,
+        )
+        self.resilience_supervisor_nonfinite_window = get_scalar_param(
+            sup_dict,
+            C.RESILIENCE_SUPERVISOR_NONFINITE_WINDOW,
+            C.RESILIENCE_SUPERVISOR_NONFINITE_WINDOW_DEFAULT,
+        )
+        self.resilience_supervisor_spike_factor = get_scalar_param(
+            sup_dict,
+            C.RESILIENCE_SUPERVISOR_SPIKE_FACTOR,
+            C.RESILIENCE_SUPERVISOR_SPIKE_FACTOR_DEFAULT,
+        )
+        self.resilience_supervisor_spike_window = get_scalar_param(
+            sup_dict,
+            C.RESILIENCE_SUPERVISOR_SPIKE_WINDOW,
+            C.RESILIENCE_SUPERVISOR_SPIKE_WINDOW_DEFAULT,
+        )
+        self.resilience_supervisor_min_history = get_scalar_param(
+            sup_dict,
+            C.RESILIENCE_SUPERVISOR_MIN_HISTORY,
+            C.RESILIENCE_SUPERVISOR_MIN_HISTORY_DEFAULT,
+        )
 
         # data_pipeline block (runtime/staging.py, docs/performance.md)
         dp_dict = get_dict_param(pd, C.DATA_PIPELINE)
@@ -393,6 +443,18 @@ class DeepSpeedConfig:
         self.inference_eos_token_id = get_scalar_param(
             inf_dict, C.INFERENCE_EOS_TOKEN_ID,
             C.INFERENCE_EOS_TOKEN_ID_DEFAULT,
+        )
+        self.inference_deadline_secs = get_scalar_param(
+            inf_dict, C.INFERENCE_DEADLINE_SECS,
+            C.INFERENCE_DEADLINE_SECS_DEFAULT,
+        )
+        self.inference_driver_restart_budget = get_scalar_param(
+            inf_dict, C.INFERENCE_DRIVER_RESTART_BUDGET,
+            C.INFERENCE_DRIVER_RESTART_BUDGET_DEFAULT,
+        )
+        self.inference_degraded_queue_ratio = get_scalar_param(
+            inf_dict, C.INFERENCE_DEGRADED_QUEUE_RATIO,
+            C.INFERENCE_DEGRADED_QUEUE_RATIO_DEFAULT,
         )
         self.inference_dtype = get_scalar_param(
             inf_dict, C.INFERENCE_DTYPE, C.INFERENCE_DTYPE_DEFAULT
@@ -698,6 +760,114 @@ class DeepSpeedConfig:
                 f"{C.RESILIENCE_PREEMPTION_TAG_PREFIX} must be a non-empty "
                 f"path-component-safe string, got {prefix!r}"
             )
+        self._check_fault_injection()
+        self._check_supervisor()
+
+    def _check_fault_injection(self):
+        """Validate the fault_injection sub-block: a typo'd site name must
+        fail at init — a chaos run whose fault never fires reads as "the
+        stack survived" when nothing was tested."""
+        fi = f"{C.RESILIENCE}.{C.RESILIENCE_FAULT_INJECTION}"
+        if not isinstance(self.resilience_fault_injection_enabled, bool):
+            raise DeepSpeedConfigError(
+                f"{fi}.{C.RESILIENCE_FAULT_INJECTION_ENABLED} must be a "
+                f"boolean, got {self.resilience_fault_injection_enabled!r}"
+            )
+        seed = self.resilience_fault_injection_seed
+        if not isinstance(seed, int) or isinstance(seed, bool):
+            raise DeepSpeedConfigError(
+                f"{fi}.{C.RESILIENCE_FAULT_INJECTION_SEED} must be an "
+                f"integer, got {seed!r}"
+            )
+        faults = self.resilience_fault_injection_faults
+        if not isinstance(faults, list):
+            raise DeepSpeedConfigError(
+                f"{fi}.{C.RESILIENCE_FAULT_INJECTION_FAULTS} must be a "
+                f"list of fault entries, got {faults!r}"
+            )
+        if self.resilience_fault_injection_enabled and not faults:
+            raise DeepSpeedConfigError(
+                f"{fi} is enabled but {C.RESILIENCE_FAULT_INJECTION_FAULTS} "
+                "is empty — arm at least one site or disable the block"
+            )
+        from ..resilience.faults import KNOWN_FAULT_SITES
+
+        for i, f in enumerate(faults):
+            where = f"{fi}.{C.RESILIENCE_FAULT_INJECTION_FAULTS}[{i}]"
+            if not isinstance(f, dict):
+                raise DeepSpeedConfigError(
+                    f"{where} must be an object, got {f!r}"
+                )
+            site = f.get("site")
+            if site not in KNOWN_FAULT_SITES:
+                raise DeepSpeedConfigError(
+                    f"{where}.site: unknown fault site {site!r}; valid "
+                    f"sites: {sorted(KNOWN_FAULT_SITES)}"
+                )
+            for field, default, minimum in (
+                ("times", 1, 0), ("after", 0, 0),
+            ):
+                v = f.get(field, default)
+                if not isinstance(v, int) or isinstance(v, bool) or v < minimum:
+                    raise DeepSpeedConfigError(
+                        f"{where}.{field} must be an integer >= {minimum}, "
+                        f"got {v!r}"
+                    )
+            prob = f.get("probability", 1.0)
+            if (
+                not isinstance(prob, (int, float))
+                or isinstance(prob, bool)
+                or not 0 <= prob <= 1
+            ):
+                raise DeepSpeedConfigError(
+                    f"{where}.probability must be a number in [0, 1], got "
+                    f"{prob!r}"
+                )
+            args = f.get("args", {})
+            if not isinstance(args, dict):
+                raise DeepSpeedConfigError(
+                    f"{where}.args must be an object, got {args!r}"
+                )
+
+    def _check_supervisor(self):
+        """Validate the supervisor sub-block: a negative retry budget or a
+        zero detector window must fail at init, not as a supervisor that
+        escalates on its first window."""
+        sup = f"{C.RESILIENCE}.{C.RESILIENCE_SUPERVISOR}"
+        if not isinstance(self.resilience_supervisor_enabled, bool):
+            raise DeepSpeedConfigError(
+                f"{sup}.{C.RESILIENCE_SUPERVISOR_ENABLED} must be a "
+                f"boolean, got {self.resilience_supervisor_enabled!r}"
+            )
+        for field, value, minimum in (
+            (C.RESILIENCE_SUPERVISOR_MAX_ROLLBACKS,
+             self.resilience_supervisor_max_rollbacks, 0),
+            (C.RESILIENCE_SUPERVISOR_NONFINITE_WINDOW,
+             self.resilience_supervisor_nonfinite_window, 1),
+            (C.RESILIENCE_SUPERVISOR_SPIKE_WINDOW,
+             self.resilience_supervisor_spike_window, 2),
+            (C.RESILIENCE_SUPERVISOR_MIN_HISTORY,
+             self.resilience_supervisor_min_history, 1),
+        ):
+            if (
+                not isinstance(value, int)
+                or isinstance(value, bool)
+                or value < minimum
+            ):
+                raise DeepSpeedConfigError(
+                    f"{sup}.{field} must be an integer >= {minimum}, got "
+                    f"{value!r}"
+                )
+        spike = self.resilience_supervisor_spike_factor
+        if (
+            not isinstance(spike, (int, float))
+            or isinstance(spike, bool)
+            or spike < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{sup}.{C.RESILIENCE_SUPERVISOR_SPIKE_FACTOR} must be a "
+                f"number >= 0 (0 disables spike detection), got {spike!r}"
+            )
 
     def _check_data_pipeline(self):
         """Validate the data_pipeline and compile_cache blocks: a typo'd
@@ -800,6 +970,37 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"{C.INFERENCE}.{C.INFERENCE_EOS_TOKEN_ID} must be an "
                 f"integer token id or null, got {eos!r}"
+            )
+        deadline = self.inference_deadline_secs
+        if deadline is not None and (
+            not isinstance(deadline, (int, float))
+            or isinstance(deadline, bool)
+            or deadline <= 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_DEADLINE_SECS} must be a "
+                f"number > 0 seconds or null (null = no deadline), got "
+                f"{deadline!r}"
+            )
+        budget = self.inference_driver_restart_budget
+        if (
+            not isinstance(budget, int)
+            or isinstance(budget, bool)
+            or budget < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_DRIVER_RESTART_BUDGET} must "
+                f"be an integer >= 0 (0 = no auto-restart), got {budget!r}"
+            )
+        ratio = self.inference_degraded_queue_ratio
+        if (
+            not isinstance(ratio, (int, float))
+            or isinstance(ratio, bool)
+            or not 0 < ratio <= 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_DEGRADED_QUEUE_RATIO} must "
+                f"be a number in (0, 1], got {ratio!r}"
             )
         if self.inference_dtype not in ("fp32", "bf16"):
             raise DeepSpeedConfigError(

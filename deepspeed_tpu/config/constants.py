@@ -365,6 +365,38 @@ RESILIENCE_PREEMPTION_TAG_PREFIX = "tag_prefix"
 RESILIENCE_PREEMPTION_TAG_PREFIX_DEFAULT = "preempt"
 RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE = "exit_after_save"
 RESILIENCE_PREEMPTION_EXIT_AFTER_SAVE_DEFAULT = True
+# Fault-injection registry (resilience/faults.py, docs/resilience.md):
+# seed-deterministic chaos at the stack's real seams. Each entry of
+# "faults" names a site from faults.KNOWN_FAULT_SITES plus optional
+# times / probability / after / args. Off by default — production runs
+# arm it only for game days.
+RESILIENCE_FAULT_INJECTION = "fault_injection"
+RESILIENCE_FAULT_INJECTION_ENABLED = "enabled"
+RESILIENCE_FAULT_INJECTION_ENABLED_DEFAULT = False
+RESILIENCE_FAULT_INJECTION_SEED = "seed"
+RESILIENCE_FAULT_INJECTION_SEED_DEFAULT = 0
+RESILIENCE_FAULT_INJECTION_FAULTS = "faults"
+RESILIENCE_FAULT_INJECTION_FAULTS_DEFAULT = ()
+# Self-healing run supervisor (resilience/supervisor.py): step-boundary
+# anomaly detectors + bounded in-process rollback to the last committed
+# checkpoint. max_rollbacks is the retry budget before the typed
+# terminal escalation; nonfinite_window is the consecutive-bad-window
+# budget (beyond what the loss scaler's skip/adapt handles);
+# spike_factor > 0 arms the relative loss-spike detector over a
+# spike_window rolling mean (armed after min_history samples).
+RESILIENCE_SUPERVISOR = "supervisor"
+RESILIENCE_SUPERVISOR_ENABLED = "enabled"
+RESILIENCE_SUPERVISOR_ENABLED_DEFAULT = False
+RESILIENCE_SUPERVISOR_MAX_ROLLBACKS = "max_rollbacks"
+RESILIENCE_SUPERVISOR_MAX_ROLLBACKS_DEFAULT = 2
+RESILIENCE_SUPERVISOR_NONFINITE_WINDOW = "nonfinite_window"
+RESILIENCE_SUPERVISOR_NONFINITE_WINDOW_DEFAULT = 3
+RESILIENCE_SUPERVISOR_SPIKE_FACTOR = "spike_factor"
+RESILIENCE_SUPERVISOR_SPIKE_FACTOR_DEFAULT = 0.0
+RESILIENCE_SUPERVISOR_SPIKE_WINDOW = "spike_window"
+RESILIENCE_SUPERVISOR_SPIKE_WINDOW_DEFAULT = 32
+RESILIENCE_SUPERVISOR_MIN_HISTORY = "min_history"
+RESILIENCE_SUPERVISOR_MIN_HISTORY_DEFAULT = 8
 
 # Overlapped input staging (deepspeed_tpu/runtime/staging.py,
 # docs/performance.md "Input pipeline & compile cache"). While window N
@@ -450,6 +482,26 @@ INFERENCE_SAMPLING_TOP_P = "top_p"
 INFERENCE_SAMPLING_TOP_P_DEFAULT = 1.0  # 1.0 = disabled
 INFERENCE_SAMPLING_GREEDY = "greedy"
 INFERENCE_SAMPLING_GREEDY_DEFAULT = False
+# Default per-request deadline, seconds from submission (null = no
+# deadline). A request is finished with reason "deadline" when it cannot
+# be admitted before its deadline (reject-on-admission) or when a decode
+# step finds it past-deadline in flight (slot reclaimed within one
+# step). Per-request deadline_secs on submit() overrides.
+INFERENCE_DEADLINE_SECS = "deadline_secs"
+INFERENCE_DEADLINE_SECS_DEFAULT = None
+# Decode-driver auto-restarts allowed after a decode crash before the
+# scheduler gives up and fail-finishes everything (0 = legacy behavior:
+# any crash drains the scheduler). A restart fails the in-flight
+# requests (their KV rows died with the crashed step), rebuilds the
+# decode state from the engine's pinned params, and keeps serving the
+# queue.
+INFERENCE_DRIVER_RESTART_BUDGET = "driver_restart_budget"
+INFERENCE_DRIVER_RESTART_BUDGET_DEFAULT = 0
+# Queue-pressure threshold (fraction of queue_depth) past which the
+# health state degrades and priority > 0 submissions are shed at the
+# front door (docs/inference.md "Self-healing serving").
+INFERENCE_DEGRADED_QUEUE_RATIO = "degraded_queue_ratio"
+INFERENCE_DEGRADED_QUEUE_RATIO_DEFAULT = 0.75
 # Optional checkpoint to serve from: loaded through the resilience
 # verified-load path (manifest check + host-side parse + newest-valid
 # fallback) before params pin to device shardings.

@@ -208,11 +208,14 @@ class InferenceEngine:
         from .decode import KVCache
 
         cache_sharding = NamedSharding(self._mesh, kv_cache_partition_specs())
+        # kept for reset_decode_state: driver auto-restart re-inits the
+        # cache into the same shardings without touching the pinned params
+        self._cache_sharding = KVCache(k=cache_sharding, v=cache_sharding)
         self._cache = jax.device_put(
             init_kv_cache(
                 mcfg, self.num_slots, self.max_seq_len, self.compute_dtype
             ),
-            KVCache(k=cache_sharding, v=cache_sharding),
+            self._cache_sharding,
         )
         self._key = jax.random.PRNGKey(rng_seed)
         self._lengths = np.zeros(self.num_slots, np.int32)
@@ -272,6 +275,9 @@ class InferenceEngine:
             registry=self.metrics,
             telemetry=self.telemetry,
             export_interval=getattr(self.telemetry, "interval", 1) * 16,
+            deadline_secs=cfg.inference_deadline_secs,
+            driver_restart_budget=cfg.inference_driver_restart_budget,
+            degraded_queue_ratio=cfg.inference_degraded_queue_ratio,
         )
         log_dist(
             f"init_inference: {self.num_slots} decode slots x "
@@ -317,10 +323,33 @@ class InferenceEngine:
         self._temps[slot] = temperature
         return first
 
+    def reset_decode_state(self):
+        """Rebuild the decode-side state (KV cache, slot bookkeeping)
+        from scratch; the PINNED params are untouched — this is the
+        driver auto-restart path after a decode crash
+        (scheduler._recover_driver_crash), a cache re-init rather than a
+        weight reload."""
+        self._cache = jax.device_put(
+            init_kv_cache(
+                self.model_config, self.num_slots, self.max_seq_len,
+                self.compute_dtype,
+            ),
+            self._cache_sharding,
+        )
+        self._lengths[:] = 0
+        self._last_tokens[:] = 0
+        log_dist(
+            "inference decode state reset from pinned params "
+            "(driver restart)", ranks=[0],
+        )
+
     def decode_tokens(self, active_slots):
         """One fixed-shape decode step over ALL slots; commits length /
         last-token bookkeeping for ``active_slots`` and returns their
         sampled tokens as host ints (same order)."""
+        # fault site: decode-driver crash (resilience/faults.py) — raises
+        # through the scheduler's step, exercising the auto-restart path
+        self.resilience.faults.maybe_raise("decode.step")
         self._key, sub = jax.random.split(self._key)
         next_tokens, self._cache = self._jit_decode(
             self.params,
@@ -373,14 +402,16 @@ class InferenceEngine:
             self.scheduler.run_until_idle()
             results = [r.result() for r in requests]
         for r in requests:
-            if r.finish_reason == "cancelled":
+            if r.finish_reason in ("cancelled", "error"):
                 # a crashed driver / concurrent close() fail-finished the
                 # request mid-flight; partial tokens must not masquerade
-                # as a completed generation
+                # as a completed generation. A "deadline" finish is NOT an
+                # error: the partial tokens are the contract's answer.
                 raise RuntimeError(
-                    f"generation cancelled after {len(r.tokens)} of up to "
-                    f"{r.max_new_tokens} tokens (scheduler shut down or "
-                    "its driver crashed)"
+                    f"generation {r.finish_reason} after {len(r.tokens)} "
+                    f"of up to {r.max_new_tokens} tokens (scheduler shut "
+                    "down, or its decode driver crashed past the restart "
+                    "budget)"
                 )
         return results
 

@@ -22,7 +22,16 @@ Slot lifecycle (docs/inference.md has the diagram):
     FREE -> (admit: prefill writes cache rows 0..P-1, first token
              sampled from the prompt's last logit row = TTFT)
          -> DECODING (one token per step, position P, P+1, ...)
-         -> (EOS | max_new_tokens | position cap) -> FREE
+         -> (EOS | max_new_tokens | position cap | deadline) -> FREE
+
+Self-healing (docs/inference.md "Self-healing serving"): per-request
+deadlines (unmeetable at admission => finished with reason "deadline"
+without ever taking a slot; expired in flight => the slot is reclaimed
+within one decode step), a health-state machine (healthy -> degraded ->
+draining; degraded sheds priority > 0 submissions at the front door),
+and decode-driver auto-restart from the engine's pinned params within a
+configured budget instead of fail-finishing everything on the first
+crash.
 """
 
 import itertools
@@ -35,13 +44,21 @@ from ..utils.logging import logger
 
 
 class RequestRejected(RuntimeError):
-    """The front door shed this request (queue full past the timeout)."""
+    """The front door shed this request (queue full past the timeout,
+    degraded-health priority shedding, or a draining scheduler)."""
 
 
 _FINISH_EOS = "eos"
 _FINISH_MAX_NEW = "max_new_tokens"
 _FINISH_LENGTH = "length"
 _FINISH_CANCELLED = "cancelled"
+_FINISH_DEADLINE = "deadline"
+_FINISH_ERROR = "error"
+
+# infer/health_state gauge values (docs/observability.md)
+HEALTH_HEALTHY = 0
+HEALTH_DEGRADED = 1
+HEALTH_DRAINING = 2
 
 
 class InferenceRequest:
@@ -51,15 +68,22 @@ class InferenceRequest:
     _ids = itertools.count()
 
     def __init__(self, prompt_tokens, max_new_tokens, temperature,
-                 eos_token_id):
+                 eos_token_id, deadline_secs=None, priority=0):
         self.request_id = next(self._ids)
         self.prompt_tokens = [int(t) for t in prompt_tokens]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
+        self.priority = int(priority)
         self.tokens = []
         self.finish_reason = None
         self.submitted_at = time.monotonic()
+        # absolute monotonic deadline; a request past it finishes with
+        # reason "deadline" (tokens so far are the partial answer)
+        self.deadline = (
+            self.submitted_at + float(deadline_secs)
+            if deadline_secs is not None else None
+        )
         self.first_token_at = None
         self._done = threading.Event()
         self._cancelled = False
@@ -95,7 +119,8 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine, *, num_slots, max_seq_len, queue_depth,
                  queue_timeout, eos_token_id, temperature, registry,
-                 telemetry=None, export_interval=16):
+                 telemetry=None, export_interval=16, deadline_secs=None,
+                 driver_restart_budget=0, degraded_queue_ratio=0.75):
         self._engine = engine
         self.num_slots = int(num_slots)
         self.max_seq_len = int(max_seq_len)
@@ -103,6 +128,11 @@ class ContinuousBatchingScheduler:
         self._queue_timeout = float(queue_timeout)
         self._eos_token_id = eos_token_id
         self._default_temperature = float(temperature)
+        self._default_deadline = deadline_secs
+        self._restart_budget = int(driver_restart_budget)
+        self.restarts_used = 0
+        self._degraded_ratio = float(degraded_queue_ratio)
+        self._draining = False
         self._slots = [None] * self.num_slots
         self._registry = registry
         self._telemetry = telemetry
@@ -137,19 +167,81 @@ class ContinuousBatchingScheduler:
         self._rejected = reg.counter("infer/requests_rejected")
         self._completed = reg.counter("infer/requests_completed")
         self._tokens_generated = reg.counter("infer/tokens_generated")
+        self._deadline_misses = reg.counter("infer/deadline_misses")
+        self._health_gauge = reg.gauge("infer/health_state")
+        self._driver_restarts = reg.counter("infer/driver_restarts")
+        self._shed = reg.counter("infer/requests_shed")
+
+    # -- health-state machine -------------------------------------------
+    @property
+    def health(self):
+        """Current health state (module constants HEALTH_*)."""
+        return self._update_health()
+
+    def _update_health(self):
+        """healthy -> degraded -> draining, from queue pressure and the
+        drain/stop flags; mirrors onto the infer/health_state gauge."""
+        if self._draining or self._stop.is_set():
+            h = HEALTH_DRAINING
+        elif (
+            self._queue.maxsize > 0
+            and self._queue.qsize()
+            >= self._degraded_ratio * self._queue.maxsize
+        ):
+            h = HEALTH_DEGRADED
+        else:
+            h = HEALTH_HEALTHY
+        self._health_gauge.set(h)
+        return h
+
+    def drain(self):
+        """Stop admitting new requests; everything queued or in flight
+        runs to completion (the graceful shutdown ramp — ``shutdown``
+        afterwards is instant)."""
+        self._draining = True
+        self._update_health()
 
     # -- front door -----------------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens=32, temperature=None,
-               eos_token_id=None, timeout=None):
+               eos_token_id=None, timeout=None, deadline_secs=None,
+               priority=0):
         """Enqueue a request; returns the :class:`InferenceRequest`
         handle. Raises :class:`RequestRejected` when the bounded queue
         stays full past ``timeout`` (default: the config's
-        ``queue_timeout_secs``) and ``ValueError`` for prompts the engine
-        can never serve (longer than the prefill window, or leaving no
-        room to generate)."""
+        ``queue_timeout_secs``), when the scheduler is draining, or when
+        degraded health sheds this ``priority`` (> 0 = sheddable; 0 =
+        always admitted while healthy capacity exists). Raises
+        ``ValueError`` for prompts the engine can never serve (longer
+        than the prefill window, or leaving no room to generate) and for
+        ``deadline_secs <= 0``. ``deadline_secs`` (default: the config's
+        ``inference.deadline_secs``) bounds the request end to end: an
+        unmeetable deadline finishes it with reason ``"deadline"`` at
+        admission, an expired one frees its slot within one decode
+        step."""
         if self._stop.is_set():
             self._rejected.inc()
             raise RequestRejected("scheduler is shut down")
+        if deadline_secs is None:
+            deadline_secs = self._default_deadline
+        if deadline_secs is not None and float(deadline_secs) <= 0:
+            raise ValueError(
+                f"deadline_secs must be > 0 seconds (or None for no "
+                f"deadline), got {deadline_secs!r}"
+            )
+        health = self._update_health()
+        if health == HEALTH_DRAINING:
+            self._rejected.inc()
+            raise RequestRejected(
+                "scheduler is draining; not admitting new requests"
+            )
+        if health == HEALTH_DEGRADED and int(priority) > 0:
+            self._shed.inc()
+            self._rejected.inc()
+            raise RequestRejected(
+                f"degraded (queue {self._queue.qsize()}/"
+                f"{self._queue.maxsize}): shedding priority-{priority} "
+                "submission (priority 0 is never shed at this gate)"
+            )
         n = len(prompt_tokens)
         if n == 0:
             raise ValueError("empty prompt")
@@ -179,6 +271,8 @@ class ContinuousBatchingScheduler:
             eos_token_id=(
                 self._eos_token_id if eos_token_id is None else eos_token_id
             ),
+            deadline_secs=deadline_secs,
+            priority=priority,
         )
         wait = self._queue_timeout if timeout is None else float(timeout)
         try:
@@ -208,9 +302,54 @@ class ContinuousBatchingScheduler:
     def active_slots(self):
         return [i for i, r in enumerate(self._slots) if r is not None]
 
+    def _prefill_estimate_secs(self):
+        """Observed mean prefill wall time — the admission-time lower
+        bound on time-to-first-token (0 before any prefill ran)."""
+        count = self._prefill_ms.count
+        return (self._prefill_ms.sum / count) / 1e3 if count else 0.0
+
+    def _deadline_unmeetable(self, req):
+        """True when ``req`` cannot meet its deadline even if admitted
+        right now: already expired, or less time remains than prefill
+        alone is observed to take (reject-on-admission)."""
+        if req.deadline is None:
+            return False
+        remaining = req.deadline - time.monotonic()
+        return remaining <= 0 or remaining < self._prefill_estimate_secs()
+
+    def _expire_deadlines(self):
+        """Finish every request past its deadline — in flight (the slot
+        is reclaimed) AND still queued (the waiter gets its "deadline"
+        answer now, not when a slot eventually frees). Runs at each step
+        boundary, so expiry lands within one decode step."""
+        now = time.monotonic()
+        for slot, req in enumerate(self._slots):
+            if (
+                req is not None
+                and req.deadline is not None
+                and now >= req.deadline
+            ):
+                self._slots[slot] = None
+                self._deadline_misses.inc()
+                req._finish(_FINISH_DEADLINE)
+        # queued requests: finish in place under the queue mutex (state
+        # only — no structural mutation); _admit pops and discards
+        # already-finished entries
+        with self._queue.mutex:
+            for req in self._queue.queue:
+                if (
+                    req.deadline is not None
+                    and not req.done
+                    and now >= req.deadline
+                ):
+                    self._deadline_misses.inc()
+                    req._finish(_FINISH_DEADLINE)
+
     def _admit(self):
         """Fill free slots from the queue: prefill each admitted request
-        and sample its first token (TTFT ends here)."""
+        and sample its first token (TTFT ends here). Requests whose
+        deadline is unmeetable finish with reason ``"deadline"`` without
+        taking the slot."""
         for slot, occupant in enumerate(self._slots):
             if occupant is not None:
                 continue
@@ -221,13 +360,27 @@ class ContinuousBatchingScheduler:
                 except queue.Empty:
                     break
                 self._queue_depth.set(self._queue.qsize())
-                if req._cancelled:
+                if req.done:
+                    # already finished in the queue (deadline sweep):
+                    # just discard the husk
+                    req = None
+                elif req._cancelled:
                     req._finish(_FINISH_CANCELLED)
                     req = None  # withdrawn: keep the slot for the next one
+                elif self._deadline_unmeetable(req):
+                    self._deadline_misses.inc()
+                    req._finish(_FINISH_DEADLINE)
+                    req = None  # never takes the slot
             if req is None:
                 break
             t0 = time.monotonic()
             self._queue_wait_ms.observe((t0 - req.submitted_at) * 1e3)
+            # the request OWNS the slot before prefill runs: a prefill
+            # that raises (device OOM, injected chaos) then leaves it in
+            # the slot table, where the crash-recovery / fail-finish
+            # sweeps reach it — popped-but-unplaced requests would hang
+            # their result() waiters forever
+            self._slots[slot] = req
             first = self._engine.prefill_request(
                 slot, req.prompt_tokens, req.temperature
             )
@@ -235,7 +388,6 @@ class ContinuousBatchingScheduler:
             self._prefill_ms.observe((now - t0) * 1e3)
             req.first_token_at = now
             self._ttft_ms.observe((now - req.submitted_at) * 1e3)
-            self._slots[slot] = req
             # a 1-token request (or instant EOS) frees the slot right here
             self._count_token(req, first)
         self._occupancy.set(len(self.active_slots))
@@ -269,6 +421,9 @@ class ContinuousBatchingScheduler:
         if self._rate_anchor is None:
             self._rate_anchor = time.monotonic()
             self._tokens_since_rate = 0
+        # reclaim past-deadline slots FIRST: the freed slots are
+        # admittable in this same step
+        self._expire_deadlines()
         self._admit()
         active = self.active_slots
         if not active:
@@ -283,6 +438,7 @@ class ContinuousBatchingScheduler:
             if req is not None:
                 self._count_token(req, token)
         self._occupancy.set(len(self.active_slots))
+        self._update_health()
         self._steps += 1
         self._update_rate()
         if (
@@ -303,13 +459,50 @@ class ContinuousBatchingScheduler:
             self._tokens_since_rate = 0
             self._rate_anchor = now
 
+    def _recover_driver_crash(self):
+        """Post-decode-crash recovery (call under the drive lock): the
+        in-flight requests' KV rows died with the crashed step, so they
+        fail-finish with reason ``"error"``; the queue survives, and the
+        engine rebuilds its decode state from the pinned params — the
+        weights never left device, so recovery is a cache re-init, not a
+        reload."""
+        for slot, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[slot] = None
+                req._finish(_FINISH_ERROR)
+        reset = getattr(self._engine, "reset_decode_state", None)
+        if reset is not None:
+            reset()
+        self._occupancy.set(0)
+
+    def _step_recovering(self):
+        """One driver step with crash auto-restart inside the configured
+        budget; re-raises when the budget is exhausted (or zero — the
+        legacy fail-fast behavior)."""
+        try:
+            return self.step()
+        except Exception:
+            if self._stop.is_set() or self.restarts_used >= self._restart_budget:
+                raise
+            self.restarts_used += 1
+            self._driver_restarts.inc()
+            logger.exception(
+                "decode driver crashed; auto-restarting from pinned "
+                "params (%d/%d restarts used; in-flight requests "
+                "fail-finished, queue preserved)",
+                self.restarts_used, self._restart_budget,
+            )
+            self._recover_driver_crash()
+            return 0
+
     def run_until_idle(self):
         """Drive steps until no request is active or queued (the
         synchronous ``generate()`` path). Serialized: concurrent callers
-        take turns as the driver instead of racing the slot table."""
+        take turns as the driver instead of racing the slot table. Decode
+        crashes auto-restart within ``driver_restart_budget``."""
         with self._drive_lock:
             while not self._stop.is_set() and (
-                self.step() or not self._queue.empty()
+                self._step_recovering() or not self._queue.empty()
             ):
                 pass
             self._flush_rate()
@@ -334,9 +527,12 @@ class ContinuousBatchingScheduler:
     def serve_forever(self, idle_sleep=0.005):
         """Drive the scheduler on a daemon thread until :meth:`shutdown`
         (the long-running server mode; ``submit`` from any thread). A
-        step that raises (device OOM, runtime error) stops the server and
-        fail-finishes everything outstanding — ``result()`` waiters get
-        their ``"cancelled"`` answer instead of hanging on a dead loop."""
+        step that raises (device OOM, runtime error) auto-restarts the
+        decode driver from the engine's pinned params while the
+        ``driver_restart_budget`` lasts; past it the server stops,
+        health goes draining, and everything outstanding fail-finishes —
+        ``result()`` waiters get their answer instead of hanging on a
+        dead loop."""
         if self.driving:
             return self._thread
 
@@ -344,15 +540,19 @@ class ContinuousBatchingScheduler:
             try:
                 while not self._stop.is_set():
                     with self._drive_lock:
-                        n = self.step()
+                        n = self._step_recovering()
                     if n == 0:
                         time.sleep(idle_sleep)
             except Exception:
                 logger.exception(
-                    "inference scheduler driver crashed; rejecting new "
-                    "submissions and cancelling outstanding requests"
+                    "inference scheduler driver crashed (restart budget "
+                    "%d/%d spent); rejecting new submissions and "
+                    "cancelling outstanding requests",
+                    self.restarts_used, self._restart_budget,
                 )
                 self._stop.set()
+                self._draining = True
+                self._update_health()
                 self._fail_finish_outstanding()
 
         self._stop.clear()
@@ -377,6 +577,7 @@ class ContinuousBatchingScheduler:
         with self._drive_lock:
             self._fail_finish_outstanding()
         self._flush_rate()
+        self._update_health()  # gauge lands on draining
 
     def _fail_finish_outstanding(self):
         while True:

@@ -1,6 +1,6 @@
 """Fault-tolerance layer for the save/load/run lifecycle (docs/resilience.md).
 
-Five pieces, configured under the ``"resilience"`` config block and wired
+Seven pieces, configured under the ``"resilience"`` config block and wired
 through the engine:
 
 - **Atomic commit protocol** (atomic_io, manifest): every checkpoint file
@@ -17,9 +17,23 @@ through the engine:
   save-at-next-step-boundary flag the engine honors in ``step()``.
 - **Retention GC** (retention): ``keep_last_n`` pruning that never
   deletes the newest valid checkpoint.
+- **Fault injection** (faults): config-armed, seed-deterministic chaos at
+  the stack's real seams (checkpoint I/O, staging, the step boundary,
+  the decode driver) so chaos tests exercise production code paths.
+- **Run supervision** (supervisor): step-boundary anomaly detectors with
+  a bounded, bitwise-reproducible in-process rollback to the last
+  committed checkpoint, and a typed terminal escalation when the retry
+  budget is exhausted.
 """
 
 from .atomic_io import RetryPolicy, with_retries
+from .faults import (
+    KNOWN_FAULT_SITES,
+    NULL_INJECTOR,
+    FaultInjector,
+    FaultSpec,
+    build_fault_injector,
+)
 from .manager import ResilienceManager, build_resilience
 from .manifest import (
     CheckpointCorruptionError,
@@ -28,14 +42,29 @@ from .manifest import (
 )
 from .preemption import PreemptionHandler
 from .retention import prune_checkpoints
+from .supervisor import (
+    ReplayableDataSource,
+    SupervisorEscalation,
+    TrainingSupervisor,
+    build_supervisor,
+)
 
 __all__ = [
     "CheckpointCorruptionError",
+    "FaultInjector",
+    "FaultSpec",
+    "KNOWN_FAULT_SITES",
     "MANIFEST_FILE",
+    "NULL_INJECTOR",
     "PreemptionHandler",
+    "ReplayableDataSource",
     "ResilienceManager",
     "RetryPolicy",
+    "SupervisorEscalation",
+    "TrainingSupervisor",
+    "build_fault_injector",
     "build_resilience",
+    "build_supervisor",
     "prune_checkpoints",
     "verify_checkpoint",
     "with_retries",

@@ -24,6 +24,7 @@ import os
 import random
 import time
 
+from ..telemetry.registry import count_suppressed
 from ..utils.logging import log_dist
 
 
@@ -77,8 +78,10 @@ def with_retries(fn, policy=None, op_name="io", on_retry=None,
             if on_retry is not None:
                 try:
                     on_retry(op_name, failures, e)
-                except Exception:
-                    pass  # a metrics hook must never mask the real error
+                except Exception as hook_exc:
+                    # a metrics hook must never mask the real error —
+                    # but its failure is counted, not silent
+                    count_suppressed("atomic_io.on_retry_hook", hook_exc)
             log_dist(
                 f"transient I/O failure in {op_name} "
                 f"(attempt {failures}/{policy.max_attempts}): {e!r} — "
@@ -98,8 +101,8 @@ def fsync_dir(dirpath):
         return
     try:
         os.fsync(fd)
-    except OSError:
-        pass
+    except OSError as e:
+        count_suppressed("atomic_io.fsync_dir", e)
     finally:
         os.close(fd)
 
@@ -122,8 +125,8 @@ def atomic_write_bytes(path, data, fsync=True):
     except BaseException:
         try:
             os.unlink(tmp)
-        except OSError:
-            pass
+        except OSError as e:
+            count_suppressed("atomic_io.tmp_cleanup", e)
         raise
     if fsync:
         fsync_dir(dirpath)

@@ -1,0 +1,219 @@
+"""Config-armed, seed-deterministic fault-injection registry.
+
+Chaos testing only earns its keep when the injected fault travels the
+*production* code path — a mocked OSError proves the mock. Every site in
+:data:`KNOWN_FAULT_SITES` is a named seam the engine / window stager /
+checkpoint writer / inference driver already passes through on every run;
+arming the registry makes that seam raise (or poison, or stall) exactly
+where a real storage flake, worker death, or numeric blowup would, so
+the recovery machinery exercised is the one shipped: retry backoff,
+manifest fallback, supervisor rollback, driver auto-restart.
+
+Armed from the config::
+
+    "resilience": {
+      "fault_injection": {
+        "enabled": true,
+        "seed": 0,
+        "faults": [
+          {"site": "checkpoint.write", "times": 1},
+          {"site": "grads.nan", "after": 4, "times": 1},
+          {"site": "step.stall", "times": 1, "args": {"duration_ms": 250}}
+        ]
+      }
+    }
+
+Determinism contract: each site traversal is counted and each spec draws
+from its own ``numpy`` generator seeded by ``(seed, site)`` — two runs
+with the same config inject at the same traversals, so a chaos failure
+reproduces byte-for-byte. Probability < 1 stays deterministic for the
+same reason (the draw sequence is fixed).
+
+Every fired fault increments ``resilience/faults_injected`` on the
+shared registry and logs the site at WARNING — an injected fault must
+never be mistakable for a real one in postmortems.
+"""
+
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ..telemetry.registry import MetricsRegistry
+from ..utils.logging import log_dist, logger
+
+# site -> one-line description (docs/resilience.md mirrors this table).
+# The exception type raised at each raising site matches what the real
+# failure would produce, so retry/fallback classification is untouched.
+KNOWN_FAULT_SITES = {
+    "checkpoint.write": (
+        "OSError inside a checkpoint file write (under the retry loop: "
+        "times <= max_attempts-1 is absorbed by backoff, more escalates)"
+    ),
+    "checkpoint.read": (
+        "OSError inside a checkpoint file read (retry loop, then the "
+        "corruption-fallback walk)"
+    ),
+    "staging.worker": (
+        "RuntimeError on the window-staging worker thread at a window "
+        "pull (worker death surfaces at the next get_window)"
+    ),
+    "staging.device_put": (
+        "RuntimeError in the window placement path (device_put failure, "
+        "fires on whichever thread places the window)"
+    ),
+    "grads.nan": (
+        "NaN-poisons the dispatched window's first floating batch leaf "
+        "(non-finite loss AND gradients through the production skip path)"
+    ),
+    "decode.step": (
+        "RuntimeError inside the inference decode step (decode-driver "
+        "crash; exercises scheduler auto-restart)"
+    ),
+    "step.stall": (
+        "artificial stall (sleep) at the training step boundary "
+        "(args.duration_ms, default 250) — watchdog food"
+    ),
+}
+
+_RAISES = {
+    "checkpoint.write": OSError,
+    "checkpoint.read": OSError,
+    "staging.worker": RuntimeError,
+    "staging.device_put": RuntimeError,
+    "decode.step": RuntimeError,
+}
+
+STALL_DURATION_MS_DEFAULT = 250.0
+
+
+class FaultSpec:
+    """One armed fault: fires at site traversals ``after < n`` while
+    ``hits < times`` (``times=0`` = unlimited), each time with
+    ``probability`` (drawn from the spec's own seeded generator)."""
+
+    __slots__ = ("site", "times", "probability", "after", "args", "hits",
+                 "_rng")
+
+    def __init__(self, site, times=1, probability=1.0, after=0, args=None,
+                 seed=0):
+        if site not in KNOWN_FAULT_SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; valid sites: "
+                f"{sorted(KNOWN_FAULT_SITES)}"
+            )
+        self.site = site
+        self.times = int(times)
+        self.probability = float(probability)
+        self.after = int(after)
+        self.args = dict(args or {})
+        self.hits = 0
+        # per-spec generator seeded by (seed, site): deterministic across
+        # runs, independent across sites
+        self._rng = np.random.default_rng(
+            (int(seed), zlib.crc32(site.encode()))
+        )
+
+    def should_fire(self, traversal):
+        """``traversal`` is 1-based per-site pass count."""
+        if traversal <= self.after:
+            return False
+        if self.times and self.hits >= self.times:
+            return False
+        if self.probability < 1.0 and self._rng.random() >= self.probability:
+            return False
+        self.hits += 1
+        return True
+
+
+class FaultInjector:
+    """The registry call sites consult. Disabled (the default
+    :data:`NULL_INJECTOR`) it is a do-nothing object with ``enabled``
+    False, so hot paths guard with one attribute read. Thread-safe:
+    sites fire from the staging worker and the serve thread too."""
+
+    def __init__(self, specs=(), seed=0, registry=None):
+        self._specs = list(specs)
+        self.enabled = bool(self._specs)
+        self._lock = threading.Lock()
+        self._passes = {}
+        self.injected = {}  # site -> fired count (test/diagnostic surface)
+        reg = registry if registry is not None else MetricsRegistry()
+        self._counter = reg.counter(
+            "resilience/faults_injected",
+            help="faults fired by the config-armed fault-injection registry",
+        )
+
+    def fire(self, site):
+        """Count one traversal of ``site``; return the matching
+        :class:`FaultSpec` when a fault fires here, else None."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            n = self._passes.get(site, 0) + 1
+            self._passes[site] = n
+            for spec in self._specs:
+                if spec.site == site and spec.should_fire(n):
+                    self.injected[site] = self.injected.get(site, 0) + 1
+                    self._counter.inc()
+                    log_dist(
+                        f"FAULT INJECTED at site {site!r} (traversal {n}, "
+                        f"hit {spec.hits}/{spec.times or 'inf'})",
+                        ranks=[-1],
+                    )
+                    return spec
+        return None
+
+    def maybe_raise(self, site):
+        """Raise the site's canonical exception type when a fault fires
+        here (the type a real failure would produce — OSError for
+        checkpoint I/O, RuntimeError for worker/driver deaths)."""
+        spec = self.fire(site)
+        if spec is not None:
+            raise _RAISES.get(site, RuntimeError)(
+                f"injected fault at site {site!r} "
+                "(resilience.fault_injection)"
+            )
+
+    def maybe_stall(self, site="step.stall"):
+        """Sleep ``args.duration_ms`` when a stall fault fires; returns
+        True when it stalled."""
+        spec = self.fire(site)
+        if spec is None:
+            return False
+        duration = float(
+            spec.args.get("duration_ms", STALL_DURATION_MS_DEFAULT)
+        )
+        logger.warning(
+            "injected stall at site %r: sleeping %.0f ms", site, duration
+        )
+        time.sleep(duration / 1e3)
+        return True
+
+
+NULL_INJECTOR = FaultInjector()
+
+
+def build_fault_injector(config, registry=None):
+    """Construct the injector from a validated DeepSpeedConfig; returns
+    :data:`NULL_INJECTOR` unless the config block arms at least one
+    fault."""
+    if not getattr(config, "resilience_fault_injection_enabled", False):
+        return NULL_INJECTOR
+    seed = getattr(config, "resilience_fault_injection_seed", 0)
+    raw = getattr(config, "resilience_fault_injection_faults", []) or []
+    specs = [
+        FaultSpec(
+            f["site"],
+            times=f.get("times", 1),
+            probability=f.get("probability", 1.0),
+            after=f.get("after", 0),
+            args=f.get("args"),
+            seed=seed,
+        )
+        for f in raw
+    ]
+    if not specs:
+        return NULL_INJECTOR
+    return FaultInjector(specs, seed=seed, registry=registry)

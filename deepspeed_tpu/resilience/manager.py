@@ -14,6 +14,7 @@ import time
 from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS, MetricsRegistry
 from ..utils.logging import log_dist, warn_once
 from .atomic_io import RetryPolicy, with_retries
+from .faults import NULL_INJECTOR, build_fault_injector
 from .preemption import DEFAULT_SIGNALS, PreemptionHandler
 
 
@@ -32,6 +33,7 @@ class ResilienceManager:
         preemption_tag_prefix="preempt",
         preemption_exit_after_save=True,
         registry=None,
+        faults=None,
     ):
         self.enabled = bool(enabled)
         self.fsync = bool(fsync)
@@ -51,6 +53,10 @@ class ResilienceManager:
             else None
         )
         self.registry = registry if registry is not None else MetricsRegistry()
+        # the fault-injection registry (faults.py): NULL (disabled) unless
+        # the config armed sites — checkpoint I/O, staging, the engine's
+        # step boundary, and the decode driver all consult this object
+        self.faults = faults if faults is not None else NULL_INJECTOR
         reg = self.registry
         self._retries = reg.counter(
             "resilience/io_retries",
@@ -140,6 +146,10 @@ def build_resilience(config, telemetry=None):
     registry = None
     if telemetry is not None and getattr(telemetry, "enabled", False):
         registry = telemetry.registry
+    if registry is None:
+        # one shared private registry: the fault injector's counters must
+        # land next to the manager's (tests and the chaos smoke read both)
+        registry = MetricsRegistry()
     if not hasattr(config, "resilience_enabled"):
         # standalone/legacy config objects (tests, tools) get the defaults
         warn_once(
@@ -147,6 +157,7 @@ def build_resilience(config, telemetry=None):
             "config has no resilience block attributes; using defaults",
         )
         return ResilienceManager(registry=registry)
+    faults = build_fault_injector(config, registry=registry)
     return ResilienceManager(
         enabled=config.resilience_enabled,
         fsync=config.resilience_fsync,
@@ -165,4 +176,5 @@ def build_resilience(config, telemetry=None):
         preemption_tag_prefix=config.resilience_preemption_tag_prefix,
         preemption_exit_after_save=config.resilience_preemption_exit_after_save,
         registry=registry,
+        faults=faults,
     )

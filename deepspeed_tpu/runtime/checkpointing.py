@@ -56,6 +56,7 @@ from ..resilience import atomic_io
 from ..resilience import manifest as manifest_lib
 from ..resilience import retention
 from ..resilience.manager import ResilienceManager
+from ..telemetry.registry import count_suppressed
 from ..utils.logging import log_dist, warn_once
 
 MODEL_FILE = "mp_rank_{mp:02d}_model_states.msgpack"
@@ -80,23 +81,29 @@ def _resilience_of(engine):
 def _write_blob(res, path, data):
     """One checkpoint file write under the active protocol: atomic +
     fsynced + retried when resilience is enabled, the legacy bare write
-    otherwise."""
+    otherwise. The ``checkpoint.write`` fault site fires INSIDE the
+    retried operation — injected storage flakes exercise the same
+    backoff/escalation path a real one would."""
+    def op():
+        res.faults.maybe_raise("checkpoint.write")
+        atomic_io.atomic_write_bytes(path, data, fsync=res.fsync)
+
     if res.enabled:
-        res.retrying(
-            lambda: atomic_io.atomic_write_bytes(path, data, fsync=res.fsync),
-            op_name=f"write:{os.path.basename(path)}",
-        )
+        res.retrying(op, op_name=f"write:{os.path.basename(path)}")
     else:
+        res.faults.maybe_raise("checkpoint.write")
         with open(path, "wb") as f:
             f.write(data)
 
 
 def _read_blob(res, path):
+    def op():
+        res.faults.maybe_raise("checkpoint.read")
+        return atomic_io.read_bytes(path)
+
     if res.enabled:
-        return res.retrying(
-            lambda: atomic_io.read_bytes(path),
-            op_name=f"read:{os.path.basename(path)}",
-        )
+        return res.retrying(op, op_name=f"read:{os.path.basename(path)}")
+    res.faults.maybe_raise("checkpoint.read")
     with open(path, "rb") as f:
         return f.read()
 
@@ -135,6 +142,65 @@ def _normalize_quant_padding(saved_tree, template_tree):
     return jax.tree_util.tree_map(
         fit, saved_tree, template_tree, is_leaf=is_quantized
     )
+
+
+def _rng_key_host(engine):
+    """The engine's RNG key chain as a host array (typed keys serialize
+    their key_data), or None for engines without one. Persisting the
+    chain makes a resume — and the supervisor's in-process rollback —
+    bitwise-reproducible: the replayed run splits the exact keys the
+    original would have."""
+    rng = getattr(engine, "_rng", None)
+    if rng is None:
+        return None
+    try:
+        import jax.numpy as jnp
+
+        if jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+            return np.asarray(jax.random.key_data(rng))
+    except Exception as e:  # pragma: no cover - key API drift
+        count_suppressed("checkpointing.rng_key_host", e)
+    return np.asarray(rng)
+
+
+def _restore_rng_key(engine, data):
+    """Adopt a checkpoint's ``rng_key`` into the engine, matching the
+    engine's current key flavor (typed rbg keys on TPU, raw PRNGKey
+    arrays elsewhere). A mismatched key (checkpoint from a different
+    backend's impl) keeps the engine's current RNG with a warning rather
+    than failing the whole load — only replay bitwiseness is lost."""
+    cur = getattr(engine, "_rng", None)
+    if cur is None:
+        return
+    import jax.numpy as jnp
+
+    arr = np.asarray(data)
+    try:
+        if jnp.issubdtype(cur.dtype, jax.dtypes.prng_key):
+            cur_data = jax.random.key_data(cur)
+            if tuple(arr.shape) != tuple(cur_data.shape):
+                raise ValueError(
+                    f"saved key data shape {arr.shape} != engine key "
+                    f"shape {tuple(cur_data.shape)}"
+                )
+            engine._rng = jax.random.wrap_key_data(
+                jnp.asarray(arr, cur_data.dtype),
+                impl=jax.random.key_impl(cur),
+            )
+        else:
+            if tuple(arr.shape) != tuple(np.asarray(cur).shape):
+                raise ValueError(
+                    f"saved key shape {arr.shape} != engine key shape "
+                    f"{tuple(np.asarray(cur).shape)}"
+                )
+            engine._rng = jnp.asarray(arr, cur.dtype)
+    except Exception as e:
+        warn_once(
+            "rng-key-restore-failed",
+            "checkpoint rng_key could not be adopted (%s); keeping the "
+            "engine's current RNG — the resumed/rolled-back run will not "
+            "replay bitwise", e,
+        )
 
 
 def _data_axis_of(leaf):
@@ -238,6 +304,11 @@ def save_checkpoint(engine, save_dir, tag=None, client_state=None):
         ),
         "client_state": client_state or {},
     }
+    rng_key = _rng_key_host(engine)
+    if rng_key is not None:
+        # the RNG key chain rides in the model-states file so resumes and
+        # supervisor rollbacks replay bitwise (ignored by older readers)
+        state["rng_key"] = rng_key
     if proc == 0:
         model_path = os.path.join(ckpt_dir, MODEL_FILE.format(mp=mp_rank))
         _write_blob(res, model_path, serialization.msgpack_serialize(state))
@@ -439,6 +510,10 @@ def _apply_checkpoint(
         good_steps=jnp.int32(sc["good_steps"]),
         hysteresis=jnp.int32(sc["hysteresis"]),
     )
+    # RNG key chain (absent on pre-PR5 checkpoints: the engine keeps its
+    # current chain and only replay bitwiseness is lost)
+    if state.get("rng_key") is not None:
+        _restore_rng_key(engine, state["rng_key"])
     if (
         load_lr_scheduler_states
         and state.get("lr_scheduler") is not None

@@ -21,6 +21,7 @@ cache records.
 
 import os
 
+from ..telemetry.registry import count_suppressed
 from ..utils.logging import log_dist, warn_once
 
 # process-global: jax.config is global, so arming is too; re-arming with
@@ -87,8 +88,8 @@ def disarm_compile_cache():
 
         jax.config.update("jax_compilation_cache_dir", None)
         _reset_cache_verdict()
-    except Exception:  # pragma: no cover - defensive
-        pass
+    except Exception as e:  # pragma: no cover - defensive
+        count_suppressed("compile_cache.disarm", e)
     _armed = None
 
 
@@ -100,8 +101,8 @@ def _reset_cache_verdict():
         from jax._src import compilation_cache as _cc
 
         _cc.reset_cache()
-    except Exception:  # pragma: no cover - jax internals moved
-        pass
+    except Exception as e:  # pragma: no cover - jax internals moved
+        count_suppressed("compile_cache.reset_verdict", e)
 
 
 def configure_compile_cache(config):

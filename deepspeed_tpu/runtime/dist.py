@@ -18,6 +18,8 @@ import os
 
 from ..utils.logging import logger
 
+from ..telemetry.registry import count_suppressed
+
 _INITIALIZED = False
 
 
@@ -69,7 +71,8 @@ def _jax_client_initialized():
         from jax._src import distributed
 
         return distributed.global_state.client is not None
-    except Exception:
+    except Exception as e:  # jax internals moved: treat as uninitialized
+        count_suppressed("dist.jax_client_probe", e)
         return False
 
 
@@ -78,7 +81,8 @@ def _backends_initialized():
         from jax._src import xla_bridge
 
         return xla_bridge.backends_are_initialized()
-    except Exception:
+    except Exception as e:  # jax internals moved: treat as uninitialized
+        count_suppressed("dist.backend_probe", e)
         return False
 
 

@@ -46,6 +46,7 @@ import numpy as np
 from ..config import constants as C
 from ..config.config import DeepSpeedConfig, DeepSpeedConfigError
 from ..ops.optimizers import Optimizer, build_optimizer
+from ..resilience.supervisor import SupervisorEscalation
 from ..parallel import mesh as mesh_lib
 from ..parallel.mpu import TPUMpu
 from ..utils.logging import log_dist, logger, warn_once
@@ -89,6 +90,33 @@ def _split_model_output(out):
     if isinstance(out, (tuple, list)):
         return out[0], tuple(out[1:])
     return out, ()
+
+
+def _poison_first_float_leaf(tree):
+    """Fault site ``grads.nan`` (resilience/faults.py): NaN-multiply the
+    window's first floating batch leaf so its loss AND gradients go
+    non-finite through the production dispatch — the on-device skip guard
+    and the run supervisor see exactly what a real numeric blowup
+    produces. Integer-only batches have nothing poisonable; the fault
+    then fires as a no-op (warned once)."""
+    done = []
+
+    def poison(x):
+        if not done and hasattr(x, "dtype") and np.issubdtype(
+            np.dtype(x.dtype), np.floating
+        ):
+            done.append(True)
+            return x * np.float32("nan")
+        return x
+
+    out = jax.tree_util.tree_map(poison, tree)
+    if not done:
+        warn_once(
+            "grads-nan-no-float-leaf",
+            "fault site 'grads.nan' fired but the batch has no floating "
+            "leaf to poison — the injected fault had no effect",
+        )
+    return out
 
 
 class EngineOptimizerFacade:
@@ -510,6 +538,37 @@ class DeepSpeedEngine:
         # the drain's default save target when the config names none: the
         # last directory this engine saved to or resumed from
         self._last_checkpoint_dir = None
+        # fault-injection registry (resilience/faults.py): NULL unless the
+        # config armed sites; consulted at the step boundary, the window
+        # placement path, and (via the manager) the checkpoint I/O seams
+        self.faults = self.resilience.faults
+        # self-healing run supervision (resilience/supervisor.py): anomaly
+        # detectors at the step boundary + bounded rollback to the last
+        # committed checkpoint. None unless the config enables it — the
+        # async fast path never pays the per-window host sync otherwise.
+        from ..resilience.supervisor import build_supervisor
+
+        self.supervisor = build_supervisor(
+            self.config,
+            registry=(
+                self.telemetry.registry
+                if self.telemetry.enabled
+                else self.resilience.registry
+            ),
+        )
+        # rolled-back flag for the supervised train_batch retry loop: set
+        # by _finish_step when the supervisor discarded this window's
+        # timeline
+        self._window_rolled_back = False
+        if (
+            self.supervisor is not None
+            and getattr(self.telemetry, "watchdog", None) is not None
+        ):
+            # watchdog stall reports arm a rollback at the next completed
+            # step boundary (the "wedged stager / transient hang" healer)
+            self.telemetry.watchdog.add_stall_listener(
+                self.supervisor.notify_stall
+            )
 
         # ---- input staging pipeline (runtime/staging.py) --------------
         # Double-buffered async window staging: while window N computes,
@@ -1453,6 +1512,22 @@ class DeepSpeedEngine:
         # dispatched, so the device stays busy while we wait)
         if len(self._deferred_overflows) > 1:
             self._reconcile_deferred(keep_last=True)
+        # fault site: artificial step stall (watchdog food) — before the
+        # supervisor check so a long-enough stall can escalate same-window
+        if self.faults.enabled:
+            self.faults.maybe_stall("step.stall")
+        # self-healing supervision at the step boundary: the detectors
+        # read this window's loss/grad-norm (one host sync, supervised
+        # runs only) and may roll the engine back to the last committed
+        # checkpoint. The flag tells the supervised train_batch loop that
+        # the window it just ran belongs to a discarded timeline.
+        if self.supervisor is not None:
+            self._window_rolled_back = self.supervisor.on_window(
+                self, window_loss
+            )
+            if self._window_rolled_back:
+                return  # rolled back: the drain check below would act on
+                # a boundary that no longer exists
         # preemption drain: a SIGTERM/SIGINT received mid-window armed a
         # flag; this step boundary is the first safe commit point
         self._maybe_preemption_save()
@@ -1605,6 +1680,38 @@ class DeepSpeedEngine:
             )
 
     def train_batch(self, batch_iter_or_batches):
+        """Run one accumulation window (see :meth:`_train_batch_once` for
+        the dispatch mechanics). With the run supervisor enabled
+        (``resilience.supervisor``), this is the self-healing entry
+        point: an anomalous window (sustained non-finite loss, loss
+        spike, stall escalation) or a recoverable window failure (dead
+        staging worker, device_put error, injected chaos) triggers a
+        bounded in-process rollback to the last committed checkpoint and
+        the window re-runs from the rewound data source — callers see a
+        finite loss or, when the retry budget is exhausted, a typed
+        :class:`~deepspeed_tpu.resilience.SupervisorEscalation`.
+        Supervision costs one host sync per window; without the config
+        block this is a zero-overhead passthrough."""
+        sup = self.supervisor
+        if sup is None:
+            return self._train_batch_once(batch_iter_or_batches)
+        sup.note_source(batch_iter_or_batches)
+        while True:
+            self._window_rolled_back = False
+            try:
+                loss = self._train_batch_once(batch_iter_or_batches)
+            except (StopIteration, SupervisorEscalation):
+                raise
+            except Exception as exc:
+                if not sup.on_failure(self, exc):
+                    raise
+                continue  # rolled back; re-run from the rewound source
+            if self._window_rolled_back:
+                # the returned loss belongs to the discarded timeline
+                continue
+            return loss
+
+    def _train_batch_once(self, batch_iter_or_batches):
         """Native fast path: run a full accumulation window (forward,
         accumulate, update) as ONE compiled program and return the mean
         unscaled loss. Semantically equivalent to
@@ -1765,6 +1872,14 @@ class DeepSpeedEngine:
                 raise RuntimeError("engine dropped while staging")
             return engine._shard_window_batch(stacked)
 
+        # fault site: staging worker death. The hook closes over the
+        # injector only (never the engine — the worker must not pin it)
+        faults = self.faults
+        fault_fn = (
+            (lambda: faults.maybe_raise("staging.worker"))
+            if faults.enabled else None
+        )
+
         self._stager = WindowStager(
             source=source,
             accum=self.gradient_accumulation_steps(),
@@ -1776,6 +1891,7 @@ class DeepSpeedEngine:
             buffers=self._staging_buffers,
             stage_to_device=self._stage_to_device,
             telemetry=self.telemetry if tel_on else None,
+            fault_fn=fault_fn,
         )
         self._stager_source = source
         self._stager_finalizer = weakref.finalize(self, self._stager.close)
@@ -1828,6 +1944,8 @@ class DeepSpeedEngine:
         """Dispatch one stacked window through the fused program and do
         the post-update bookkeeping — the shared tail of the staged and
         unstaged train_batch paths."""
+        if self.faults.enabled and self.faults.fire("grads.nan") is not None:
+            stacked = _poison_first_float_leaf(stacked)
         lr = jnp.float32(self._current_lr())
         mom = jnp.float32(self._current_mom())
         (
@@ -1952,6 +2070,11 @@ class DeepSpeedEngine:
     def _shard_window_batch(self, stacked):
         """Place a stacked accumulation window: leaves are [accum, micro, ...];
         the micro-batch dim (axis 1) shards over data."""
+        if self.faults.enabled:
+            # fault site: the window's device placement (fires on
+            # whichever thread places — the staging worker under
+            # stage_to_device, the dispatch thread otherwise)
+            self.faults.maybe_raise("staging.device_put")
         return jax.tree_util.tree_map(
             lambda x: self._place_leaf(x, 1), stacked
         )
@@ -2048,6 +2171,10 @@ class DeepSpeedEngine:
             result = _save(self, save_dir, tag=tag, client_state=client_state or {})
         # remember the save target: the preemption drain's default sink
         self._last_checkpoint_dir = save_dir
+        if self.supervisor is not None:
+            # this directory's newest valid tag is now the rollback
+            # resume point (resilience/supervisor.py)
+            self.supervisor.on_checkpoint(save_dir)
         return result
 
     def load_checkpoint(
@@ -2085,4 +2212,6 @@ class DeepSpeedEngine:
             # a successful resume makes this directory the drain's
             # default save target too
             self._last_checkpoint_dir = load_dir
+            if self.supervisor is not None:
+                self.supervisor.on_checkpoint(load_dir)
         return result

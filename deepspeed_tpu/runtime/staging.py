@@ -47,13 +47,18 @@ from ..utils.logging import logger
 def ragged_window_error(collected, accum):
     """The one place the mid-window-dry message is built: the unstaged
     ``train_batch`` loop and the stager raise the identical error."""
-    return RuntimeError(
+    err = RuntimeError(
         f"data iterator ran dry mid-window: collected {collected} of "
         f"gradient_accumulation_steps={accum} micro-batches. Size the "
         "dataset/loader so full accumulation windows divide it (the "
         "loader's drop_last does this), or stop at the previous window "
         "boundary."
     )
+    # data exhaustion is the CALLER's sizing bug, not a transient fault:
+    # the run supervisor must surface it, not roll back and re-train old
+    # windows until its budget drains (resilience/supervisor.py)
+    err.ds_unrecoverable = True
+    return err
 
 
 def _tree_nbytes(tree):
@@ -128,7 +133,8 @@ class WindowStager:
 
     def __init__(self, source, accum, stack_fn, place_fn, rng=None,
                  split_fn=None, meta_fn=None, buffers=2,
-                 stage_to_device=True, telemetry=None, name="train_batch"):
+                 stage_to_device=True, telemetry=None, name="train_batch",
+                 fault_fn=None):
         if accum < 1:
             raise ValueError(f"accum must be >= 1, got {accum}")
         if buffers < 1:
@@ -148,6 +154,11 @@ class WindowStager:
         self._meta_fn = meta_fn
         self._stage_to_device = bool(stage_to_device)
         self._telemetry = telemetry
+        # fault-injection hook (resilience/faults.py, site
+        # "staging.worker"): called once per window assembly ON the worker
+        # thread; an exception here is real worker death — it surfaces at
+        # the consumer's next get_window like any staging failure
+        self._fault_fn = fault_fn
         self._stop = threading.Event()
         self._closed = False
         # slots bound TOTAL staged-but-unconsumed windows to ``buffers``:
@@ -182,6 +193,8 @@ class WindowStager:
             t0 = time.monotonic()
             batches = []
             try:
+                if self._fault_fn is not None:
+                    self._fault_fn()
                 try:
                     for _ in range(self._accum):
                         # re-check between pulls: close() mid-window must

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import constants as C
 from ..parallel import mesh as mesh_lib
+from ..telemetry.registry import count_suppressed
 
 
 def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=None,
@@ -261,5 +262,10 @@ def _lookup(model_specs, path):
             key = getattr(k, "key", getattr(k, "idx", None))
             node = node[key]
         return node if isinstance(node, PartitionSpec) else None
-    except Exception:
+    except (KeyError, IndexError, TypeError):
+        return None  # no spec at this path: replicate (normal layout gap)
+    except Exception as e:
+        # anything else is a malformed model_specs tree — still resolves
+        # to "no spec", but counted and debug-logged (no silent swallows)
+        count_suppressed("zero.model_specs_lookup", e)
         return None

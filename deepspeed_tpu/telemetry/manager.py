@@ -75,6 +75,11 @@ ENGINE_METRICS = (
     ("counter", "resilience/checkpoints_pruned", "checkpoint directories deleted by retention GC"),
     ("histogram", "resilience/save_time_ms", "wall time of save_checkpoint, end to end"),
     ("histogram", "resilience/load_time_ms", "wall time of load_checkpoint, end to end"),
+    # self-healing run supervision + fault injection (resilience/faults.py,
+    # resilience/supervisor.py, docs/resilience.md)
+    ("counter", "resilience/rollbacks", "in-process rollbacks to the last committed checkpoint (run supervisor)"),
+    ("counter", "resilience/anomalies", "anomalous windows detected by the run supervisor (non-finite loss, loss spike, stall escalation, window failure)"),
+    ("counter", "resilience/faults_injected", "faults fired by the config-armed fault-injection registry"),
 )
 
 
@@ -95,6 +100,11 @@ INFERENCE_METRICS = (
     ("counter", "infer/requests_rejected", "requests shed at the front door (queue full past the timeout)"),
     ("counter", "infer/requests_completed", "requests finished (EOS, max_new_tokens, or length cap)"),
     ("counter", "infer/tokens_generated", "decode tokens sampled across all requests"),
+    # self-healing serving (docs/inference.md "Self-healing serving")
+    ("counter", "infer/deadline_misses", "requests finished with reason 'deadline' (unmeetable at admission, or expired in flight)"),
+    ("gauge", "infer/health_state", "serving health: 0 healthy, 1 degraded (shedding priority > 0), 2 draining"),
+    ("counter", "infer/driver_restarts", "decode-driver auto-restarts from pinned params after a decode crash"),
+    ("counter", "infer/requests_shed", "priority > 0 submissions shed at the front door while degraded"),
 )
 
 

@@ -169,6 +169,37 @@ class MetricsRegistry:
 
 
 # ---------------------------------------------------------------------------
+# Suppressed-error accounting: best-effort probe paths (TPU metadata
+# probes, compile-cache verdict resets, model-spec lookups) deliberately
+# swallow failures — but NEVER silently (the no-silent-swallows audit,
+# docs/resilience.md). Each swallow debug-logs and counts here, on a
+# process-global diagnostics registry that exists before any engine or
+# telemetry block does, so "how often does this probe fail" is
+# answerable from counters instead of grep.
+# ---------------------------------------------------------------------------
+_DIAGNOSTICS = MetricsRegistry()
+
+
+def diagnostics_registry():
+    """The process-global internal-health registry (suppressed-error
+    counters); readable by tests and stall reports without any engine."""
+    return _DIAGNOSTICS
+
+
+def count_suppressed(site, exc=None):
+    """Account one deliberately swallowed exception at ``site``: a debug
+    log plus a total and a per-site counter. Call this from every
+    broad-except that intentionally continues — a swallow with no counter
+    is invisible exactly when it starts happening every step."""
+    logger.debug("suppressed error at %s: %r", site, exc)
+    _DIAGNOSTICS.counter(
+        "internal/suppressed_errors",
+        help="deliberately swallowed exceptions across best-effort paths",
+    ).inc()
+    _DIAGNOSTICS.counter(f"internal/suppressed_errors/{site}").inc()
+
+
+# ---------------------------------------------------------------------------
 # Recompile accounting via jax.monitoring: one process-global listener feeds
 # every live registry counter (engines come and go in tests; the WeakSet
 # drops counters whose telemetry was garbage-collected).

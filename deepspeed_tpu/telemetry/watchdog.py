@@ -20,6 +20,8 @@ import time
 
 from ..utils.logging import log_dist
 
+from .registry import count_suppressed
+
 
 class StepHeartbeatWatchdog:
     def __init__(
@@ -59,8 +61,15 @@ class StepHeartbeatWatchdog:
         self._paused = 0
         self._stall_reported = False
         self.stall_count = 0
+        self._stall_listeners = []
         self._thread = None
         self._stop_event = threading.Event()
+
+    def add_stall_listener(self, callback):
+        """Register ``callback(waited, last_step)`` to run (on the polling
+        thread) after every stall report — the run supervisor's
+        stall-escalation hook (resilience/supervisor.py)."""
+        self._stall_listeners.append(callback)
 
     # -- heartbeat ------------------------------------------------------
     def beat(self, step=None):
@@ -120,8 +129,14 @@ class StepHeartbeatWatchdog:
                 context = {"context_error": repr(e)}
         try:
             self._report_fn(waited, last_step, context)
-        except Exception:
-            pass  # a failing reporter must not kill the polling thread
+        except Exception as e:
+            # a failing reporter must not kill the polling thread
+            count_suppressed("watchdog.report_fn", e)
+        for cb in list(self._stall_listeners):
+            try:
+                cb(waited, last_step)
+            except Exception as e:
+                count_suppressed("watchdog.stall_listener", e)
 
     def _default_report(self, waited, last_step, context):
         lines = [

@@ -287,3 +287,105 @@ def test_zero_allow_untested_optimizer_key():
         {"train_batch_size": 8, "zero_allow_untested_optimizer": True}
     )
     assert cfg.zero_allow_untested_optimizer is True
+
+
+# ---------------------------------------------------------------------------
+# resilience self-healing blocks: fault_injection + supervisor
+# (docs/resilience.md "Fault injection" / "Self-healing supervision")
+# ---------------------------------------------------------------------------
+def _res(block):
+    return make({"train_batch_size": 8, "resilience": block})
+
+
+def test_fault_injection_and_supervisor_defaults():
+    cfg = make({"train_batch_size": 8})
+    assert cfg.resilience_fault_injection_enabled is False
+    assert cfg.resilience_fault_injection_seed == 0
+    assert cfg.resilience_fault_injection_faults == []
+    assert cfg.resilience_supervisor_enabled is False
+    assert cfg.resilience_supervisor_max_rollbacks == 2
+    assert cfg.resilience_supervisor_nonfinite_window == 3
+    assert cfg.resilience_supervisor_spike_factor == 0.0
+
+
+def test_fault_injection_valid_block_parses():
+    cfg = _res({"fault_injection": {"enabled": True, "seed": 7, "faults": [
+        {"site": "checkpoint.write", "times": 2},
+        {"site": "step.stall", "probability": 0.5,
+         "args": {"duration_ms": 10}},
+    ]}})
+    assert cfg.resilience_fault_injection_enabled is True
+    assert len(cfg.resilience_fault_injection_faults) == 2
+
+
+@pytest.mark.parametrize("block", [
+    # unknown fault-site names must fail at init, not fire never
+    {"fault_injection": {"enabled": True,
+                         "faults": [{"site": "not.a.site"}]}},
+    {"fault_injection": {"enabled": True, "faults": [{}]}},  # no site
+    {"fault_injection": {"enabled": True, "faults": []}},  # armed but empty
+    {"fault_injection": {"enabled": True, "faults": "checkpoint.write"}},
+    {"fault_injection": {"enabled": True, "faults": [
+        {"site": "grads.nan", "times": -1}]}},
+    {"fault_injection": {"enabled": True, "faults": [
+        {"site": "grads.nan", "probability": 1.5}]}},
+    {"fault_injection": {"enabled": True, "faults": [
+        {"site": "grads.nan", "after": -2}]}},
+    {"fault_injection": {"enabled": True, "faults": [
+        {"site": "step.stall", "args": 250}]}},
+    {"fault_injection": {"enabled": "yes"}},
+    {"fault_injection": {"seed": "abc"}},
+    # negative retry budgets and degenerate detector windows
+    {"supervisor": {"enabled": True, "max_rollbacks": -1}},
+    {"supervisor": {"max_rollbacks": True}},
+    {"supervisor": {"nonfinite_window": 0}},
+    {"supervisor": {"spike_window": 1}},
+    {"supervisor": {"min_history": 0}},
+    {"supervisor": {"spike_factor": -0.5}},
+    {"supervisor": {"enabled": "on"}},
+])
+def test_resilience_self_healing_rejects(block):
+    from deepspeed_tpu.config.config import DeepSpeedConfigError
+
+    with pytest.raises(DeepSpeedConfigError):
+        _res(block)
+
+
+# ---------------------------------------------------------------------------
+# inference self-healing keys: deadlines, restart budget, degraded ratio
+# ---------------------------------------------------------------------------
+def _inf(block):
+    return make({"train_batch_size": 8, "inference": block})
+
+
+def test_inference_self_healing_defaults():
+    cfg = make({"train_batch_size": 8})
+    assert cfg.inference_deadline_secs is None
+    assert cfg.inference_driver_restart_budget == 0
+    assert cfg.inference_degraded_queue_ratio == 0.75
+
+
+def test_inference_self_healing_valid_block_parses():
+    cfg = _inf({"deadline_secs": 2.5, "driver_restart_budget": 3,
+                "degraded_queue_ratio": 0.5})
+    assert cfg.inference_deadline_secs == 2.5
+    assert cfg.inference_driver_restart_budget == 3
+    assert cfg.inference_degraded_queue_ratio == 0.5
+
+
+@pytest.mark.parametrize("block", [
+    {"deadline_secs": 0},      # deadline values <= 0 rejected
+    {"deadline_secs": -1.0},
+    {"deadline_secs": "1s"},
+    {"driver_restart_budget": -1},
+    {"driver_restart_budget": 1.5},
+    {"driver_restart_budget": True},
+    {"degraded_queue_ratio": 0},
+    {"degraded_queue_ratio": 1.2},
+    {"degraded_queue_ratio": "half"},
+])
+def test_inference_self_healing_rejects(block):
+    from deepspeed_tpu.config.config import DeepSpeedConfigError
+
+    with pytest.raises(DeepSpeedConfigError):
+        _inf(block)

@@ -538,3 +538,250 @@ def test_init_inference_rejects_unsupported_stacks():
         )
     with pytest.raises(ValueError, match="model_parameters"):
         deepspeed_tpu.init_inference(model=model, config={})
+
+
+# ---------------------------------------------------------------------------
+# self-healing serving: deadlines, health-state machine, driver restart
+# (docs/inference.md "Self-healing serving")
+# ---------------------------------------------------------------------------
+import time as _time
+
+
+def _healing_engine(inference=None, resilience=None):
+    cfg, model, params = _small_model()
+    block = {"max_batch_slots": 2, "max_seq_len": 48, "prefill_len": 16,
+             "sampling": {"greedy": True}, "queue_depth": 4}
+    block.update(inference or {})
+    config = {"inference": block}
+    if resilience:
+        config["resilience"] = resilience
+    return deepspeed_tpu.init_inference(
+        model=model, model_parameters=params, config=config,
+    )
+
+
+def test_unmeetable_deadline_rejected_at_admission():
+    """A request whose deadline is already unmeetable finishes with
+    reason 'deadline' at admission — the slot is never taken and no
+    prefill runs for it."""
+    eng = _healing_engine()
+    try:
+        req = eng.submit(_prompt(6), max_new_tokens=8, deadline_secs=1e-4)
+        _time.sleep(0.01)  # the deadline passes while queued
+        eng.scheduler.step()
+        assert req.finish_reason == "deadline"
+        assert req.tokens == []
+        snap = eng.metrics.snapshot()
+        assert snap["infer/deadline_misses"] == 1
+        assert snap["infer/requests_completed"] == 0
+        assert snap["infer/slot_occupancy"] == 0
+    finally:
+        eng.close()
+
+
+def test_inflight_deadline_frees_slot_within_one_step():
+    eng = _healing_engine()
+    try:
+        req = eng.submit(_prompt(6), max_new_tokens=500, deadline_secs=30.0)
+        eng.scheduler.step()  # admit + first decode step
+        assert eng.scheduler.active_slots == [0]
+        produced = len(req.tokens)
+        assert produced >= 1
+        # force the deadline into the past; the NEXT step must reclaim
+        req.deadline = _time.monotonic() - 0.001
+        eng.scheduler.step()
+        assert req.finish_reason == "deadline"
+        assert eng.scheduler.active_slots == []
+        assert req.tokens[:produced] == req.tokens[:produced]  # partial kept
+        assert eng.metrics.snapshot()["infer/deadline_misses"] == 1
+    finally:
+        eng.close()
+
+
+def test_submit_rejects_nonpositive_deadline():
+    eng = _healing_engine()
+    try:
+        with pytest.raises(ValueError):
+            eng.submit(_prompt(6), deadline_secs=0)
+        with pytest.raises(ValueError):
+            eng.submit(_prompt(6), deadline_secs=-1.5)
+    finally:
+        eng.close()
+
+
+def test_config_default_deadline_applies_to_requests():
+    eng = _healing_engine(inference={"deadline_secs": 30.0})
+    try:
+        req = eng.submit(_prompt(6), max_new_tokens=1)
+        assert req.deadline is not None
+        eng.scheduler.run_until_idle()
+        assert req.finish_reason == "max_new_tokens"
+    finally:
+        eng.close()
+
+
+def test_degraded_health_sheds_low_priority_only():
+    from deepspeed_tpu.inference.scheduler import (
+        HEALTH_DEGRADED,
+        HEALTH_HEALTHY,
+    )
+
+    eng = _healing_engine(inference={"degraded_queue_ratio": 0.5})
+    try:
+        assert eng.scheduler.health == HEALTH_HEALTHY
+        a = eng.submit(_prompt(6), max_new_tokens=2)
+        b = eng.submit(_prompt(6), max_new_tokens=2)
+        # queue 2/4 >= 0.5 ratio: degraded — priority > 0 shed at the door
+        assert eng.scheduler.health == HEALTH_DEGRADED
+        with pytest.raises(RequestRejected):
+            eng.submit(_prompt(6), max_new_tokens=2, priority=1)
+        c = eng.submit(_prompt(6), max_new_tokens=2, priority=0)
+        snap = eng.metrics.snapshot()
+        assert snap["infer/requests_shed"] == 1
+        assert snap["infer/health_state"] == HEALTH_DEGRADED
+        eng.scheduler.run_until_idle()
+        assert {a.finish_reason, b.finish_reason, c.finish_reason} == {
+            "max_new_tokens"
+        }
+        assert eng.scheduler.health == HEALTH_HEALTHY
+    finally:
+        eng.close()
+
+
+def test_drain_stops_admission_finishes_inflight():
+    from deepspeed_tpu.inference.scheduler import HEALTH_DRAINING
+
+    eng = _healing_engine()
+    try:
+        req = eng.submit(_prompt(6), max_new_tokens=3)
+        eng.scheduler.drain()
+        assert eng.metrics.snapshot()["infer/health_state"] == HEALTH_DRAINING
+        with pytest.raises(RequestRejected):
+            eng.submit(_prompt(6), max_new_tokens=2)
+        eng.scheduler.run_until_idle()
+        assert req.finish_reason == "max_new_tokens"
+    finally:
+        eng.close()
+
+
+def test_decode_crash_auto_restarts_within_budget():
+    """An injected decode crash fails the in-flight request (its KV rows
+    died), resets the decode state from the pinned params, and the
+    scheduler keeps serving — the next request completes normally."""
+    eng = _healing_engine(
+        inference={"driver_restart_budget": 1},
+        resilience={"fault_injection": {"enabled": True, "faults": [
+            {"site": "decode.step", "after": 1, "times": 1},
+        ]}},
+    )
+    try:
+        r1 = eng.submit(_prompt(6), max_new_tokens=6)
+        eng.scheduler.run_until_idle()  # decode traversal 2 crashes
+        snap = eng.metrics.snapshot()
+        assert snap["infer/driver_restarts"] == 1
+        assert r1.finish_reason == "error"
+        assert len(r1.tokens) >= 1  # prefill token landed before the crash
+        # post-restart the engine serves from the same pinned params
+        r2 = eng.submit(_prompt(6), max_new_tokens=4)
+        eng.scheduler.run_until_idle()
+        assert r2.finish_reason == "max_new_tokens"
+        assert len(r2.tokens) == 4
+    finally:
+        eng.close()
+
+
+def test_decode_crash_exhausted_budget_drains():
+    from deepspeed_tpu.inference.scheduler import HEALTH_DRAINING
+
+    eng = _healing_engine(
+        resilience={"fault_injection": {"enabled": True, "faults": [
+            {"site": "decode.step", "after": 1, "times": 0},
+        ]}},
+    )
+    try:
+        r1 = eng.submit(_prompt(6), max_new_tokens=6)
+        eng.serve_forever()
+        r1.result(timeout=30)  # fail-finished, never hangs
+        assert r1.finish_reason in ("cancelled", "error")
+        deadline = _time.monotonic() + 5
+        while eng.scheduler.driving and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert not eng.scheduler.driving
+        assert eng.metrics.snapshot()["infer/health_state"] == HEALTH_DRAINING
+        with pytest.raises(RequestRejected):
+            eng.submit(_prompt(6), max_new_tokens=2)
+    finally:
+        eng.close()
+
+
+def test_restarted_decode_matches_clean_engine_greedy():
+    """Driver restart serves from the PINNED params: a post-restart
+    greedy generation is bitwise what a never-crashed engine produces."""
+    prompt = _prompt(8, seed=3)
+    eng = _healing_engine(
+        inference={"driver_restart_budget": 1},
+        resilience={"fault_injection": {"enabled": True, "faults": [
+            {"site": "decode.step", "times": 1},
+        ]}},
+    )
+    clean = _healing_engine()
+    try:
+        crash = eng.submit(_prompt(6), max_new_tokens=4)
+        eng.scheduler.run_until_idle()  # first decode step crashes
+        assert crash.finish_reason == "error"
+        out = eng.generate([prompt], max_new_tokens=8)[0]
+        ref = clean.generate([prompt], max_new_tokens=8)[0]
+        assert out == ref
+    finally:
+        eng.close()
+        clean.close()
+
+
+def test_prefill_crash_does_not_orphan_request():
+    """A prefill that raises must leave the popped request reachable by
+    the recovery sweeps — its result() waiter gets an answer instead of
+    hanging forever (the request owns its slot before prefill runs)."""
+    eng = _healing_engine(inference={"driver_restart_budget": 1})
+    try:
+        orig = eng.prefill_request
+        calls = {"n": 0}
+
+        def crashing_prefill(slot, tokens, temperature):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected prefill crash")
+            return orig(slot, tokens, temperature)
+
+        eng.prefill_request = crashing_prefill
+        req = eng.submit(_prompt(6), max_new_tokens=3)
+        eng.scheduler.run_until_idle()  # crash -> auto-restart
+        assert req.done  # NOT hanging
+        assert req.finish_reason == "error"
+        assert eng.metrics.snapshot()["infer/driver_restarts"] == 1
+        # and the restarted driver still serves
+        req2 = eng.submit(_prompt(6), max_new_tokens=3)
+        eng.scheduler.run_until_idle()
+        assert req2.finish_reason == "max_new_tokens"
+    finally:
+        eng.close()
+
+
+def test_queued_request_past_deadline_expires_without_free_slot():
+    """Deadline expiry reaches QUEUED requests too: with every slot busy
+    on a long generation, an expired queued request gets its 'deadline'
+    finish at the next step boundary, not when a slot eventually frees."""
+    eng = _healing_engine(inference={"max_batch_slots": 1})
+    try:
+        long_req = eng.submit(_prompt(6), max_new_tokens=30)
+        eng.scheduler.step()  # long_req occupies the only slot
+        queued = eng.submit(_prompt(6), max_new_tokens=5, deadline_secs=60)
+        queued.deadline = _time.monotonic() - 0.001  # force expiry
+        eng.scheduler.step()  # slot still busy; queued must expire NOW
+        assert queued.finish_reason == "deadline"
+        assert long_req.finish_reason is None  # untouched
+        eng.scheduler.run_until_idle()
+        assert long_req.finish_reason == "max_new_tokens"
+        # the expired husk was discarded at admission, never decoded
+        assert queued.tokens == []
+    finally:
+        eng.close()

@@ -797,3 +797,404 @@ def test_resilience_shares_telemetry_registry(tmp_path):
         assert "resilience/save_time_ms" in tags
     finally:
         engine.telemetry.close()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection registry (resilience/faults.py)
+# ---------------------------------------------------------------------------
+def test_fault_injector_unknown_site_rejected():
+    from deepspeed_tpu.resilience.faults import FaultSpec
+
+    with pytest.raises(ValueError):
+        FaultSpec("not.a.site")
+
+
+def test_fault_injector_times_after_semantics():
+    from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+
+    inj = FaultInjector([FaultSpec("grads.nan", times=2, after=3)])
+    fired = [inj.fire("grads.nan") is not None for _ in range(8)]
+    # traversals 1-3 skipped (after), 4-5 fire (times=2), 6+ exhausted
+    assert fired == [False, False, False, True, True, False, False, False]
+    assert inj.injected["grads.nan"] == 2
+
+
+def test_fault_injector_probability_is_seed_deterministic():
+    from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+
+    def pattern(seed):
+        inj = FaultInjector(
+            [FaultSpec("decode.step", times=0, probability=0.5, seed=seed)],
+            seed=seed,
+        )
+        return [inj.fire("decode.step") is not None for _ in range(64)]
+
+    a, b = pattern(7), pattern(7)
+    assert a == b  # same seed => identical firing traversals
+    assert any(a) and not all(a)  # probability actually thins the firings
+    assert pattern(8) != a  # a different seed moves them
+
+
+def test_fault_injector_raises_site_canonical_exception():
+    from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+
+    inj = FaultInjector([
+        FaultSpec("checkpoint.write"), FaultSpec("staging.worker"),
+    ])
+    with pytest.raises(OSError):
+        inj.maybe_raise("checkpoint.write")
+    with pytest.raises(RuntimeError):
+        inj.maybe_raise("staging.worker")
+    # exhausted: subsequent traversals pass through clean
+    inj.maybe_raise("checkpoint.write")
+
+
+def test_null_injector_is_inert():
+    from deepspeed_tpu.resilience.faults import NULL_INJECTOR
+
+    assert NULL_INJECTOR.enabled is False
+    assert NULL_INJECTOR.fire("grads.nan") is None
+    NULL_INJECTOR.maybe_raise("checkpoint.write")  # no-op
+
+
+# ---------------------------------------------------------------------------
+# suppressed-error audit (no silent swallows)
+# ---------------------------------------------------------------------------
+def test_count_suppressed_increments_diagnostics_registry():
+    from deepspeed_tpu.telemetry.registry import (
+        count_suppressed,
+        diagnostics_registry,
+    )
+
+    before = diagnostics_registry().counter(
+        "internal/suppressed_errors"
+    ).value
+    count_suppressed("test.site", RuntimeError("boom"))
+    snap = diagnostics_registry().snapshot()
+    assert snap["internal/suppressed_errors"] == before + 1
+    assert snap["internal/suppressed_errors/test.site"] >= 1
+
+
+def test_compile_cache_disarm_failure_is_counted_not_silent(monkeypatch):
+    import jax as _jax
+
+    from deepspeed_tpu.runtime import compile_cache
+    from deepspeed_tpu.telemetry.registry import diagnostics_registry
+
+    compile_cache._armed = ("/tmp/x", 0.0)
+    monkeypatch.setattr(
+        _jax.config, "update",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("nope")),
+    )
+    before = diagnostics_registry().counter(
+        "internal/suppressed_errors"
+    ).value
+    compile_cache.disarm_compile_cache()  # must not raise
+    assert compile_cache._armed is None
+    assert diagnostics_registry().counter(
+        "internal/suppressed_errors"
+    ).value > before
+
+
+# ---------------------------------------------------------------------------
+# self-healing run supervision (resilience/supervisor.py)
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.resilience import (  # noqa: E402
+    ReplayableDataSource,
+    SupervisorEscalation,
+)
+
+
+def _chaos_factory(micro=8, dim=INPUT_DIM, base_seed=20_000):
+    """Deterministic micro-batch stream: batch i is a pure function of
+    (base_seed, i), so any start offset replays bitwise."""
+    def factory(start):
+        def gen(i):
+            while True:
+                r = np.random.default_rng(base_seed + i)
+                x = r.normal(size=(micro, dim)).astype(np.float32)
+                y = r.integers(0, 10, micro).astype(np.int32)
+                yield (x, y)
+                i += 1
+
+        return gen(start)
+
+    return factory
+
+
+def _supervised_engine(faults, seed=0, max_rollbacks=2, staging=False,
+                       nonfinite_window=1):
+    extra = {
+        "resilience": {
+            "supervisor": {
+                "enabled": True,
+                "nonfinite_window": nonfinite_window,
+                "max_rollbacks": max_rollbacks,
+            },
+            "fault_injection": {"enabled": bool(faults), "faults": faults}
+            if faults else {},
+        },
+    }
+    if staging:
+        extra["data_pipeline"] = {"enabled": True, "staging_buffers": 2}
+    return _make_engine(cfg_extra=extra, seed=seed)
+
+
+def test_replayable_source_rewinds_bitwise():
+    src = ReplayableDataSource(_chaos_factory())
+    first = [next(src) for _ in range(4)]
+    assert src.position == 4
+    src.rewind(1)
+    replay = [next(src) for _ in range(3)]
+    for (xa, ya), (xb, yb) in zip(first[1:], replay):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+@pytest.mark.parametrize("site,staging", [
+    ("grads.nan", False),
+    ("grads.nan", True),
+    ("staging.worker", True),
+    ("staging.device_put", True),
+])
+def test_injected_fault_self_heals_bitwise(tmp_path, site, staging):
+    """Chaos matrix core: an injected fault after the commit point either
+    poisons a window (grads.nan) or kills the input pipeline
+    (staging.*); the supervisor rolls back to the checkpoint, rewinds
+    the data/RNG chain, and the run completes BITWISE-identical to an
+    uninjected replay from the same checkpoint."""
+    factory = _chaos_factory()
+    engine = _supervised_engine(
+        [{"site": site, "after": 3, "times": 1}], seed=3, staging=staging,
+    )
+    src = ReplayableDataSource(factory)
+    losses = [float(engine.train_batch(src)) for _ in range(2)]
+    engine.save_checkpoint(str(tmp_path))
+    losses += [float(engine.train_batch(src)) for _ in range(4)]
+    engine.close_data_pipeline()
+    assert all(np.isfinite(losses)), losses
+    snap = engine.resilience.registry.snapshot()
+    assert snap["resilience/rollbacks"] == 1
+    assert snap["resilience/anomalies"] == 1
+    assert snap["resilience/faults_injected"] == 1
+
+    # uninjected replay from the same checkpoint: bitwise-identical
+    replay = _make_engine(seed=9)
+    path, _ = replay.load_checkpoint(str(tmp_path))
+    assert path is not None
+    src2 = ReplayableDataSource(factory, start=replay.micro_steps)
+    n_replay = engine.global_steps - replay.global_steps
+    assert n_replay > 0
+    for _ in range(n_replay):
+        float(replay.train_batch(src2))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, engine.params)
+        ),
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, replay.params)
+        ),
+    ):
+        np.testing.assert_array_equal(a, b)
+    assert engine.global_steps == replay.global_steps
+    assert engine.micro_steps == replay.micro_steps
+
+
+def test_persistent_fault_escalates_with_typed_error(tmp_path):
+    """times=0 (unlimited) grads.nan: every replayed window re-poisons,
+    so the retry budget drains and the supervisor escalates with the
+    typed terminal error instead of looping forever."""
+    engine = _supervised_engine(
+        [{"site": "grads.nan", "after": 2, "times": 0}],
+        seed=4, max_rollbacks=1,
+    )
+    src = ReplayableDataSource(_chaos_factory())
+    float(engine.train_batch(src))
+    engine.save_checkpoint(str(tmp_path))
+    float(engine.train_batch(src))  # traversal 2: still clean
+    with pytest.raises(SupervisorEscalation) as exc_info:
+        for _ in range(4):
+            float(engine.train_batch(src))
+    assert exc_info.value.rollbacks == 1
+    assert "budget" in str(exc_info.value)
+
+
+def test_anomaly_without_checkpoint_escalates(tmp_path):
+    """No committed checkpoint => nothing to roll back to: the first
+    anomaly escalates immediately (typed), never hangs or corrupts."""
+    engine = _supervised_engine(
+        [{"site": "grads.nan", "after": 0, "times": 1}], seed=5,
+    )
+    src = ReplayableDataSource(_chaos_factory())
+    with pytest.raises(SupervisorEscalation):
+        float(engine.train_batch(src))
+
+
+def test_stall_escalation_rolls_back_at_next_boundary(tmp_path):
+    engine = _supervised_engine([], seed=6)
+    src = ReplayableDataSource(_chaos_factory())
+    float(engine.train_batch(src))
+    engine.save_checkpoint(str(tmp_path))
+    engine.supervisor.notify_stall(waited=123.0, last_step=1)
+    # boundary after the stall: rollback to step 1, then the retried
+    # window completes inside the same call -> step 2
+    float(engine.train_batch(src))
+    assert engine.supervisor.rollbacks == 1
+    assert engine.global_steps == 2
+    float(engine.train_batch(src))  # and the run keeps going
+    assert engine.global_steps == 3
+
+
+def test_watchdog_stall_listener_fires():
+    from deepspeed_tpu.telemetry.watchdog import StepHeartbeatWatchdog
+
+    clock = {"t": 0.0}
+    seen = []
+    wd = StepHeartbeatWatchdog(timeout=10.0, clock=lambda: clock["t"])
+    wd.add_stall_listener(lambda waited, step: seen.append((waited, step)))
+    wd.beat(step=3)
+    clock["t"] = 11.0
+    assert wd.check() is True
+    assert seen and seen[0][1] == 3
+
+
+def test_step_stall_fault_sleeps_and_run_completes(tmp_path):
+    engine = _supervised_engine(
+        [{"site": "step.stall", "times": 1, "args": {"duration_ms": 30}}],
+        seed=7,
+    )
+    src = ReplayableDataSource(_chaos_factory())
+    import time as _time
+
+    t0 = _time.monotonic()
+    losses = [float(engine.train_batch(src)) for _ in range(2)]
+    assert _time.monotonic() - t0 >= 0.03
+    assert all(np.isfinite(losses))
+    assert engine.resilience.faults.injected["step.stall"] == 1
+
+
+def test_spike_detector_triggers_rollback(monkeypatch):
+    """Unit-level: a finite loss far above the rolling mean is an anomaly
+    once min_history is met (rollback mocked — the trigger is the
+    contract under test)."""
+    from deepspeed_tpu.resilience.supervisor import TrainingSupervisor
+
+    sup = TrainingSupervisor(
+        spike_factor=3.0, spike_window=8, min_history=4, nonfinite_window=10,
+    )
+    calls = []
+    monkeypatch.setattr(
+        sup, "rollback", lambda engine, reason: calls.append(reason)
+    )
+
+    class FakeEngine:
+        _last_grad_norm = 0.5
+
+    eng = FakeEngine()
+    for _ in range(5):
+        assert sup.on_window(eng, 1.0) is False
+    assert sup.on_window(eng, 10.0) is True  # > 3x rolling mean of 1.0
+    assert calls and "spike" in calls[0]
+
+
+def test_consecutive_nonfinite_budget(monkeypatch):
+    from deepspeed_tpu.resilience.supervisor import TrainingSupervisor
+
+    sup = TrainingSupervisor(nonfinite_window=3)
+    calls = []
+    monkeypatch.setattr(
+        sup, "rollback", lambda engine, reason: calls.append(reason)
+    )
+
+    class FakeEngine:
+        _last_grad_norm = 0.5
+
+    eng = FakeEngine()
+    assert sup.on_window(eng, float("nan")) is False
+    assert sup.on_window(eng, 1.0) is False  # recovery resets the count
+    assert sup.on_window(eng, float("nan")) is False
+    assert sup.on_window(eng, float("inf")) is False
+    assert sup.on_window(eng, float("nan")) is True  # 3 consecutive
+    # the -1.0 grad-norm sentinel (device-side skip) also counts as bad
+    sup2 = TrainingSupervisor(nonfinite_window=1)
+    monkeypatch.setattr(
+        sup2, "rollback", lambda engine, reason: calls.append(reason)
+    )
+
+    class SkippedEngine:
+        _last_grad_norm = -1.0
+
+    assert sup2.on_window(SkippedEngine(), 1.0) is True
+
+
+def test_checkpoint_read_fault_during_rollback_is_retried(tmp_path):
+    """Chaos on the healer itself: a transient read flake during the
+    rollback's verified load is absorbed by retry backoff — the rollback
+    still lands."""
+    engine = _supervised_engine(
+        [
+            {"site": "grads.nan", "after": 2, "times": 1},
+            {"site": "checkpoint.read", "times": 1},
+        ],
+        seed=8,
+    )
+    src = ReplayableDataSource(_chaos_factory())
+    float(engine.train_batch(src))
+    engine.save_checkpoint(str(tmp_path))
+    losses = [float(engine.train_batch(src)) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    snap = engine.resilience.registry.snapshot()
+    assert snap["resilience/rollbacks"] == 1
+    assert snap["resilience/io_retries"] >= 1
+
+
+def test_checkpoint_rng_key_roundtrip(tmp_path):
+    """Checkpoints persist the RNG key chain: a fresh engine (different
+    seed) that loads one adopts the saved chain exactly — the resume
+    splits the keys the original run would have."""
+    engine = _make_engine(seed=21)
+    _run_steps(engine, n=1, seed=21)
+    engine.save_checkpoint(str(tmp_path))
+    other = _make_engine(seed=99)
+    assert not np.array_equal(
+        np.asarray(other._rng), np.asarray(engine._rng)
+    )
+    other.load_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(
+        np.asarray(other._rng), np.asarray(engine._rng)
+    )
+
+
+def test_ragged_window_error_is_not_healed(tmp_path):
+    """Dataset exhaustion mid-window is the caller's sizing bug: the
+    supervisor must surface the ragged-window error, not roll back and
+    re-train old windows until its budget drains."""
+    engine = _supervised_engine([], seed=30)
+    bs = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
+
+    class Finite:
+        """2.5 windows of data with accum=2: ends mid-window."""
+        def __init__(self):
+            self.n = 0
+        def __iter__(self):
+            return self
+        def __next__(self):
+            if self.n >= 5:
+                raise StopIteration
+            self.n += 1
+            r = np.random.default_rng(self.n)
+            return (r.normal(size=(bs, INPUT_DIM)).astype(np.float32),
+                    r.integers(0, 10, bs).astype(np.int32))
+        def rewind(self, position):  # rewindable, so rollback WOULD engage
+            self.n = position
+
+    # force accum=2 semantics via the unstaged list-window path: pull 2
+    # micro-batches per train_batch call by overriding accum
+    engine.config.gradient_accumulation_steps = 2
+    src = Finite()
+    float(engine.train_batch(src))
+    engine.save_checkpoint(str(tmp_path))
+    float(engine.train_batch(src))
+    with pytest.raises(RuntimeError, match="ran dry mid-window"):
+        engine.train_batch(src)
+    assert engine.supervisor.rollbacks == 0  # never tried to heal this
