@@ -945,6 +945,135 @@ def smoke_infer():
     }))
 
 
+def smoke_fleet():
+    """CI fast path (``python bench.py --smoke-fleet``): two tiny CPU
+    in-process replicas behind the FleetRouter (docs/serving.md) serving
+    concurrent mixed-tenant traffic through ONE rolling drain/restart
+    cycle. Asserts ZERO lost requests (every submission answered exactly
+    once, greedy outputs bitwise-identical to a single-replica run),
+    capacity never below the floor, and fleet p99 TTFT recorded through
+    the telemetry sinks. Prints one JSON line and exits non-zero on any
+    failed check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    tmp = tempfile.mkdtemp(prefix="ds_smoke_fleet_")
+    cfg = GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False,
+    )
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    ids0 = jnp.asarray(rng.integers(0, 128, (1, 8)), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids0, ids0,
+    )["params"]
+
+    def engine_factory():
+        return deepspeed_tpu.init_inference(
+            model=model, model_parameters=params,
+            config={"inference": {
+                "max_batch_slots": 2, "max_seq_len": 48,
+                "prefill_len": 16, "sampling": {"greedy": True},
+            }},
+        )
+
+    prompts = [
+        [int(t) for t in rng.integers(0, 128, n)] for n in (9, 5, 13, 7)
+    ]
+    single = engine_factory()
+    reference = single.generate(prompts, max_new_tokens=8)
+    single.close()
+
+    router = deepspeed_tpu.init_fleet(
+        engine_factory=engine_factory,
+        config={
+            "serving": {"replicas": 2, "capacity_floor": 0.5},
+            "telemetry": {
+                "enabled": True,
+                "output_path": os.path.join(tmp, "telemetry"),
+                "job_name": "smoke_fleet",
+                "watchdog": {"enabled": False},
+            },
+        },
+    )
+    available = router.metrics.gauge("fleet/replicas_available")
+    floor_breaches = []
+    results, errors = {}, []
+
+    def client(i):
+        tenant = "alpha" if i % 2 == 0 else "beta"
+        try:
+            req = router.submit(
+                prompts[i % 4], tenant=tenant, max_new_tokens=8
+            )
+            results.setdefault(i, []).append(req.result(300.0))
+        except Exception as e:
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+
+    stop_watch = threading.Event()
+
+    def watch_floor():
+        while not stop_watch.is_set():
+            if available.value < 1.0:  # ceil(0.5 * 2) replicas
+                floor_breaches.append(available.value)
+            time.sleep(0.002)
+
+    watcher = threading.Thread(target=watch_floor, daemon=True)
+    watcher.start()
+    router.rolling_restart(wait_timeout=120.0)  # the drain/restart cycle
+    for t in threads:
+        t.join(300.0)
+    stop_watch.set()
+    watcher.join(5.0)
+
+    assert not errors, errors
+    assert len(results) == 8, f"lost requests: {sorted(results)}"
+    for i, answers in results.items():
+        assert len(answers) == 1, f"request {i} answered {len(answers)}x"
+        assert answers[0] == reference[i % 4], f"request {i} diverged"
+    router.refresh_telemetry()
+    snap = router.metrics.snapshot()
+    assert snap["fleet/requests_completed"] == 8, snap
+    assert snap["fleet/replica_restarts"] == 2, snap
+    assert snap["fleet/ttft_ms/count"] == 8, snap
+    assert snap["fleet/ttft_p99_ms"] > 0, "fleet p99 TTFT not recorded"
+    assert not floor_breaches, floor_breaches
+    router.shutdown()
+    prom = open(
+        os.path.join(tmp, "telemetry", "smoke_fleet", "metrics.prom")
+    ).read()
+    assert "fleet_ttft_ms_bucket" in prom, "fleet TTFT missing from prom"
+    assert "fleet_requests_routed" in prom, "fleet counters missing"
+
+    print(json.dumps({
+        "metric": "smoke_fleet_rolling_restart",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": {
+            "requests": 8,
+            "replicas": 2,
+            "restarts": int(snap["fleet/replica_restarts"]),
+            "ttft_p50_ms": round(snap["fleet/ttft_p50_ms"], 1),
+            "ttft_p99_ms": round(snap["fleet/ttft_p99_ms"], 1),
+            "rerouted": int(snap["fleet/requests_rerouted"]),
+        },
+    }))
+
+
 def smoke_chaos():
     """CI fast path (``python bench.py --smoke-chaos``): a tiny CPU run
     under the fault-injection registry (docs/resilience.md) — one
@@ -1053,6 +1182,9 @@ def main():
         return
     if "--smoke-chaos" in sys.argv:
         smoke_chaos()
+        return
+    if "--smoke-fleet" in sys.argv:
+        smoke_fleet()
         return
     if os.environ.get("BENCH_WORKER"):
         _worker_main()

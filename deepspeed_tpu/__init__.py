@@ -104,6 +104,31 @@ def init_inference(
     )
 
 
+def init_fleet(
+    engine_factory=None,
+    worker_spec=None,
+    config=None,
+    registry=None,
+    start=True,
+):
+    """Build a multi-replica serving fleet (deepspeed_tpu/serving/,
+    docs/serving.md): a ``FleetRouter`` spreading requests over N
+    inference-engine replicas with per-tenant rate limits, pluggable
+    placement (least-loaded / prefix-affinity), and rolling-restart
+    lifecycle. Pass ``engine_factory`` (in-process replicas) or
+    ``worker_spec`` (one engine per worker subprocess); the ``"serving"``
+    config block sizes the fleet."""
+    from .serving import init_fleet as _init_fleet
+
+    return _init_fleet(
+        engine_factory=engine_factory,
+        worker_spec=worker_spec,
+        config=config,
+        registry=registry,
+        start=start,
+    )
+
+
 def _add_core_arguments(parser):
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
     group.add_argument(

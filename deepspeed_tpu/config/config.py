@@ -486,6 +486,54 @@ class DeepSpeedConfig:
             C.INFERENCE_CHECKPOINT_TAG_DEFAULT,
         )
 
+        # serving block (deepspeed_tpu/serving/, docs/serving.md)
+        srv_dict = get_dict_param(pd, C.SERVING)
+        self.serving_replicas = get_scalar_param(
+            srv_dict, C.SERVING_REPLICAS, C.SERVING_REPLICAS_DEFAULT
+        )
+        self.serving_backend = get_scalar_param(
+            srv_dict, C.SERVING_BACKEND, C.SERVING_BACKEND_DEFAULT
+        )
+        self.serving_placement = get_scalar_param(
+            srv_dict, C.SERVING_PLACEMENT, C.SERVING_PLACEMENT_DEFAULT
+        )
+        self.serving_affinity_prefix_tokens = get_scalar_param(
+            srv_dict, C.SERVING_AFFINITY_PREFIX_TOKENS,
+            C.SERVING_AFFINITY_PREFIX_TOKENS_DEFAULT,
+        )
+        self.serving_capacity_floor = get_scalar_param(
+            srv_dict, C.SERVING_CAPACITY_FLOOR,
+            C.SERVING_CAPACITY_FLOOR_DEFAULT,
+        )
+        self.serving_shed_queue_ratio = get_scalar_param(
+            srv_dict, C.SERVING_SHED_QUEUE_RATIO,
+            C.SERVING_SHED_QUEUE_RATIO_DEFAULT,
+        )
+        self.serving_max_reroutes = get_scalar_param(
+            srv_dict, C.SERVING_MAX_REROUTES, C.SERVING_MAX_REROUTES_DEFAULT
+        )
+        self.serving_drain_on_preemption = get_scalar_param(
+            srv_dict, C.SERVING_DRAIN_ON_PREEMPTION,
+            C.SERVING_DRAIN_ON_PREEMPTION_DEFAULT,
+        )
+        rl_dict = get_dict_param(srv_dict, C.SERVING_RATE_LIMIT)
+        self.serving_rate_limit_rps = get_scalar_param(
+            rl_dict, C.SERVING_RATE_LIMIT_RPS, C.SERVING_RATE_LIMIT_RPS_DEFAULT
+        )
+        self.serving_rate_limit_burst = get_scalar_param(
+            rl_dict, C.SERVING_RATE_LIMIT_BURST,
+            C.SERVING_RATE_LIMIT_BURST_DEFAULT,
+        )
+        per_tenant = rl_dict.get(
+            C.SERVING_RATE_LIMIT_PER_TENANT,
+            C.SERVING_RATE_LIMIT_PER_TENANT_DEFAULT,
+        )
+        # keep non-dict values for _check_serving to reject loudly
+        self.serving_rate_limit_per_tenant = (
+            dict(per_tenant) if isinstance(per_tenant, dict)
+            else {} if per_tenant is None else per_tenant
+        )
+
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
         self.data_parallel_size = get_scalar_param(
@@ -584,6 +632,7 @@ class DeepSpeedConfig:
         self._check_resilience()
         self._check_data_pipeline()
         self._check_inference()
+        self._check_serving()
         amp_dict = get_dict_param(self._param_dict, C.AMP)
         if amp_dict.get(C.AMP_ENABLED, bool(amp_dict)):
             # apex amp (reference deepspeed_light.py:516-521) has no TPU
@@ -1042,6 +1091,150 @@ class DeepSpeedConfig:
                 f"('' = serve the passed-in parameters), got "
                 f"{self.inference_checkpoint_load_dir!r}"
             )
+
+    def _check_serving(self):
+        """Validate the serving block (docs/serving.md): a typo'd backend
+        or a capacity floor no rolling restart can satisfy must fail at
+        init_fleet(), not mid-restart with live traffic on the fleet."""
+        replicas = self.serving_replicas
+        if (
+            not isinstance(replicas, int)
+            or isinstance(replicas, bool)
+            or replicas < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_REPLICAS} must be an integer >= 1, "
+                f"got {replicas!r}"
+            )
+        if self.serving_backend not in C.SERVING_VALID_BACKENDS:
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_BACKEND} must be one of "
+                f"{list(C.SERVING_VALID_BACKENDS)}, got "
+                f"{self.serving_backend!r}"
+            )
+        if self.serving_placement not in C.SERVING_VALID_PLACEMENTS:
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_PLACEMENT} must be one of "
+                f"{list(C.SERVING_VALID_PLACEMENTS)}, got "
+                f"{self.serving_placement!r}"
+            )
+        affinity = self.serving_affinity_prefix_tokens
+        if (
+            not isinstance(affinity, int)
+            or isinstance(affinity, bool)
+            or affinity < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_AFFINITY_PREFIX_TOKENS} must be an "
+                f"integer >= 1, got {affinity!r}"
+            )
+        floor = self.serving_capacity_floor
+        if (
+            not isinstance(floor, (int, float))
+            or isinstance(floor, bool)
+            or not 0 <= floor < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_CAPACITY_FLOOR} must be a number "
+                f"in [0, 1) — the fraction of replicas that must stay "
+                f"routable (< 1, or no replica could ever drain), got "
+                f"{floor!r}"
+            )
+        shed = self.serving_shed_queue_ratio
+        if (
+            not isinstance(shed, (int, float))
+            or isinstance(shed, bool)
+            or not 0 < shed <= 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_SHED_QUEUE_RATIO} must be a number "
+                f"in (0, 1], got {shed!r}"
+            )
+        reroutes = self.serving_max_reroutes
+        if (
+            not isinstance(reroutes, int)
+            or isinstance(reroutes, bool)
+            or reroutes < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_MAX_REROUTES} must be an integer "
+                f">= 0 (0 = fail a request with its replica), got "
+                f"{reroutes!r}"
+            )
+        if not isinstance(self.serving_drain_on_preemption, bool):
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_DRAIN_ON_PREEMPTION} must be a "
+                f"boolean, got {self.serving_drain_on_preemption!r}"
+            )
+        rl = f"{C.SERVING}.{C.SERVING_RATE_LIMIT}"
+        rl_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING), C.SERVING_RATE_LIMIT
+        )
+        unknown = set(rl_dict) - {
+            C.SERVING_RATE_LIMIT_RPS, C.SERVING_RATE_LIMIT_BURST,
+            C.SERVING_RATE_LIMIT_PER_TENANT,
+        }
+        if unknown:
+            # a typo'd requests_per_sec would otherwise mean "unlimited"
+            # in production — the exact silent misconfiguration this
+            # validator exists to catch
+            raise DeepSpeedConfigError(
+                f"{rl}: unknown keys {sorted(unknown)}; valid: "
+                f"['{C.SERVING_RATE_LIMIT_BURST}', "
+                f"'{C.SERVING_RATE_LIMIT_PER_TENANT}', "
+                f"'{C.SERVING_RATE_LIMIT_RPS}']"
+            )
+        if not isinstance(self.serving_rate_limit_per_tenant, dict):
+            raise DeepSpeedConfigError(
+                f"{rl}.{C.SERVING_RATE_LIMIT_PER_TENANT} must be an object "
+                f"mapping tenant -> limits, got "
+                f"{self.serving_rate_limit_per_tenant!r}"
+            )
+        limits = [(
+            f"{rl}", self.serving_rate_limit_rps,
+            self.serving_rate_limit_burst,
+        )]
+        for tenant, block in self.serving_rate_limit_per_tenant.items():
+            where = f"{rl}.{C.SERVING_RATE_LIMIT_PER_TENANT}.{tenant}"
+            if not isinstance(block, dict):
+                raise DeepSpeedConfigError(
+                    f"{where} must be an object, got {block!r}"
+                )
+            unknown = set(block) - {
+                C.SERVING_RATE_LIMIT_RPS, C.SERVING_RATE_LIMIT_BURST,
+            }
+            if unknown:
+                raise DeepSpeedConfigError(
+                    f"{where}: unknown keys {sorted(unknown)}; valid: "
+                    f"['{C.SERVING_RATE_LIMIT_BURST}', "
+                    f"'{C.SERVING_RATE_LIMIT_RPS}']"
+                )
+            limits.append((
+                where,
+                block.get(C.SERVING_RATE_LIMIT_RPS,
+                          self.serving_rate_limit_rps),
+                block.get(C.SERVING_RATE_LIMIT_BURST,
+                          self.serving_rate_limit_burst),
+            ))
+        for where, rps, burst in limits:
+            if rps is not None and (
+                not isinstance(rps, (int, float))
+                or isinstance(rps, bool)
+                or rps <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{where}.{C.SERVING_RATE_LIMIT_RPS} must be a number "
+                    f"> 0 or null (null = unlimited), got {rps!r}"
+                )
+            if (
+                not isinstance(burst, int)
+                or isinstance(burst, bool)
+                or burst < 1
+            ):
+                raise DeepSpeedConfigError(
+                    f"{where}.{C.SERVING_RATE_LIMIT_BURST} must be an "
+                    f"integer >= 1, got {burst!r}"
+                )
 
     def _do_warning_check(self):
         if self.zero_enabled and not (self.fp16_enabled or self.bf16_enabled):

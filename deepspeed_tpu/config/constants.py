@@ -512,6 +512,65 @@ INFERENCE_CHECKPOINT_TAG = "tag"
 INFERENCE_CHECKPOINT_TAG_DEFAULT = None  # None => the 'latest' pointer
 
 #############################################
+# Multi-replica serving tier (deepspeed_tpu/serving/, docs/serving.md):
+# a FleetRouter in front of N inference-engine replicas — placement,
+# per-tenant admission, and rolling-restart lifecycle. The DeepSpeed-
+# Inference "serving at scale" act on top of the per-replica Orca-style
+# scheduler the "inference" block configures.
+#############################################
+SERVING = "serving"
+# Engine replicas behind the router. Each replica is one full
+# InferenceEngine (own KV cache, own scheduler, own driver thread).
+SERVING_REPLICAS = "replicas"
+SERVING_REPLICAS_DEFAULT = 1
+# Replica isolation backend: "in_process" (N engines in this process —
+# zero-copy, shares the host) or "subprocess" (one engine per worker
+# process, newline-JSON RPC over pipes — a crashed replica cannot take
+# the router down).
+SERVING_BACKEND = "backend"
+SERVING_BACKEND_DEFAULT = "in_process"
+SERVING_VALID_BACKENDS = ("in_process", "subprocess")
+# Placement policy: "least_loaded" scores queue depth + slot occupancy,
+# "prefix_affinity" routes identical templated prompt prefixes to the
+# replica that served them (the hook a cross-request prefix cache plugs
+# into) falling back to least-loaded, "round_robin" ignores load.
+SERVING_PLACEMENT = "placement"
+SERVING_PLACEMENT_DEFAULT = "least_loaded"
+SERVING_VALID_PLACEMENTS = ("least_loaded", "prefix_affinity", "round_robin")
+# Prompt tokens hashed for prefix affinity (the templated-system-prompt
+# span; prompts shorter than this hash whole).
+SERVING_AFFINITY_PREFIX_TOKENS = "affinity_prefix_tokens"
+SERVING_AFFINITY_PREFIX_TOKENS_DEFAULT = 16
+# Fraction of replicas that must stay routable during lifecycle
+# operations: rolling_restart() refuses to start when draining one more
+# replica would leave fewer than ceil(floor * replicas) serving.
+SERVING_CAPACITY_FLOOR = "capacity_floor"
+SERVING_CAPACITY_FLOOR_DEFAULT = 0.5
+# Fleet-wide queue-fill fraction past which priority > 0 submissions are
+# shed at the ROUTER's door (before any replica queue is touched).
+SERVING_SHED_QUEUE_RATIO = "shed_queue_ratio"
+SERVING_SHED_QUEUE_RATIO_DEFAULT = 0.75
+# Re-route attempts for a request whose replica died under it before the
+# router fails the request to its caller.
+SERVING_MAX_REROUTES = "max_reroutes"
+SERVING_MAX_REROUTES_DEFAULT = 2
+# Install the resilience PreemptionHandler so SIGTERM/SIGINT drains the
+# whole fleet gracefully (in-flight requests finish, new traffic sheds)
+# instead of killing mid-decode.
+SERVING_DRAIN_ON_PREEMPTION = "drain_on_preemption"
+SERVING_DRAIN_ON_PREEMPTION_DEFAULT = False
+# Per-tenant token-bucket admission. "rate_limit" sets the default
+# bucket (requests_per_sec null = unlimited); "per_tenant" maps tenant
+# name -> {requests_per_sec, burst} overrides.
+SERVING_RATE_LIMIT = "rate_limit"
+SERVING_RATE_LIMIT_RPS = "requests_per_sec"
+SERVING_RATE_LIMIT_RPS_DEFAULT = None
+SERVING_RATE_LIMIT_BURST = "burst"
+SERVING_RATE_LIMIT_BURST_DEFAULT = 1
+SERVING_RATE_LIMIT_PER_TENANT = "per_tenant"
+SERVING_RATE_LIMIT_PER_TENANT_DEFAULT = None  # None => {} (no overrides)
+
+#############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,
 # which delegated model parallelism to an external mpu object)
 #############################################

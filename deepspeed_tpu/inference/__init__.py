@@ -24,12 +24,22 @@ from .decode import (
 from .engine import InferenceEngine, init_inference
 from .sampling import sample_tokens
 from .scheduler import (
+    REJECT_DEADLINE,
+    REJECT_DRAINING,
+    REJECT_OVERLOAD,
+    REJECT_RATE_LIMIT,
+    REJECT_REASONS,
     ContinuousBatchingScheduler,
     InferenceRequest,
     RequestRejected,
 )
 
 __all__ = [
+    "REJECT_DEADLINE",
+    "REJECT_DRAINING",
+    "REJECT_OVERLOAD",
+    "REJECT_RATE_LIMIT",
+    "REJECT_REASONS",
     "KVCache",
     "gpt2_decode_step",
     "gpt2_prefill",

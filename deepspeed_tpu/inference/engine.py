@@ -374,6 +374,11 @@ class InferenceEngine:
         :meth:`ContinuousBatchingScheduler.submit`."""
         return self.scheduler.submit(prompt_tokens, **kwargs)
 
+    def load_snapshot(self):
+        """Router-facing load/health view; see
+        :meth:`ContinuousBatchingScheduler.load_snapshot`."""
+        return self.scheduler.load_snapshot()
+
     def generate(self, prompts, max_new_tokens=32, temperature=None,
                  eos_token_id=None):
         """Synchronous batch generation: submit every prompt (token-id

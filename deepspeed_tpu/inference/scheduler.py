@@ -43,9 +43,33 @@ from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS
 from ..utils.logging import logger
 
 
+# Machine-readable rejection reason codes carried by RequestRejected (and
+# its fleet-tier subclasses in deepspeed_tpu/serving/): routers and tests
+# branch on ``exc.reason``, never on the prose message.
+REJECT_OVERLOAD = "overload"      # queue full / degraded shedding / fleet full
+REJECT_DEADLINE = "deadline"      # deadline unmeetable at an admission gate
+REJECT_RATE_LIMIT = "rate_limit"  # per-tenant token bucket empty
+REJECT_DRAINING = "draining"      # draining or shut-down front door
+REJECT_REASONS = (
+    REJECT_OVERLOAD, REJECT_DEADLINE, REJECT_RATE_LIMIT, REJECT_DRAINING,
+)
+
+
 class RequestRejected(RuntimeError):
     """The front door shed this request (queue full past the timeout,
-    degraded-health priority shedding, or a draining scheduler)."""
+    degraded-health priority shedding, or a draining scheduler).
+
+    ``reason`` is one of the REJECT_* codes above — the machine-readable
+    classification the serving tier routes and retries on."""
+
+    def __init__(self, message, reason=REJECT_OVERLOAD):
+        if reason not in REJECT_REASONS:
+            raise ValueError(
+                f"unknown rejection reason {reason!r}; valid: "
+                f"{REJECT_REASONS}"
+            )
+        super().__init__(message)
+        self.reason = reason
 
 
 _FINISH_EOS = "eos"
@@ -131,6 +155,10 @@ class ContinuousBatchingScheduler:
         self._default_deadline = deadline_secs
         self._restart_budget = int(driver_restart_budget)
         self.restarts_used = 0
+        # flipped when a serve_forever driver dies PAST the restart budget
+        # (never by a requested shutdown/drain) — the fleet tier's
+        # eviction signal (deepspeed_tpu/serving/replica.py)
+        self.driver_failed = False
         self._degraded_ratio = float(degraded_queue_ratio)
         self._draining = False
         self._slots = [None] * self.num_slots
@@ -201,6 +229,38 @@ class ContinuousBatchingScheduler:
         self._draining = True
         self._update_health()
 
+    def load_snapshot(self):
+        """Cheap router-facing load/health view (host-side counters only —
+        no device sync, no locks beyond the queue's own): what a fleet
+        placement policy scores replicas by (docs/serving.md). Sampling
+        the queue here also refreshes the infer/queue_depth gauge, so an
+        IDLE replica reports a live value instead of whatever the last
+        drive-loop iteration left behind."""
+        depth = self._queue.qsize()
+        self._queue_depth.set(depth)
+        active = len(self.active_slots)
+        decode_n = self._token_latency_ms.count
+        return {
+            "queue_depth": depth,
+            "queue_capacity": self._queue.maxsize,
+            "active_slots": active,
+            "free_slots": self.num_slots - active,
+            "num_slots": self.num_slots,
+            "health": self._update_health(),
+            "mean_prefill_ms": (
+                self._prefill_ms.sum / self._prefill_ms.count
+                if self._prefill_ms.count else 0.0
+            ),
+            "mean_decode_ms": (
+                self._token_latency_ms.sum / decode_n if decode_n else 0.0
+            ),
+            "requests_shed": self._shed.value,
+            "restarts_used": self.restarts_used,
+            "driving": self.driving,
+            "stopped": self._stop.is_set(),
+            "driver_failed": self.driver_failed,
+        }
+
     # -- front door -----------------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens=32, temperature=None,
                eos_token_id=None, timeout=None, deadline_secs=None,
@@ -220,7 +280,9 @@ class ContinuousBatchingScheduler:
         step."""
         if self._stop.is_set():
             self._rejected.inc()
-            raise RequestRejected("scheduler is shut down")
+            raise RequestRejected(
+                "scheduler is shut down", reason=REJECT_DRAINING
+            )
         if deadline_secs is None:
             deadline_secs = self._default_deadline
         if deadline_secs is not None and float(deadline_secs) <= 0:
@@ -232,7 +294,8 @@ class ContinuousBatchingScheduler:
         if health == HEALTH_DRAINING:
             self._rejected.inc()
             raise RequestRejected(
-                "scheduler is draining; not admitting new requests"
+                "scheduler is draining; not admitting new requests",
+                reason=REJECT_DRAINING,
             )
         if health == HEALTH_DEGRADED and int(priority) > 0:
             self._shed.inc()
@@ -240,7 +303,8 @@ class ContinuousBatchingScheduler:
             raise RequestRejected(
                 f"degraded (queue {self._queue.qsize()}/"
                 f"{self._queue.maxsize}): shedding priority-{priority} "
-                "submission (priority 0 is never shed at this gate)"
+                "submission (priority 0 is never shed at this gate)",
+                reason=REJECT_OVERLOAD,
             )
         n = len(prompt_tokens)
         if n == 0:
@@ -284,7 +348,8 @@ class ContinuousBatchingScheduler:
             self._rejected.inc()
             raise RequestRejected(
                 f"request queue full ({self._queue.maxsize} waiting); "
-                f"rejected after {wait:.3f}s"
+                f"rejected after {wait:.3f}s",
+                reason=REJECT_OVERLOAD,
             ) from None
         if self._stop.is_set():
             # raced shutdown's outstanding-request drain: nobody will
@@ -292,7 +357,9 @@ class ContinuousBatchingScheduler:
             req.cancel()
             req._finish(_FINISH_CANCELLED)
             self._rejected.inc()
-            raise RequestRejected("scheduler is shut down")
+            raise RequestRejected(
+                "scheduler is shut down", reason=REJECT_DRAINING
+            )
         self._admitted.inc()
         self._queue_depth.set(self._queue.qsize())
         return req
@@ -550,6 +617,7 @@ class ContinuousBatchingScheduler:
                     "cancelling outstanding requests",
                     self.restarts_used, self._restart_budget,
                 )
+                self.driver_failed = True
                 self._stop.set()
                 self._draining = True
                 self._update_health()

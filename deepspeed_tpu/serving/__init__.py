@@ -1,0 +1,169 @@
+"""Multi-replica serving tier: a fleet router in front of N engines.
+
+The repo's fifth subsystem (docs/serving.md) — the DeepSpeed-Inference
+"serving at scale" act (PAPERS.md) on top of the Orca-style per-replica
+scheduler in deepspeed_tpu/inference/. Four layers:
+
+  admission.py — per-tenant token buckets + typed rejections
+                 (RateLimited / FleetOverloaded, machine-readable
+                 ``reason`` codes).
+  replica.py   — the uniform submit/health/drain/restart surface:
+                 InProcessReplica (N engines, one process) and
+                 SubprocessReplica (one engine per worker process,
+                 newline-JSON RPC over pipes).
+  worker.py    — the subprocess engine host
+                 (``python -m deepspeed_tpu.serving.worker``).
+  router.py    — FleetRouter: pluggable placement (least-loaded /
+                 round-robin / prefix-affinity), rolling restarts under
+                 a capacity floor, failed-replica eviction + re-route,
+                 fleet/* telemetry.
+
+``init_fleet`` is the config-driven front door, the fleet analog of
+``deepspeed_tpu.init_inference``.
+"""
+
+from ..config import constants as C
+from ..config.config import DeepSpeedConfig
+from .admission import (
+    AdmissionController,
+    FleetOverloaded,
+    RateLimited,
+    TokenBucket,
+)
+from .replica import InProcessReplica, RemoteRequest, SubprocessReplica
+from .router import (
+    PLACEMENT_POLICIES,
+    FleetRequest,
+    FleetRouter,
+    LeastLoaded,
+    PrefixAffinity,
+    RoundRobin,
+)
+
+_BATCH_KEYS = (
+    C.TRAIN_BATCH_SIZE,
+    C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+    C.GRADIENT_ACCUMULATION_STEPS,
+)
+
+
+def _resolve_config(config):
+    """dict / JSON path / DeepSpeedConfig -> validated DeepSpeedConfig,
+    with the training batch triangle anchored to an inert default (the
+    same serving-side contract init_inference applies)."""
+    if isinstance(config, DeepSpeedConfig):
+        return config
+    if config is None:
+        raw = {}
+    elif isinstance(config, dict):
+        raw = dict(config)
+    else:
+        from ..config.config_utils import load_config_json
+
+        raw = load_config_json(config)
+    if not any(k in raw for k in _BATCH_KEYS):
+        raw[C.TRAIN_BATCH_SIZE] = 1
+    return DeepSpeedConfig(None, param_dict=raw, world_size=1)
+
+
+def init_fleet(engine_factory=None, worker_spec=None, config=None,
+               registry=None, start=True):
+    """Build (and by default start) a :class:`FleetRouter` from the
+    config's ``"serving"`` block (docs/serving.md).
+
+    Exactly one replica source is required:
+
+    ``engine_factory``
+        zero-arg callable returning a fresh ``InferenceEngine`` — used
+        for the ``in_process`` backend, and called again on every replica
+        restart. Give the factory's engines a config WITHOUT a telemetry
+        block (fleet-level telemetry is the router's; per-replica state
+        surfaces through load snapshots).
+    ``worker_spec``
+        the worker.py init spec — used for the ``subprocess`` backend;
+        each replica spawns one worker process from it.
+
+    The router's fleet/* streams export through the config's
+    ``"telemetry"`` block when enabled (same sinks as the engines), or
+    live on a private registry otherwise.
+    """
+    cfg = _resolve_config(config)
+    if (engine_factory is None) == (worker_spec is None):
+        raise ValueError(
+            "pass exactly one of engine_factory (in_process backend) or "
+            "worker_spec (subprocess backend)"
+        )
+    backend = cfg.serving_backend
+    if engine_factory is not None and backend == "subprocess":
+        raise ValueError(
+            'serving.backend is "subprocess" but an engine_factory was '
+            "passed; provide worker_spec instead"
+        )
+    if worker_spec is not None and backend == "in_process":
+        raise ValueError(
+            'serving.backend is "in_process" but a worker_spec was '
+            "passed; provide engine_factory instead"
+        )
+
+    if engine_factory is not None:
+        replicas = [
+            InProcessReplica(str(i), engine_factory)
+            for i in range(cfg.serving_replicas)
+        ]
+    else:
+        replicas = [
+            SubprocessReplica(str(i), worker_spec)
+            for i in range(cfg.serving_replicas)
+        ]
+
+    telemetry = None
+    if registry is None:
+        import jax
+
+        from ..telemetry.manager import build_telemetry
+
+        telemetry = build_telemetry(cfg, rank=jax.process_index())
+        if telemetry.enabled:
+            registry = telemetry.registry
+        else:
+            telemetry = None
+
+    router = FleetRouter(
+        replicas,
+        placement=cfg.serving_placement,
+        affinity_prefix_tokens=cfg.serving_affinity_prefix_tokens,
+        capacity_floor=cfg.serving_capacity_floor,
+        shed_queue_ratio=cfg.serving_shed_queue_ratio,
+        max_reroutes=cfg.serving_max_reroutes,
+        rate_limit=(
+            cfg.serving_rate_limit_rps, cfg.serving_rate_limit_burst,
+        ),
+        per_tenant_limits=cfg.serving_rate_limit_per_tenant,
+        registry=registry,
+        telemetry=telemetry,
+    )
+    if start:
+        router.start()
+        if cfg.serving_drain_on_preemption:
+            router.install_preemption_drain(
+                signals=cfg.resilience_preemption_signals
+            )
+    return router
+
+
+__all__ = [
+    "AdmissionController",
+    "FleetOverloaded",
+    "FleetRequest",
+    "FleetRouter",
+    "InProcessReplica",
+    "LeastLoaded",
+    "PLACEMENT_POLICIES",
+    "PrefixAffinity",
+    "RateLimited",
+    "RemoteRequest",
+    "RoundRobin",
+    "SubprocessReplica",
+    "TokenBucket",
+    "init_fleet",
+]

@@ -1,0 +1,463 @@
+"""Replica: one serving engine behind a uniform lifecycle surface.
+
+The fleet router (router.py) never touches an ``InferenceEngine``
+directly — it speaks to :class:`InProcessReplica` /
+:class:`SubprocessReplica`, both exposing the same contract:
+
+    submit(prompt, **kw) -> request handle   (.done/.tokens/.finish_reason
+                                              /.first_token_at/.result())
+    load_snapshot() -> dict                  (the scheduler's router-facing
+                                              load/health view, plus
+                                              "alive"/"failed" flags)
+    drain() / wait_idle() / restart() / shutdown()
+
+``InProcessReplica`` wraps an engine built by a caller-supplied factory —
+N replicas in one process, zero-copy, sharing the host's devices.
+``SubprocessReplica`` runs one engine per worker process (worker.py)
+speaking newline-JSON RPC over stdin/stdout, so a replica that segfaults
+or OOMs cannot take the router (or its sibling replicas) down — the
+process exit IS the failure signal, and the router re-routes.
+
+Failure semantics: ``failed`` is True only when the replica died WITHOUT
+being asked (decode driver past its restart budget in-process; unexpected
+process exit for subprocess). A drained or shut-down replica is not
+routable but not failed — eviction is for corpses, not for lifecycle.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from ..inference.scheduler import (
+    REJECT_DRAINING,
+    REJECT_REASONS,
+    RequestRejected,
+)
+from ..utils.logging import logger
+
+_FINISH_ERROR = "error"
+
+
+class ReplicaBase:
+    """Shared lifecycle helpers; subclasses implement the transport."""
+
+    def __init__(self, replica_id):
+        self.replica_id = str(replica_id)
+
+    def wait_idle(self, timeout=30.0, poll=0.005):
+        """Block until the replica has nothing queued and nothing in a
+        slot (the drain barrier before a restart). Returns True when
+        idle; False on timeout or a replica that died while draining."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            snap = self.load_snapshot()
+            if snap.get("failed"):
+                return False
+            if not snap.get("alive"):
+                return True  # already stopped: nothing can be in flight
+            if snap["queue_depth"] == 0 and snap["active_slots"] == 0:
+                return True
+            time.sleep(poll)
+        return False
+
+
+class InProcessReplica(ReplicaBase):
+    """One engine in this process, rebuilt from ``engine_factory`` on
+    every (re)start — a restart is a fresh KV cache, fresh scheduler,
+    fresh driver thread over freshly-pinned params, exactly what a
+    process restart would give, minus the process."""
+
+    def __init__(self, replica_id, engine_factory):
+        super().__init__(replica_id)
+        self._factory = engine_factory
+        self.engine = None
+        self._shutdown_requested = False
+
+    def start(self):
+        if self.engine is not None:
+            return self
+        self._shutdown_requested = False
+        self.engine = self._factory()
+        self.engine.serve_forever()
+        return self
+
+    # -- serving --------------------------------------------------------
+    # every method captures self.engine ONCE: a concurrent restart()/
+    # shutdown() nulling the attribute between a check and a use must
+    # read as a rejection/dead snapshot, never an AttributeError leaking
+    # through the router's RequestRejected handling
+    def submit(self, prompt_tokens, **kwargs):
+        engine = self.engine
+        if engine is None:
+            raise RequestRejected(
+                f"replica {self.replica_id} is not running",
+                reason=REJECT_DRAINING,
+            )
+        return engine.submit(prompt_tokens, **kwargs)
+
+    def load_snapshot(self):
+        engine = self.engine
+        if engine is None:
+            return _dead_snapshot(failed=False)
+        snap = engine.load_snapshot()
+        snap["alive"] = not snap["stopped"]
+        snap["failed"] = bool(snap["driver_failed"])
+        return snap
+
+    # -- lifecycle ------------------------------------------------------
+    def drain(self):
+        engine = self.engine
+        if engine is not None:
+            engine.scheduler.drain()
+
+    def restart(self):
+        """Tear the engine down (outstanding requests fail-finish — the
+        router drains first on the graceful path) and rebuild it from the
+        factory."""
+        self.shutdown()
+        return self.start()
+
+    def shutdown(self):
+        engine = self.engine
+        if engine is not None:
+            self._shutdown_requested = True
+            self.engine = None
+            engine.close()
+
+    @property
+    def alive(self):
+        engine = self.engine
+        return (
+            engine is not None
+            and not engine.scheduler._stop.is_set()
+        )
+
+    @property
+    def failed(self):
+        engine = self.engine
+        return engine is not None and engine.scheduler.driver_failed
+
+
+# ---------------------------------------------------------------------------
+# subprocess backend: newline-JSON RPC over the worker's stdin/stdout
+# ---------------------------------------------------------------------------
+class RemoteRequest:
+    """Parent-side handle mirroring InferenceRequest's result surface for
+    a request running inside a worker process. Completed by the replica's
+    reader thread when the worker reports ``finished``."""
+
+    def __init__(self, rpc_id, prompt_tokens, max_new_tokens):
+        self.rpc_id = rpc_id
+        self.prompt_tokens = list(prompt_tokens)
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens = []
+        self.finish_reason = None
+        self.first_token_at = None
+        self._done = threading.Event()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"remote request {self.rpc_id} not finished after {timeout}s"
+            )
+        return self.tokens
+
+    def _finish(self, tokens, reason):
+        self.tokens = list(tokens)
+        self.finish_reason = reason
+        self._done.set()
+
+
+class SubprocessReplica(ReplicaBase):
+    """One engine per worker process (serving/worker.py), talked to over
+    newline-JSON on the worker's stdin/stdout (stderr passes through for
+    logs). ``worker_spec`` is the JSON the worker builds its model and
+    engine from — see worker.py's module docstring for the schema."""
+
+    def __init__(self, replica_id, worker_spec, *, python=None,
+                 start_timeout=120.0, rpc_timeout=10.0):
+        super().__init__(replica_id)
+        self.worker_spec = dict(worker_spec)
+        self._python = python or sys.executable
+        self._start_timeout = float(start_timeout)
+        self._rpc_timeout = float(rpc_timeout)
+        self._proc = None
+        self._reader = None
+        self._write_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._rpc_ids = iter(range(1, 1 << 62)).__next__
+        self._outstanding = {}   # rpc_id -> RemoteRequest
+        self._replies = {}       # rpc_id -> reply payload
+        self._expected = set()   # rpc_ids with a live reply waiter
+        self._reply_cond = threading.Condition()
+        self._ready = threading.Event()
+        self._shutdown_requested = False
+
+    def start(self):
+        if self._proc is not None and self._proc.poll() is None:
+            return self
+        self._shutdown_requested = False
+        self._ready.clear()
+        # stale RPC state from a previous incarnation must not leak into
+        # (or slowly grow across) restarts
+        with self._reply_cond:
+            self._replies.clear()
+            self._expected.clear()
+        with self._state_lock:
+            self._outstanding.clear()
+        # the worker inherits the parent's environment verbatim: forcing
+        # a platform here would silently downgrade accelerator fleets
+        # (tests/bench export JAX_PLATFORMS=cpu themselves)
+        self._proc = subprocess.Popen(
+            [self._python, "-m", "deepspeed_tpu.serving.worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=None,
+            text=True, env=dict(os.environ),
+        )
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(self._proc,),
+            name=f"ds-replica-{self.replica_id}-reader", daemon=True,
+        )
+        self._reader.start()
+        self._send({"op": "init", "spec": self.worker_spec})
+        if not self._ready.wait(self._start_timeout):
+            self.shutdown()
+            raise RuntimeError(
+                f"replica {self.replica_id} worker did not become ready "
+                f"within {self._start_timeout}s"
+            )
+        return self
+
+    # -- transport ------------------------------------------------------
+    def _send(self, msg):
+        proc = self._proc
+        if proc is None or proc.poll() is not None:
+            raise RequestRejected(
+                f"replica {self.replica_id} worker process is not running",
+                reason=REJECT_DRAINING,
+            )
+        line = json.dumps(msg)
+        with self._write_lock:
+            try:
+                proc.stdin.write(line + "\n")
+                proc.stdin.flush()
+            except (BrokenPipeError, OSError, ValueError):
+                raise RequestRejected(
+                    f"replica {self.replica_id} worker pipe is closed",
+                    reason=REJECT_DRAINING,
+                ) from None
+
+    def _read_loop(self, proc):
+        for line in proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                logger.warning(
+                    "replica %s: undecodable worker line %r",
+                    self.replica_id, line[:200],
+                )
+                continue
+            self._dispatch(msg)
+        # EOF: the worker is gone — fail everything still outstanding so
+        # the router's monitor re-routes instead of waiting forever
+        with self._state_lock:
+            orphans = list(self._outstanding.values())
+            self._outstanding.clear()
+        for req in orphans:
+            req._finish(req.tokens, _FINISH_ERROR)
+        with self._reply_cond:
+            self._reply_cond.notify_all()
+
+    def _dispatch(self, msg):
+        event = msg.get("event")
+        if event == "ready":
+            self._ready.set()
+        elif event == "reply":
+            with self._reply_cond:
+                # drop replies nobody waits for anymore (the caller timed
+                # out): storing them would grow _replies forever against
+                # a periodically-slow worker
+                if msg["id"] in self._expected:
+                    self._replies[msg["id"]] = msg
+                    self._reply_cond.notify_all()
+        elif event == "first_token":
+            with self._state_lock:
+                req = self._outstanding.get(msg["id"])
+            if req is not None and req.first_token_at is None:
+                req.first_token_at = time.monotonic()
+        elif event == "finished":
+            with self._state_lock:
+                req = self._outstanding.pop(msg["id"], None)
+            if req is not None:
+                if req.first_token_at is None and msg.get("tokens"):
+                    req.first_token_at = time.monotonic()
+                req._finish(msg.get("tokens", []), msg.get("reason"))
+        else:
+            logger.warning(
+                "replica %s: unknown worker event %r",
+                self.replica_id, event,
+            )
+
+    def _await_reply(self, rpc_id, timeout, make_exc):
+        """Wait for ``rpc_id``'s reply; raises ``make_exc()`` on timeout
+        or worker death. The waiter registers in ``_expected`` around the
+        wait so a reply landing AFTER the timeout is dropped by the
+        reader instead of leaking in ``_replies`` forever."""
+        deadline = time.monotonic() + timeout
+        with self._reply_cond:
+            try:
+                while rpc_id not in self._replies:
+                    remaining = deadline - time.monotonic()
+                    proc = self._proc
+                    if (
+                        remaining <= 0
+                        or proc is None
+                        or proc.poll() is not None
+                    ):
+                        raise make_exc()
+                    self._reply_cond.wait(min(remaining, 0.1))
+                return self._replies.pop(rpc_id)
+            finally:
+                self._expected.discard(rpc_id)
+                self._replies.pop(rpc_id, None)
+
+    def _call(self, msg, timeout=None):
+        """Send an op expecting a ``reply`` event; returns the reply."""
+        rpc_id = self._rpc_ids()
+        msg = dict(msg, id=rpc_id)
+        with self._reply_cond:
+            self._expected.add(rpc_id)
+        try:
+            self._send(msg)
+        except Exception:
+            with self._reply_cond:
+                self._expected.discard(rpc_id)
+            raise
+        return self._await_reply(
+            rpc_id,
+            self._rpc_timeout if timeout is None else timeout,
+            lambda: TimeoutError(
+                f"replica {self.replica_id}: no reply to {msg.get('op')!r}"
+            ),
+        )
+
+    # -- serving --------------------------------------------------------
+    def submit(self, prompt_tokens, max_new_tokens=32, **kwargs):
+        rpc_id = self._rpc_ids()
+        req = RemoteRequest(rpc_id, prompt_tokens, max_new_tokens)
+        with self._state_lock:
+            self._outstanding[rpc_id] = req
+        with self._reply_cond:
+            self._expected.add(rpc_id)
+        try:
+            self._send({
+                "op": "submit", "id": rpc_id,
+                "prompt": [int(t) for t in prompt_tokens],
+                "max_new_tokens": int(max_new_tokens),
+                "kwargs": kwargs,
+            })
+            reply = self._await_reply(
+                rpc_id, self._rpc_timeout,
+                lambda: RequestRejected(
+                    f"replica {self.replica_id}: worker did not "
+                    f"acknowledge the submission",
+                    reason=REJECT_DRAINING,
+                ),
+            )
+        except Exception:
+            with self._state_lock:
+                self._outstanding.pop(rpc_id, None)
+            with self._reply_cond:
+                self._expected.discard(rpc_id)
+            raise
+        if reply.get("error"):
+            with self._state_lock:
+                self._outstanding.pop(rpc_id, None)
+            reason = reply.get("reason")
+            if reason in REJECT_REASONS:
+                raise RequestRejected(reply["error"], reason=reason)
+            raise ValueError(reply["error"])
+        return req
+
+    def load_snapshot(self):
+        if self._proc is None or self._proc.poll() is not None:
+            return _dead_snapshot(failed=not self._shutdown_requested)
+        try:
+            reply = self._call({"op": "snapshot"})
+        except (TimeoutError, RequestRejected):
+            # RequestRejected = the pipe died between the poll() check
+            # and the write; callers treat load_snapshot as
+            # non-throwing — a dead replica IS a dead snapshot
+            return _dead_snapshot(failed=not self._shutdown_requested)
+        snap = reply["snapshot"]
+        snap.setdefault("alive", not snap.get("stopped", False))
+        snap.setdefault("failed", bool(snap.get("driver_failed")))
+        return snap
+
+    # -- lifecycle ------------------------------------------------------
+    def drain(self):
+        try:
+            self._send({"op": "drain"})
+        except RequestRejected:
+            pass  # already gone: drained by definition
+
+    def restart(self):
+        self.shutdown()
+        return self.start()
+
+    def shutdown(self, grace=10.0):
+        proc = self._proc
+        if proc is None:
+            return
+        self._shutdown_requested = True
+        try:
+            self._send({"op": "shutdown"})
+        except RequestRejected:
+            pass
+        try:
+            proc.wait(grace)
+        except subprocess.TimeoutExpired:
+            logger.warning(
+                "replica %s worker ignored shutdown; killing pid %d",
+                self.replica_id, proc.pid,
+            )
+            proc.kill()
+            proc.wait(grace)
+        if self._reader is not None:
+            self._reader.join(grace)
+            self._reader = None
+        self._proc = None
+
+    @property
+    def alive(self):
+        return self._proc is not None and self._proc.poll() is None
+
+    @property
+    def failed(self):
+        return (
+            self._proc is not None
+            and self._proc.poll() is not None
+            and not self._shutdown_requested
+        )
+
+
+def _dead_snapshot(failed):
+    """The snapshot shape load-scoring code expects, for a replica with
+    no live engine behind it."""
+    return {
+        "queue_depth": 0, "queue_capacity": 0, "active_slots": 0,
+        "free_slots": 0, "num_slots": 0, "health": 2,
+        "mean_prefill_ms": 0.0, "mean_decode_ms": 0.0,
+        "requests_shed": 0.0, "restarts_used": 0,
+        "driving": False, "stopped": True, "driver_failed": failed,
+        "alive": False, "failed": failed,
+    }

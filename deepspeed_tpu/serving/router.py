@@ -1,0 +1,739 @@
+"""FleetRouter: placement, admission, and lifecycle over N replicas.
+
+The serving tier's brain (docs/serving.md). A submission passes three
+gates, in order, before any replica queue is touched:
+
+  1. admission  — per-tenant token bucket (admission.py): RateLimited.
+  2. pressure   — fleet-wide queue fill past ``shed_queue_ratio`` sheds
+                  priority > 0 classes: FleetOverloaded.
+  3. placement  — a pluggable policy scores the routable replicas' load
+                  snapshots and picks one; a replica that rejects at its
+                  own door (queue full, raced a drain) is dropped from
+                  the candidate set and placement retries the rest.
+
+Placement policies (PLACEMENT_POLICIES): ``least_loaded`` scores
+``queue_depth + active_slots`` (deterministic: ties break toward the
+lower replica index), ``round_robin`` ignores load, and
+``prefix_affinity`` hashes the prompt's first K tokens and sticks to the
+replica that last served that prefix — the seam a cross-request prefix
+cache (ROADMAP item 1) plugs into: affinity makes the cached prefill HOT
+on exactly one replica instead of cold on all of them.
+
+Lifecycle: ``drain`` steers traffic away while in-flight slots finish;
+``rolling_restart`` drains and restarts replicas ONE at a time, refusing
+to start if taking one replica out would drop routable capacity below
+``ceil(capacity_floor * fleet)``; a replica whose decode driver fails
+past its restart budget is EVICTED by the monitor and every request that
+died with it is re-routed (bounded by ``max_reroutes``) — the fleet
+answer for a request is delivered exactly once or failed loudly, never
+duplicated and never silently dropped.
+
+A background monitor thread (one per router) watches outstanding
+requests, detects replica corpses, performs re-routes, and refreshes the
+fleet/* telemetry streams through the same registry/exporter machinery
+the engines use.
+"""
+
+import itertools
+import math
+import threading
+import time
+
+from ..inference.scheduler import (
+    REJECT_DEADLINE,
+    REJECT_DRAINING,
+    RequestRejected,
+)
+from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS
+from ..utils.logging import logger
+from .admission import AdmissionController, FleetOverloaded, RateLimited  # noqa: F401  (re-exported)
+
+_FINISH_ERROR = "error"
+_FINISH_CANCELLED = "cancelled"
+# inner finish reasons that are a terminal ANSWER for the fleet request
+# (everything else means "the replica died under it" and is re-routable)
+_TERMINAL_REASONS = ("eos", "max_new_tokens", "length", "deadline")
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+def _load_score(snapshot):
+    """Queue depth + busy slots: the cheapest proxy for 'how long until
+    this replica gets to a new request'."""
+    return snapshot["queue_depth"] + snapshot["active_slots"]
+
+
+class LeastLoaded:
+    """Deterministic least-loaded: min load score, ties to the earliest
+    candidate (registration order) — the property the placement tests
+    pin."""
+
+    name = "least_loaded"
+
+    def choose(self, candidates, prompt_tokens):
+        del prompt_tokens
+        best_i = min(
+            range(len(candidates)),
+            key=lambda i: (_load_score(candidates[i][1]), i),
+        )
+        return candidates[best_i][0]
+
+    def forget(self, replica_id):
+        pass
+
+
+class RoundRobin:
+    """Load-blind rotation over the candidate list."""
+
+    name = "round_robin"
+
+    def __init__(self):
+        self._turn = itertools.count()
+
+    def choose(self, candidates, prompt_tokens):
+        del prompt_tokens
+        return candidates[next(self._turn) % len(candidates)][0]
+
+    def forget(self, replica_id):
+        pass
+
+
+class PrefixAffinity:
+    """Prompt-prefix-hash affinity over a least-loaded base: identical
+    templated prefixes (system prompts, few-shot headers) land on the
+    replica that already served them. ``last_hit`` reports whether the
+    most recent choice was an affinity hit (the router's counter reads
+    it). The affinity map is an LRU bounded at ``max_entries`` —
+    high-cardinality traffic must not grow router memory without bound,
+    and affinity only pays off for recently-hot prefixes anyway."""
+
+    name = "prefix_affinity"
+
+    def __init__(self, prefix_tokens=16, base=None, max_entries=65536):
+        import collections
+
+        self.prefix_tokens = int(prefix_tokens)
+        self.max_entries = int(max_entries)
+        self._base = base or LeastLoaded()
+        self._affinity = collections.OrderedDict()
+        self.last_hit = False
+
+    def _key(self, prompt_tokens):
+        return hash(tuple(prompt_tokens[: self.prefix_tokens]))
+
+    def choose(self, candidates, prompt_tokens):
+        key = self._key(prompt_tokens)
+        sticky = self._affinity.get(key)
+        for rid, _snap in candidates:
+            if rid == sticky:
+                self._affinity.move_to_end(key)
+                self.last_hit = True
+                return rid
+        self.last_hit = False
+        rid = self._base.choose(candidates, prompt_tokens)
+        self._affinity[key] = rid
+        self._affinity.move_to_end(key)
+        while len(self._affinity) > self.max_entries:
+            self._affinity.popitem(last=False)
+        return rid
+
+    def forget(self, replica_id):
+        """Drop affinity entries for an evicted/departed replica so its
+        traffic re-pins to a live one instead of falling back forever."""
+        for key in [
+            k for k, v in self._affinity.items() if v == replica_id
+        ]:
+            del self._affinity[key]
+
+
+PLACEMENT_POLICIES = {
+    "least_loaded": lambda cfg: LeastLoaded(),
+    "round_robin": lambda cfg: RoundRobin(),
+    "prefix_affinity": lambda cfg: PrefixAffinity(
+        prefix_tokens=cfg.get("affinity_prefix_tokens", 16)
+    ),
+}
+
+
+def _histogram_quantile(hist, q):
+    """Linear-interpolated quantile from a fixed-bucket histogram (the
+    Prometheus histogram_quantile estimate). 0.0 with no observations."""
+    counts = hist.bucket_counts
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cumulative = 0
+    lower = 0.0
+    for i, upper in enumerate(hist.thresholds):
+        prev = cumulative
+        cumulative += counts[i]
+        if cumulative >= rank:
+            frac = (rank - prev) / max(counts[i], 1)
+            return lower + (upper - lower) * frac
+        lower = upper
+    return hist.thresholds[-1]  # +Inf bucket: clamp to the last edge
+
+
+# ---------------------------------------------------------------------------
+# fleet request
+# ---------------------------------------------------------------------------
+class FleetRequest:
+    """The router-side handle a fleet caller holds. Unlike an engine's
+    InferenceRequest it can survive its replica: on a replica failure the
+    router re-places the prompt (fresh decode — partial tokens from the
+    dead replica are discarded, so the delivered answer is always one
+    replica's complete generation)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_tokens, tenant, kwargs):
+        self.request_id = next(self._ids)
+        self.prompt_tokens = [int(t) for t in prompt_tokens]
+        self.tenant = tenant
+        self.kwargs = dict(kwargs)
+        self.tokens = []
+        self.finish_reason = None
+        self.replica_id = None
+        self.reroutes = 0
+        self.submitted_at = time.monotonic()
+        # absolute end-to-end deadline: re-routes charge the time already
+        # spent instead of restarting the clock on the new replica
+        deadline_secs = self.kwargs.get("deadline_secs")
+        self.deadline_at = (
+            self.submitted_at + float(deadline_secs)
+            if deadline_secs is not None else None
+        )
+        self._done = threading.Event()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Block for the fleet answer. Raises RuntimeError when the fleet
+        could not finish the request (its replicas died past the re-route
+        budget, or the router shut down) — partial tokens never
+        masquerade as an answer. A "deadline" finish returns the partial
+        tokens, same contract as the single-engine path."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"fleet request {self.request_id} not finished after "
+                f"{timeout}s"
+            )
+        if self.finish_reason in (_FINISH_ERROR, _FINISH_CANCELLED):
+            raise RuntimeError(
+                f"fleet request {self.request_id} {self.finish_reason} "
+                f"after {self.reroutes} re-route(s)"
+            )
+        return self.tokens
+
+    def _finish(self, tokens, reason):
+        self.tokens = list(tokens)
+        self.finish_reason = reason
+        self._done.set()
+
+
+# ---------------------------------------------------------------------------
+# router
+# ---------------------------------------------------------------------------
+class FleetRouter:
+    """Routes submissions over ``replicas`` (a list of Replica objects,
+    replica.py). Construct directly for programmatic fleets or through
+    :func:`deepspeed_tpu.serving.init_fleet` for config-driven ones."""
+
+    def __init__(self, replicas, *, placement="least_loaded",
+                 affinity_prefix_tokens=16, capacity_floor=0.5,
+                 shed_queue_ratio=0.75, max_reroutes=2,
+                 rate_limit=(None, 1), per_tenant_limits=None,
+                 registry=None, telemetry=None, clock=time.monotonic,
+                 monitor_interval=0.002, telemetry_refresh_secs=0.25):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        from ..telemetry.manager import register_serving_metrics
+        from ..telemetry.registry import MetricsRegistry
+
+        self._replicas = {r.replica_id: r for r in replicas}
+        if len(self._replicas) != len(replicas):
+            raise ValueError("replica ids must be unique")
+        self._order = [r.replica_id for r in replicas]
+        self._routable = set()
+        self._evicted = set()
+        self._outstanding = {}  # request_id -> (FleetRequest, inner, rid)
+        self._lock = threading.RLock()
+        self._clock = clock
+        self.capacity_floor = float(capacity_floor)
+        self.shed_queue_ratio = float(shed_queue_ratio)
+        self.max_reroutes = int(max_reroutes)
+        if isinstance(placement, str):
+            if placement not in PLACEMENT_POLICIES:
+                raise ValueError(
+                    f"unknown placement policy {placement!r}; valid: "
+                    f"{sorted(PLACEMENT_POLICIES)}"
+                )
+            placement = PLACEMENT_POLICIES[placement](
+                {"affinity_prefix_tokens": affinity_prefix_tokens}
+            )
+        self.placement = placement
+        # serializes placement-state access: choose() + the last_hit read
+        # in _place (concurrent submit threads), and forget() from the
+        # monitor's eviction sweep — policies keep mutable affinity maps
+        self._placement_lock = threading.Lock()
+        self._admission = AdmissionController(
+            default_limit=tuple(rate_limit),
+            per_tenant=per_tenant_limits, clock=clock,
+        )
+        self.routed_counts = {rid: 0 for rid in self._order}
+        self._draining = False
+        self._stop = threading.Event()
+        self._monitor = None
+        self._monitor_interval = float(monitor_interval)
+        self._telemetry = telemetry
+        self._telemetry_refresh_secs = float(telemetry_refresh_secs)
+        self._last_refresh = 0.0
+        self._refreshes = 0
+        # refreshes run from the monitor thread AND lifecycle/test
+        # callers; the exporters' atomic tmp+rename writes must not race
+        self._refresh_lock = threading.Lock()
+        self._preemption = None
+
+        self.metrics = register_serving_metrics(
+            registry if registry is not None else MetricsRegistry()
+        )
+        reg = self.metrics
+        self._ttft = reg.histogram(
+            "fleet/ttft_ms", buckets=DEFAULT_TIME_BUCKETS_MS
+        )
+        self._ttft_p50 = reg.gauge("fleet/ttft_p50_ms")
+        self._ttft_p99 = reg.gauge("fleet/ttft_p99_ms")
+        self._routed = reg.counter("fleet/requests_routed")
+        self._rerouted = reg.counter("fleet/requests_rerouted")
+        self._completed = reg.counter("fleet/requests_completed")
+        self._rate_limited = reg.counter("fleet/requests_rate_limited")
+        self._rejected = reg.counter("fleet/requests_rejected")
+        self._affinity_hits = reg.counter("fleet/affinity_hits")
+        self._restarts = reg.counter("fleet/replica_restarts")
+        self._evictions = reg.counter("fleet/replicas_evicted")
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        """Start every replica (engines build, drivers spin up) and the
+        monitor thread; returns self."""
+        for rid in self._order:
+            self._replicas[rid].start()
+        with self._lock:
+            self._routable.update(self._order)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="ds-fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+        self.refresh_telemetry()
+        return self
+
+    def shutdown(self, timeout=30.0):
+        """Stop the monitor, shut every replica down, and fail-finish
+        outstanding fleet requests — a waiter never hangs on a dead
+        fleet."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout)
+            self._monitor = None
+        for rid in self._order:
+            if rid not in self._evicted:
+                self._replicas[rid].shutdown()
+        with self._lock:
+            orphans = [fr for fr, _inner, _rid in self._outstanding.values()]
+            self._outstanding.clear()
+        for fr in orphans:
+            fr._finish(fr.tokens, _FINISH_CANCELLED)
+        if self._preemption is not None:
+            self._preemption.uninstall()
+            self._preemption = None
+        self.refresh_telemetry()
+        if self._telemetry is not None and self._telemetry.enabled:
+            self._telemetry.export(step=self._refreshes)
+            self._telemetry.close()
+
+    def install_preemption_drain(self, signals=("SIGTERM", "SIGINT")):
+        """Reuse the resilience PreemptionHandler (resilience/preemption.py)
+        as the fleet's drain trigger: the signal ARMS a flag, the monitor
+        thread notices at its next tick and drains the whole fleet —
+        in-flight requests finish, new submissions shed with reason
+        "draining" — instead of dying mid-decode. Returns the handler
+        (cooperative ``arm()`` works when handlers cannot install)."""
+        from ..resilience.preemption import PreemptionHandler
+
+        self._preemption = PreemptionHandler(
+            signals=signals, exit_after_save=False
+        )
+        self._preemption.install()
+        return self._preemption
+
+    def drain_fleet(self):
+        """Stop admitting fleet-wide; every replica finishes what it
+        holds (the graceful ramp before shutdown())."""
+        self._draining = True
+        for rid in list(self._routable_ids()):
+            self.drain(rid)
+
+    def drain(self, replica_id):
+        """Steer new traffic away from ``replica_id`` and let its queued
+        and in-flight requests run to completion. One-way: a drained
+        replica rejoins service through :meth:`restart_replica`."""
+        replica = self._replicas[replica_id]
+        with self._lock:
+            self._routable.discard(replica_id)
+        replica.drain()
+
+    def restart_replica(self, replica_id, wait_timeout=60.0):
+        """Drain ``replica_id``, wait for it to go idle, rebuild it, and
+        return it to the routable set."""
+        replica = self._replicas[replica_id]
+        self.drain(replica_id)
+        if not replica.wait_idle(wait_timeout):
+            logger.warning(
+                "fleet: replica %s did not drain within %.1fs; restarting "
+                "anyway (outstanding requests will re-route)",
+                replica_id, wait_timeout,
+            )
+        replica.restart()
+        self._restarts.inc()
+        with self._lock:
+            self._evicted.discard(replica_id)
+            self._routable.add(replica_id)
+        self.refresh_telemetry()
+
+    def rolling_restart(self, wait_timeout=60.0):
+        """Drain + restart every live replica, ONE at a time, never
+        letting routable capacity drop below ``ceil(capacity_floor *
+        fleet_size)``. Raises RuntimeError up front when the floor makes
+        a rolling restart impossible (the config error should surface
+        loudly, not as a fleet that silently skipped its restart)."""
+        ids = [rid for rid in self._order if rid not in self._evicted]
+        floor = math.ceil(self.capacity_floor * len(ids))
+        if len(ids) - 1 < floor:
+            raise RuntimeError(
+                f"rolling restart impossible: {len(ids)} replicas with a "
+                f"capacity floor of {floor} leaves no replica free to "
+                f"drain (lower serving.capacity_floor or add replicas)"
+            )
+        for rid in ids:
+            while len(self._routable_ids()) - 1 < floor:
+                # another drain (operator, preemption) is holding capacity
+                # down — wait for it rather than breach the floor; a
+                # fleet-wide drain empties _routable permanently, so bail
+                # out instead of spinning forever
+                if self._stop.is_set() or self._draining:
+                    return
+                time.sleep(self._monitor_interval)
+            if self._stop.is_set() or self._draining:
+                return
+            self.restart_replica(rid, wait_timeout=wait_timeout)
+        self.refresh_telemetry()
+
+    # -- submission -----------------------------------------------------
+    def submit(self, prompt_tokens, tenant="default", priority=0, **kwargs):
+        """Admit + place one request; returns a :class:`FleetRequest`.
+
+        Raises :class:`RateLimited` (tenant bucket empty),
+        :class:`FleetOverloaded` (no replica can take it / pressure shed
+        of priority > 0), or :class:`RequestRejected` with reason
+        ``"draining"`` (fleet draining or shut down) or ``"deadline"``
+        (the request's ``deadline_secs`` is shorter than even the
+        fastest candidate's observed prefill — no replica could answer
+        in time, so it is rejected at the ROUTER's door instead of
+        burning a replica queue slot on a guaranteed miss). ``kwargs``
+        pass through to the replica scheduler's submit (max_new_tokens,
+        temperature, deadline_secs, ...)."""
+        if self._stop.is_set() or self._draining:
+            self._rejected.inc()
+            raise RequestRejected(
+                "fleet is draining; not admitting new requests",
+                reason=REJECT_DRAINING,
+            )
+        try:
+            self._admission.admit(tenant)
+        except RateLimited:
+            self._rate_limited.inc()
+            self._rejected.inc()
+            raise
+        fleet_req = FleetRequest(prompt_tokens, tenant, kwargs)
+        fleet_req.kwargs.setdefault("priority", priority)
+        candidates = self._candidates()
+        if not candidates:
+            self._rejected.inc()
+            raise FleetOverloaded(
+                "no routable replica (all draining, restarting, or "
+                "evicted)"
+            )
+        deadline = kwargs.get("deadline_secs")
+        if deadline is not None and float(deadline) > 0:
+            fastest = min(s["mean_prefill_ms"] for _rid, s in candidates)
+            if fastest > 0 and float(deadline) * 1e3 <= fastest:
+                self._rejected.inc()
+                raise RequestRejected(
+                    f"deadline {float(deadline) * 1e3:.0f}ms is below the "
+                    f"fastest candidate's observed prefill "
+                    f"({fastest:.0f}ms): unmeetable fleet-wide",
+                    reason=REJECT_DEADLINE,
+                )
+        if priority > 0:
+            fill = sum(s["queue_depth"] for _rid, s in candidates)
+            cap = sum(s["queue_capacity"] for _rid, s in candidates)
+            if cap > 0 and fill >= self.shed_queue_ratio * cap:
+                self._rejected.inc()
+                raise FleetOverloaded(
+                    f"fleet queue fill {fill}/{cap} past the shed ratio "
+                    f"{self.shed_queue_ratio}: shedding priority-"
+                    f"{priority} submission"
+                )
+        inner, rid = self._place(fleet_req, candidates)
+        if inner is None:
+            self._rejected.inc()
+            raise FleetOverloaded(
+                "every routable replica rejected the request at its own "
+                "door (queues full)"
+            )
+        with self._lock:
+            self._outstanding[fleet_req.request_id] = (fleet_req, inner, rid)
+        if self._stop.is_set():
+            # raced shutdown's outstanding sweep: the monitor is gone and
+            # nobody will ever sweep this entry — fail it NOW so result()
+            # cannot hang on a dead fleet (same contract as the
+            # scheduler's own raced-shutdown path)
+            with self._lock:
+                self._outstanding.pop(fleet_req.request_id, None)
+            fleet_req._finish(fleet_req.tokens, _FINISH_CANCELLED)
+            self._rejected.inc()
+            raise RequestRejected(
+                "fleet is draining; not admitting new requests",
+                reason=REJECT_DRAINING,
+            )
+        self._routed.inc()
+        return fleet_req
+
+    def _candidates(self):
+        """(replica_id, snapshot) pairs for the currently routable,
+        healthy-or-degraded replicas, in registration order (placement
+        determinism depends on stable ordering)."""
+        routable = self._routable_ids()
+        out = []
+        for rid in self._order:
+            if rid not in routable:
+                continue
+            snap = self._replicas[rid].load_snapshot()
+            if snap.get("failed") or not snap.get("alive"):
+                continue
+            out.append((rid, snap))
+        return out
+
+    def _routable_ids(self):
+        with self._lock:
+            return set(self._routable)
+
+    def _place(self, fleet_req, candidates):
+        """Run placement over ``candidates``, falling through replicas
+        that reject at their own door. Returns (inner_handle, replica_id)
+        or (None, None)."""
+        candidates = list(candidates)
+        while candidates:
+            with self._placement_lock:
+                rid = self.placement.choose(
+                    candidates, fleet_req.prompt_tokens
+                )
+                was_hit = getattr(self.placement, "last_hit", False)
+            try:
+                inner = self._replicas[rid].submit(
+                    fleet_req.prompt_tokens, **fleet_req.kwargs
+                )
+            except RequestRejected:
+                candidates = [c for c in candidates if c[0] != rid]
+                continue
+            if was_hit:
+                # counted only on a PLACED hit: a sticky replica that
+                # rejected at its door and fell through to another one
+                # must not inflate the affinity-effectiveness metric
+                self._affinity_hits.inc()
+            fleet_req.replica_id = rid
+            with self._lock:
+                self.routed_counts[rid] = self.routed_counts.get(rid, 0) + 1
+            return inner, rid
+        return None, None
+
+    # -- monitor --------------------------------------------------------
+    def _monitor_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception:
+                logger.exception("fleet monitor tick failed")
+            self._stop.wait(self._monitor_interval)
+
+    def _tick(self):
+        if (
+            self._preemption is not None
+            and self._preemption.armed
+            and not self._draining
+        ):
+            logger.warning(
+                "fleet: preemption signal received — draining all replicas"
+            )
+            self.drain_fleet()
+        self._sweep_failed_replicas()
+        self._sweep_outstanding()
+        now = self._clock()
+        if now - self._last_refresh >= self._telemetry_refresh_secs:
+            self.refresh_telemetry()
+
+    def _sweep_failed_replicas(self):
+        for rid in self._order:
+            if rid in self._evicted:
+                continue
+            replica = self._replicas[rid]
+            if replica.failed:
+                logger.warning(
+                    "fleet: evicting replica %s (decode driver dead past "
+                    "its restart budget); re-routing its requests", rid,
+                )
+                with self._lock:
+                    self._routable.discard(rid)
+                    self._evicted.add(rid)
+                self._evictions.inc()
+                with self._placement_lock:
+                    self.placement.forget(rid)
+                # reap the corpse: in-process this fail-finishes anything
+                # still parked on its queue (the monitor re-routes those
+                # on the next sweep); subprocess it just waits the pid
+                replica.shutdown()
+
+    def _sweep_outstanding(self):
+        with self._lock:
+            entries = list(self._outstanding.items())
+        for req_id, (fleet_req, inner, rid) in entries:
+            if not inner.done:
+                continue
+            if inner.finish_reason in _TERMINAL_REASONS:
+                with self._lock:
+                    self._outstanding.pop(req_id, None)
+                first = getattr(inner, "first_token_at", None)
+                if first is not None:
+                    # no first token (e.g. a deadline finish with zero
+                    # tokens) = no TTFT sample; a sweep-time anchor would
+                    # poison the fleet p50/p99 with fake latencies
+                    self._ttft.observe(
+                        max(first - fleet_req.submitted_at, 0.0) * 1e3
+                    )
+                self._completed.inc()
+                fleet_req._finish(inner.tokens, inner.finish_reason)
+            else:
+                # "error"/"cancelled": the replica died under it (crash
+                # past restart budget, eviction, worker exit) — re-place
+                # on a live replica, or fail the fleet request loudly
+                self._reroute(req_id, fleet_req)
+
+    def _reroute(self, req_id, fleet_req):
+        if fleet_req.reroutes >= self.max_reroutes:
+            with self._lock:
+                self._outstanding.pop(req_id, None)
+            fleet_req._finish(fleet_req.tokens, _FINISH_ERROR)
+            return
+        if fleet_req.deadline_at is not None:
+            remaining = fleet_req.deadline_at - time.monotonic()
+            if remaining <= 0:
+                # the end-to-end deadline expired while its replica was
+                # dying: a "deadline" finish (the caller's contract), not
+                # a fresh full-budget generation somewhere else
+                with self._lock:
+                    self._outstanding.pop(req_id, None)
+                fleet_req._finish(fleet_req.tokens, "deadline")
+                return
+            fleet_req.kwargs["deadline_secs"] = remaining
+        candidates = self._candidates()
+        if not candidates:
+            with self._lock:
+                fleet_dead = len(self._evicted) >= len(self._order)
+            if self._stop.is_set() or self._draining or fleet_dead:
+                with self._lock:
+                    self._outstanding.pop(req_id, None)
+                fleet_req._finish(fleet_req.tokens, _FINISH_ERROR)
+            return  # nothing routable right now; retry next tick
+        fleet_req.reroutes += 1
+        inner, rid = self._place(fleet_req, candidates)
+        if inner is None:
+            return  # burned one attempt; retry next tick
+        logger.warning(
+            "fleet: re-routed request %d to replica %s (attempt %d/%d)",
+            fleet_req.request_id, rid, fleet_req.reroutes,
+            self.max_reroutes,
+        )
+        self._rerouted.inc()
+        with self._lock:
+            self._outstanding[req_id] = (fleet_req, inner, rid)
+
+    # -- telemetry ------------------------------------------------------
+    def refresh_telemetry(self):
+        """Mirror per-replica snapshots and fleet aggregates onto the
+        fleet/* streams (and export, when a telemetry sink is attached).
+        The monitor calls this on a cadence; tests and bench call it
+        directly before asserting."""
+        with self._refresh_lock:
+            self._refresh_telemetry_locked()
+
+    def _refresh_telemetry_locked(self):
+        reg = self.metrics
+        total_queue = 0
+        total_active = 0
+        available = 0
+        routable = self._routable_ids()
+        for rid in self._order:
+            if rid in self._evicted:
+                alive_val = 0.0
+                snap = None
+            else:
+                snap = self._replicas[rid].load_snapshot()
+                alive_val = 1.0 if snap.get("alive") else 0.0
+            prefix = f"fleet/replica{rid}"
+            if snap is not None:
+                reg.gauge(f"{prefix}/queue_depth").set(snap["queue_depth"])
+                reg.gauge(f"{prefix}/slot_occupancy").set(
+                    snap["active_slots"]
+                )
+                reg.gauge(f"{prefix}/health_state").set(snap["health"])
+                reg.gauge(f"{prefix}/requests_shed").set(
+                    snap["requests_shed"]
+                )
+                total_queue += snap["queue_depth"]
+                total_active += snap["active_slots"]
+                # degraded replicas still take priority-0 traffic, so
+                # they count as available; draining/stopped ones do not
+                if rid in routable and snap.get("alive"):
+                    available += 1
+            reg.gauge(f"{prefix}/alive").set(alive_val)
+        reg.gauge("fleet/queue_depth").set(total_queue)
+        reg.gauge("fleet/slot_occupancy").set(total_active)
+        reg.gauge("fleet/replicas_total").set(
+            len(self._order) - len(self._evicted)
+        )
+        reg.gauge("fleet/replicas_available").set(available)
+        self._ttft_p50.set(_histogram_quantile(self._ttft, 0.50))
+        self._ttft_p99.set(_histogram_quantile(self._ttft, 0.99))
+        self._last_refresh = self._clock()
+        self._refreshes += 1
+        if self._telemetry is not None and self._telemetry.enabled:
+            self._telemetry.export(step=self._refreshes)
+
+    # -- introspection --------------------------------------------------
+    @property
+    def replica_ids(self):
+        return list(self._order)
+
+    @property
+    def evicted_ids(self):
+        with self._lock:
+            return set(self._evicted)
+
+    @property
+    def outstanding_count(self):
+        with self._lock:
+            return len(self._outstanding)

@@ -1,0 +1,216 @@
+"""Replica worker: one inference engine per process, JSON-RPC over pipes.
+
+``python -m deepspeed_tpu.serving.worker`` hosts a single
+``InferenceEngine`` and speaks newline-delimited JSON on stdin/stdout
+(stderr is left alone for logging), giving a SubprocessReplica real
+isolation: a worker that segfaults or OOMs takes only its own engine.
+
+Protocol (one JSON object per line):
+
+  parent -> worker
+    {"op": "init", "spec": {...}}            build the engine (see below)
+    {"op": "submit", "id": N, "prompt": [...],
+     "max_new_tokens": M, "kwargs": {...}}   admit one request
+    {"op": "snapshot", "id": N}              router-facing load snapshot
+    {"op": "drain"}                          stop admitting, finish work
+    {"op": "shutdown"}                       close the engine and exit
+
+  worker -> parent
+    {"event": "ready"}                       init finished, serving
+    {"event": "reply", "id": N, ...}         op ack (submit/snapshot);
+                                             carries "error" + "reason"
+                                             when the op was rejected
+    {"event": "first_token", "id": N}        request N produced its TTFT
+    {"event": "finished", "id": N,
+     "tokens": [...], "reason": "..."}       request N's terminal answer
+
+The init ``spec``: ``{"model": {GPT2Config kwargs}, "init_seed": int,
+"rng_seed": int, "config": {deepspeed config dict}}``. Params initialize
+from ``init_seed`` (every replica of a fleet gets identical weights) —
+or load through the verified-checkpoint path when the config's
+``inference.checkpoint.load_dir`` is set, the production route.
+
+The server core is transport-agnostic (:class:`WorkerServer` takes any
+file-like pair), so tests drive the full protocol in-process against a
+stub engine without paying a process spawn + jax import per case.
+"""
+
+import json
+import sys
+import threading
+import time
+
+from ..inference.scheduler import RequestRejected
+
+
+class WorkerServer:
+    """The worker's op loop over explicit streams. ``engine_builder`` maps
+    the init spec to an engine exposing submit/load_snapshot/scheduler/
+    close (the InferenceEngine surface the replica tier relies on)."""
+
+    def __init__(self, stdin, stdout, engine_builder, poll_interval=0.002):
+        self._stdin = stdin
+        self._stdout = stdout
+        self._build = engine_builder
+        self._poll = float(poll_interval)
+        self._engine = None
+        self._write_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._tracked = {}  # rpc_id -> (request, first_token_announced)
+        self._stop = threading.Event()
+
+    def _emit(self, msg):
+        with self._write_lock:
+            self._stdout.write(json.dumps(msg) + "\n")
+            self._stdout.flush()
+
+    # -- request watching (engine requests complete on the engine's
+    # driver thread; this poller turns completion into pipe events) ----
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            with self._state_lock:
+                tracked = list(self._tracked.items())
+            for rpc_id, (req, announced) in tracked:
+                if not announced and req.first_token_at is not None:
+                    with self._state_lock:
+                        if rpc_id in self._tracked:
+                            self._tracked[rpc_id] = (req, True)
+                    self._emit({"event": "first_token", "id": rpc_id})
+                if req.done:
+                    with self._state_lock:
+                        self._tracked.pop(rpc_id, None)
+                    self._emit({
+                        "event": "finished", "id": rpc_id,
+                        "tokens": [int(t) for t in req.tokens],
+                        "reason": req.finish_reason,
+                    })
+            self._stop.wait(self._poll)
+
+    # -- ops -----------------------------------------------------------
+    def _op_init(self, msg):
+        self._engine = self._build(msg["spec"])
+        self._engine.serve_forever()
+        threading.Thread(
+            target=self._watch_loop, name="ds-worker-watch", daemon=True
+        ).start()
+        self._emit({"event": "ready"})
+
+    def _op_submit(self, msg):
+        rpc_id = msg["id"]
+        kwargs = dict(msg.get("kwargs") or {})
+        # never block the single-threaded op loop on queue room: a full
+        # queue must reject NOW (the parent falls through to another
+        # replica) — a blocking wait here would stall every other RPC
+        # (snapshots, drains) past the parent's timeout and read as a
+        # dead replica
+        kwargs.setdefault("timeout", 0.0)
+        try:
+            req = self._engine.submit(
+                msg["prompt"],
+                max_new_tokens=msg.get("max_new_tokens", 32),
+                **kwargs,
+            )
+        except RequestRejected as e:
+            self._emit({
+                "event": "reply", "id": rpc_id,
+                "error": str(e), "reason": e.reason,
+            })
+            return
+        except (ValueError, TypeError) as e:
+            self._emit({"event": "reply", "id": rpc_id, "error": str(e)})
+            return
+        with self._state_lock:
+            self._tracked[rpc_id] = (req, False)
+        self._emit({"event": "reply", "id": rpc_id})
+
+    def _op_snapshot(self, msg):
+        self._emit({
+            "event": "reply", "id": msg["id"],
+            "snapshot": self._engine.load_snapshot(),
+        })
+
+    def run(self):
+        """Serve ops until shutdown/EOF. Returns 0 (clean) or 1 (an op
+        loop crash — the parent sees the exit either way)."""
+        try:
+            for line in self._stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                msg = json.loads(line)
+                op = msg.get("op")
+                if op == "init":
+                    self._op_init(msg)
+                elif op == "submit":
+                    self._op_submit(msg)
+                elif op == "snapshot":
+                    self._op_snapshot(msg)
+                elif op == "drain":
+                    self._engine.scheduler.drain()
+                elif op == "shutdown":
+                    break
+                else:
+                    print(
+                        f"worker: unknown op {op!r}", file=sys.stderr,
+                        flush=True,
+                    )
+            return 0
+        except Exception as e:  # op-loop crash: the exit code is the signal
+            print(f"worker: fatal: {e!r}", file=sys.stderr, flush=True)
+            return 1
+        finally:
+            self._stop.set()
+            if self._engine is not None:
+                self._engine.close()
+
+
+def build_engine_from_spec(spec):
+    """The production engine builder: a GPT-2 from the spec's model
+    kwargs, params from ``init_seed`` (or the config's verified
+    checkpoint load), behind ``init_inference``."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from ..models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    model_kw = dict(spec.get("model") or {})
+    model_kw.setdefault("dropout", 0.0)
+    cfg = GPT2Config(**model_kw)
+    model = GPT2LMHeadModel(cfg)
+    seed = int(spec.get("init_seed", 0))
+    ids0 = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(seed),
+         "dropout": jax.random.PRNGKey(seed + 1)},
+        ids0, ids0,
+    )["params"]
+    return deepspeed_tpu.init_inference(
+        model=model,
+        model_parameters=params,
+        config=spec.get("config") or {},
+        rng_seed=int(spec.get("rng_seed", 0)),
+    )
+
+
+def main():
+    import os
+
+    # The protocol owns fd 1 EXCLUSIVELY: dup a private handle for the
+    # server, then point fd 1 at stderr so every other writer in the
+    # process (logging handlers, stray prints, jax warnings) lands on
+    # stderr instead of corrupting the parent's JSON stream.
+    proto_out = os.fdopen(os.dup(sys.stdout.fileno()), "w", buffering=1)
+    os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
+    server = WorkerServer(sys.stdin, proto_out, build_engine_from_spec)
+    t0 = time.time()
+    code = server.run()
+    print(
+        f"worker: exiting after {time.time() - t0:.1f}s (code {code})",
+        file=sys.stderr, flush=True,
+    )
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

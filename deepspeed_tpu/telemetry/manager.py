@@ -108,6 +108,40 @@ INFERENCE_METRICS = (
 )
 
 
+# The fleet router's metric catalog (deepspeed_tpu/serving/,
+# docs/serving.md, docs/observability.md). Fleet-LEVEL streams only;
+# per-replica gauges (fleet/replica{i}/queue_depth, slot_occupancy,
+# health_state, requests_shed) are registered dynamically by the router —
+# the replica count is a config value, not a catalog constant.
+SERVING_METRICS = (
+    ("histogram", "fleet/ttft_ms", "fleet-level time to first token: router admission through the serving replica's first sampled token"),
+    ("gauge", "fleet/ttft_p50_ms", "p50 TTFT interpolated from the fleet/ttft_ms buckets at the last telemetry refresh"),
+    ("gauge", "fleet/ttft_p99_ms", "p99 TTFT interpolated from the fleet/ttft_ms buckets at the last telemetry refresh"),
+    ("gauge", "fleet/replicas_total", "replicas registered with the router (evicted replicas leave this count)"),
+    ("gauge", "fleet/replicas_available", "replicas currently routable (not draining, not restarting, not failed)"),
+    ("gauge", "fleet/queue_depth", "requests waiting across every replica's admission queue"),
+    ("gauge", "fleet/slot_occupancy", "decode slots serving a request across the fleet"),
+    ("counter", "fleet/requests_routed", "requests placed onto a replica by the router"),
+    ("counter", "fleet/requests_rerouted", "requests re-placed after their replica failed under them"),
+    ("counter", "fleet/requests_completed", "fleet requests finished with a terminal answer"),
+    ("counter", "fleet/requests_rate_limited", "submissions rejected by a tenant's token bucket (RateLimited)"),
+    ("counter", "fleet/requests_rejected", "submissions rejected at the router door for any reason (rate limit, overload, draining)"),
+    ("counter", "fleet/affinity_hits", "placements that landed on the prompt prefix's affinity replica"),
+    ("counter", "fleet/replica_restarts", "replica restarts driven by the router (rolling_restart or explicit restart)"),
+    ("counter", "fleet/replicas_evicted", "replicas evicted after their decode driver failed past its restart budget"),
+)
+
+
+def register_serving_metrics(registry):
+    """Pre-register the fleet-level fleet/* catalog on ``registry`` (the
+    same golden-set contract ENGINE_METRICS / INFERENCE_METRICS give the
+    engines: an absent stream means a broken emitter, not an idle
+    fleet)."""
+    for kind, name, help_text in SERVING_METRICS:
+        getattr(registry, kind)(name, help=help_text)
+    return registry
+
+
 def register_inference_metrics(registry):
     """Pre-register the full infer/* catalog on ``registry`` so every
     inference export carries the golden set (an absent stream means a

@@ -389,3 +389,87 @@ def test_inference_self_healing_rejects(block):
 
     with pytest.raises(DeepSpeedConfigError):
         _inf(block)
+
+
+# ---------------------------------------------------------------------------
+# serving block: fleet size, placement, admission limits (docs/serving.md)
+# ---------------------------------------------------------------------------
+def _srv(block):
+    return make({"train_batch_size": 8, "serving": block})
+
+
+def test_serving_defaults():
+    cfg = make({"train_batch_size": 8})
+    assert cfg.serving_replicas == 1
+    assert cfg.serving_backend == "in_process"
+    assert cfg.serving_placement == "least_loaded"
+    assert cfg.serving_affinity_prefix_tokens == 16
+    assert cfg.serving_capacity_floor == 0.5
+    assert cfg.serving_shed_queue_ratio == 0.75
+    assert cfg.serving_max_reroutes == 2
+    assert cfg.serving_drain_on_preemption is False
+    assert cfg.serving_rate_limit_rps is None
+    assert cfg.serving_rate_limit_burst == 1
+    assert cfg.serving_rate_limit_per_tenant == {}
+
+
+def test_serving_valid_block_parses():
+    cfg = _srv({
+        "replicas": 4,
+        "backend": "subprocess",
+        "placement": "prefix_affinity",
+        "affinity_prefix_tokens": 8,
+        "capacity_floor": 0.25,
+        "shed_queue_ratio": 0.9,
+        "max_reroutes": 0,
+        "drain_on_preemption": True,
+        "rate_limit": {
+            "requests_per_sec": 10.0,
+            "burst": 5,
+            "per_tenant": {"gold": {"requests_per_sec": 100}},
+        },
+    })
+    assert cfg.serving_replicas == 4
+    assert cfg.serving_backend == "subprocess"
+    assert cfg.serving_placement == "prefix_affinity"
+    assert cfg.serving_affinity_prefix_tokens == 8
+    assert cfg.serving_capacity_floor == 0.25
+    assert cfg.serving_max_reroutes == 0
+    assert cfg.serving_drain_on_preemption is True
+    assert cfg.serving_rate_limit_rps == 10.0
+    assert cfg.serving_rate_limit_per_tenant == {
+        "gold": {"requests_per_sec": 100}
+    }
+
+
+@pytest.mark.parametrize("block", [
+    {"replicas": 0},
+    {"replicas": -2},
+    {"replicas": 1.5},
+    {"replicas": True},
+    {"backend": "thread"},          # unknown isolation backend
+    {"placement": "random"},        # unknown placement policy
+    {"affinity_prefix_tokens": 0},
+    {"capacity_floor": 1.0},        # floor 1 => nothing could ever drain
+    {"capacity_floor": -0.1},
+    {"capacity_floor": "half"},
+    {"shed_queue_ratio": 0},
+    {"shed_queue_ratio": 1.5},
+    {"max_reroutes": -1},
+    {"max_reroutes": True},
+    {"drain_on_preemption": "yes"},
+    {"rate_limit": {"requests_per_second": 10}},  # typo'd key != unlimited
+    {"rate_limit": {"requests_per_sec": 0}},
+    {"rate_limit": {"requests_per_sec": -1}},
+    {"rate_limit": {"burst": 0}},
+    {"rate_limit": {"per_tenant": "gold"}},
+    {"rate_limit": {"per_tenant": {"gold": "fast"}}},
+    {"rate_limit": {"per_tenant": {"gold": {"rps": 1}}}},  # unknown key
+    {"rate_limit": {"per_tenant": {"gold": {"requests_per_sec": 0}}}},
+    {"rate_limit": {"per_tenant": {"gold": {"burst": 0}}}},
+])
+def test_serving_rejects(block):
+    from deepspeed_tpu.config.config import DeepSpeedConfigError
+
+    with pytest.raises(DeepSpeedConfigError):
+        _srv(block)

@@ -785,3 +785,89 @@ def test_queued_request_past_deadline_expires_without_free_slot():
         assert queued.tokens == []
     finally:
         eng.close()
+
+
+# ---------------------------------------------------------------------------
+# router-facing surface: rejection reason codes + load_snapshot
+# (deepspeed_tpu/serving/ builds on exactly these)
+# ---------------------------------------------------------------------------
+def test_rejections_carry_machine_readable_reason_codes():
+    """Every RequestRejected raise site classifies itself with a REJECT_*
+    code — the router and tests branch on exc.reason, not on prose."""
+    cfg, model, params = _small_model()
+    eng = _engine(
+        model, params,
+        inference={"max_batch_slots": 1, "queue_depth": 1,
+                   "queue_timeout_secs": 0.0},
+    )
+    try:
+        eng.submit(_prompt(4), max_new_tokens=4)
+        with pytest.raises(RequestRejected) as exc:
+            eng.submit(_prompt(4), max_new_tokens=4)
+        assert exc.value.reason == "overload"
+        eng.scheduler.run_until_idle()
+        eng.scheduler.drain()
+        with pytest.raises(RequestRejected) as exc:
+            eng.submit(_prompt(4), max_new_tokens=4)
+        assert exc.value.reason == "draining"
+    finally:
+        eng.close()
+    with pytest.raises(RequestRejected) as exc:
+        eng.submit(_prompt(4), max_new_tokens=4)  # shut down
+    assert exc.value.reason == "draining"
+
+
+def test_request_rejected_rejects_unknown_reason():
+    with pytest.raises(ValueError, match="unknown rejection reason"):
+        RequestRejected("msg", reason="bogus")
+
+
+def test_degraded_shed_reason_is_overload():
+    eng = _healing_engine(
+        inference={"queue_depth": 4, "degraded_queue_ratio": 0.5}
+    )
+    try:
+        for _ in range(2):  # 2/4 fills to the degraded ratio
+            eng.submit(_prompt(4), max_new_tokens=2)
+        with pytest.raises(RequestRejected) as exc:
+            eng.submit(_prompt(4), max_new_tokens=2, priority=1)
+        assert exc.value.reason == "overload"
+        eng.scheduler.run_until_idle()
+    finally:
+        eng.close()
+
+
+def test_load_snapshot_reports_live_idle_state():
+    """load_snapshot() is the router's placement input: queue depth and
+    slot occupancy must be LIVE values even when no drive loop is
+    running — and sampling must refresh the infer/queue_depth gauge an
+    idle replica would otherwise leave stale."""
+    cfg, model, params = _small_model()
+    eng = _engine(model, params, inference={"max_batch_slots": 2})
+    try:
+        snap = eng.load_snapshot()
+        assert snap["queue_depth"] == 0
+        assert snap["active_slots"] == 0
+        assert snap["free_slots"] == 2
+        assert snap["health"] == 0
+        assert snap["driving"] is False
+        assert snap["stopped"] is False
+        assert snap["driver_failed"] is False
+        assert snap["mean_prefill_ms"] == 0.0
+
+        # pile submissions up WITHOUT stepping: an idle replica, loaded
+        for _ in range(3):
+            eng.submit(_prompt(4), max_new_tokens=2)
+        snap = eng.load_snapshot()
+        assert snap["queue_depth"] == 3
+        # the gauge refreshed from the snapshot sample, not a drive loop
+        assert eng.metrics.snapshot()["infer/queue_depth"] == 3
+
+        eng.scheduler.run_until_idle()
+        snap = eng.load_snapshot()
+        assert snap["queue_depth"] == 0
+        assert snap["mean_prefill_ms"] > 0.0
+        assert snap["mean_decode_ms"] > 0.0
+        assert eng.metrics.snapshot()["infer/queue_depth"] == 0
+    finally:
+        eng.close()

@@ -1,0 +1,745 @@
+"""Fleet serving tier tests (deepspeed_tpu/serving/, docs/serving.md):
+placement determinism, prefix affinity, token-bucket admission, drain
+steering, rolling-restart exactly-once + bitwise parity, failed-replica
+eviction/re-route, and the worker RPC protocol."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference import RequestRejected
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.serving import (
+    FleetOverloaded,
+    FleetRouter,
+    LeastLoaded,
+    PrefixAffinity,
+    RateLimited,
+    RoundRobin,
+    TokenBucket,
+)
+from deepspeed_tpu.serving.replica import ReplicaBase
+from deepspeed_tpu.serving.router import _histogram_quantile
+from deepspeed_tpu.serving.worker import WorkerServer
+
+VOCAB = 97
+
+
+# ---------------------------------------------------------------------------
+# stub replicas: the router's contract without engines (fast paths)
+# ---------------------------------------------------------------------------
+_IDLE_SNAP = {
+    "queue_depth": 0, "queue_capacity": 8, "active_slots": 0,
+    "free_slots": 2, "num_slots": 2, "health": 0,
+    "mean_prefill_ms": 1.0, "mean_decode_ms": 1.0, "requests_shed": 0.0,
+    "restarts_used": 0, "driving": True, "stopped": False,
+    "driver_failed": False, "alive": True, "failed": False,
+}
+
+
+class StubHandle:
+    def __init__(self, prompt_tokens):
+        self.prompt_tokens = list(prompt_tokens)
+        self.tokens = []
+        self.finish_reason = None
+        self.first_token_at = None
+        self._done = threading.Event()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def _finish(self, tokens, reason):
+        self.tokens = list(tokens)
+        self.finish_reason = reason
+        self.first_token_at = time.monotonic()
+        self._done.set()
+
+
+class StubReplica(ReplicaBase):
+    """Scripted replica: canned snapshot, optional auto-finish or
+    rejection, explicit failure injection."""
+
+    def __init__(self, replica_id, snapshot=None, autofinish=None,
+                 reject_with=None):
+        super().__init__(replica_id)
+        self.snap = dict(_IDLE_SNAP, **(snapshot or {}))
+        self.autofinish = autofinish  # tokens to finish with, or None
+        self.reject_with = reject_with
+        self.handles = []
+        self.failed = False
+        self.drained = False
+        self.shutdowns = 0
+        self.restarts = 0
+
+    def start(self):
+        return self
+
+    def submit(self, prompt_tokens, **kwargs):
+        if self.reject_with is not None:
+            raise self.reject_with
+        handle = StubHandle(prompt_tokens)
+        self.handles.append(handle)
+        if self.autofinish is not None:
+            handle._finish(self.autofinish, "max_new_tokens")
+        return handle
+
+    def load_snapshot(self):
+        snap = dict(self.snap)
+        snap["failed"] = self.failed
+        snap["alive"] = snap["alive"] and not self.failed
+        return snap
+
+    def drain(self):
+        self.drained = True
+
+    def restart(self):
+        self.restarts += 1
+        self.failed = False
+        return self
+
+    def shutdown(self):
+        self.shutdowns += 1
+
+
+def _stub_router(replicas, **kw):
+    kw.setdefault("monitor_interval", 0.001)
+    return FleetRouter(replicas, **kw).start()
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+def test_least_loaded_placement_deterministic():
+    """Given FIXED load snapshots the policy's choice is a pure function:
+    min(queue_depth + active_slots), ties to the earliest candidate."""
+    policy = LeastLoaded()
+    candidates = [
+        ("0", dict(_IDLE_SNAP, queue_depth=3, active_slots=1)),
+        ("1", dict(_IDLE_SNAP, queue_depth=0, active_slots=2)),
+        ("2", dict(_IDLE_SNAP, queue_depth=1, active_slots=0)),
+    ]
+    for _ in range(5):
+        assert policy.choose(candidates, [1, 2, 3]) == "2"
+    # tie (load 2 vs load 2): earliest candidate wins
+    tied = [
+        ("a", dict(_IDLE_SNAP, queue_depth=1, active_slots=1)),
+        ("b", dict(_IDLE_SNAP, queue_depth=0, active_slots=2)),
+    ]
+    assert LeastLoaded().choose(tied, []) == "a"
+
+
+def test_round_robin_cycles_candidates():
+    policy = RoundRobin()
+    candidates = [("0", dict(_IDLE_SNAP)), ("1", dict(_IDLE_SNAP))]
+    picks = [policy.choose(candidates, []) for _ in range(4)]
+    assert picks == ["0", "1", "0", "1"]
+
+
+def test_prefix_affinity_hits_and_forgets():
+    """Identical prompt prefixes stick to the first-serving replica even
+    when load says otherwise; forget() re-pins after an eviction."""
+    policy = PrefixAffinity(prefix_tokens=4)
+    heavy0 = [
+        ("0", dict(_IDLE_SNAP, queue_depth=9)),
+        ("1", dict(_IDLE_SNAP, queue_depth=0)),
+    ]
+    prefix = [7, 7, 7, 7]
+    first = policy.choose(heavy0, prefix + [1])
+    assert first == "1" and policy.last_hit is False  # least-loaded pick
+    # same prefix, different tail, replica 1 now the HEAVY one: sticky
+    heavy1 = [
+        ("0", dict(_IDLE_SNAP, queue_depth=0)),
+        ("1", dict(_IDLE_SNAP, queue_depth=9)),
+    ]
+    assert policy.choose(heavy1, prefix + [2]) == "1"
+    assert policy.last_hit is True
+    # a DIFFERENT prefix follows load as usual
+    assert policy.choose(heavy1, [5, 5, 5, 5, 3]) == "0"
+    assert policy.last_hit is False
+    policy.forget("1")
+    assert policy.choose(heavy1, prefix + [3]) == "0"
+    assert policy.last_hit is False
+
+
+def test_router_prefix_affinity_counts_hits():
+    a = StubReplica("0", autofinish=[1])
+    b = StubReplica("1", autofinish=[2])
+    router = _stub_router([a, b], placement="prefix_affinity",
+                          affinity_prefix_tokens=4)
+    try:
+        prefix = [9, 9, 9, 9]
+        r1 = router.submit(prefix + [1], max_new_tokens=1)
+        r2 = router.submit(prefix + [2], max_new_tokens=1)
+        r1.result(2.0), r2.result(2.0)
+        assert r1.replica_id == r2.replica_id
+        assert router.metrics.snapshot()["fleet/affinity_hits"] == 1
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# admission: rate limits + priority shedding
+# ---------------------------------------------------------------------------
+def test_token_bucket_burst_and_refill():
+    clock = [0.0]
+    bucket = TokenBucket(rate=2.0, burst=2, clock=lambda: clock[0])
+    assert bucket.try_acquire() and bucket.try_acquire()
+    assert not bucket.try_acquire()  # burst spent, no time passed
+    clock[0] += 0.5  # refills one token at 2/s
+    assert bucket.try_acquire()
+    assert not bucket.try_acquire()
+    clock[0] += 10.0  # refill clamps at burst
+    assert bucket.try_acquire() and bucket.try_acquire()
+    assert not bucket.try_acquire()
+
+
+def test_rate_limit_rejects_per_tenant_with_reason_code():
+    clock = [0.0]
+    a = StubReplica("0", autofinish=[1])
+    router = _stub_router(
+        [a], rate_limit=(1.0, 1), clock=lambda: clock[0],
+        per_tenant_limits={"gold": {"requests_per_sec": 100.0, "burst": 3}},
+    )
+    try:
+        router.submit([1, 2], tenant="free", max_new_tokens=1)
+        with pytest.raises(RateLimited) as exc:
+            router.submit([1, 2], tenant="free", max_new_tokens=1)
+        assert exc.value.reason == "rate_limit"
+        assert isinstance(exc.value, RequestRejected)  # one except clause
+        # an over-limit tenant never touches a replica queue
+        assert len(a.handles) == 1
+        # other tenants have their own bucket
+        for _ in range(3):
+            router.submit([1, 2], tenant="gold", max_new_tokens=1)
+        snap = router.metrics.snapshot()
+        assert snap["fleet/requests_rate_limited"] == 1
+        assert snap["fleet/requests_rejected"] == 1
+        assert snap["fleet/requests_routed"] == 4
+        # the bucket refills with the (injected) clock
+        clock[0] += 1.1
+        router.submit([1, 2], tenant="free", max_new_tokens=1)
+    finally:
+        router.shutdown()
+
+
+def test_fleet_pressure_sheds_priority_classes_only():
+    full = StubReplica(
+        "0", snapshot={"queue_depth": 7, "queue_capacity": 8},
+        autofinish=[1],
+    )
+    router = _stub_router([full], shed_queue_ratio=0.75)
+    try:
+        with pytest.raises(FleetOverloaded) as exc:
+            router.submit([1], priority=1, max_new_tokens=1)
+        assert exc.value.reason == "overload"
+        router.submit([1], priority=0, max_new_tokens=1)  # never shed here
+    finally:
+        router.shutdown()
+
+
+def test_draining_fleet_rejects_with_reason():
+    router = _stub_router([StubReplica("0", autofinish=[1])])
+    try:
+        router.drain_fleet()
+        with pytest.raises(RequestRejected) as exc:
+            router.submit([1], max_new_tokens=1)
+        assert exc.value.reason == "draining"
+    finally:
+        router.shutdown()
+
+
+def test_unmeetable_deadline_rejected_at_router_door():
+    """A deadline below even the fastest candidate's observed prefill is
+    rejected at the ROUTER (reason "deadline") — it never burns a
+    replica queue slot on a guaranteed miss."""
+    slow = StubReplica("0", snapshot={"mean_prefill_ms": 50.0},
+                       autofinish=[1])
+    router = _stub_router([slow])
+    try:
+        with pytest.raises(RequestRejected) as exc:
+            router.submit([1, 2], max_new_tokens=1, deadline_secs=0.01)
+        assert exc.value.reason == "deadline"
+        assert len(slow.handles) == 0
+        # a meetable deadline passes the gate and places normally
+        req = router.submit([1, 2], max_new_tokens=1, deadline_secs=5.0)
+        assert req.result(2.0) == [1]
+    finally:
+        router.shutdown()
+
+
+def test_affinity_hit_not_counted_when_sticky_replica_rejects():
+    """The sticky replica rejecting at its door is NOT an affinity hit:
+    the request actually lands elsewhere via fallback."""
+    a = StubReplica("0", autofinish=[1])
+    b = StubReplica("1", autofinish=[2])
+    router = _stub_router([a, b], placement="prefix_affinity",
+                          affinity_prefix_tokens=4)
+    try:
+        prefix = [3, 3, 3, 3]
+        first = router.submit(prefix + [1], max_new_tokens=1)
+        first.result(2.0)
+        sticky = router._replicas[first.replica_id]
+        other = b if sticky is a else a
+        sticky.reject_with = RequestRejected("full", reason="overload")
+        second = router.submit(prefix + [2], max_new_tokens=1)
+        assert second.result(2.0) == (other.autofinish)
+        assert second.replica_id == other.replica_id
+        assert router.metrics.snapshot()["fleet/affinity_hits"] == 0
+    finally:
+        router.shutdown()
+
+
+def test_all_replicas_rejecting_is_fleet_overloaded():
+    rej = RequestRejected("queue full", reason="overload")
+    router = _stub_router([
+        StubReplica("0", reject_with=rej),
+        StubReplica("1", reject_with=rej),
+    ])
+    try:
+        with pytest.raises(FleetOverloaded):
+            router.submit([1], max_new_tokens=1)
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# failure handling: eviction + re-route
+# ---------------------------------------------------------------------------
+def test_evicted_replica_requests_reroute_exactly_once():
+    """A replica that dies under its requests is evicted; each of its
+    requests is re-placed on a survivor and finishes exactly once."""
+    flaky = StubReplica("0")          # least loaded: takes the request
+    backup = StubReplica("1", snapshot={"queue_depth": 5}, autofinish=[42])
+    router = _stub_router([flaky, backup], max_reroutes=2)
+    try:
+        req = router.submit([1, 2, 3], max_new_tokens=1)
+        assert req.replica_id == "0"
+        # the replica crashes past its restart budget: its scheduler
+        # fail-finishes the in-flight request, the snapshot reports failed
+        flaky.failed = True
+        flaky.handles[0]._finish([], "error")
+        assert req.result(5.0) == [42]
+        assert req.replica_id == "1"
+        assert req.reroutes == 1
+        assert req.finish_reason == "max_new_tokens"
+        deadline = time.monotonic() + 5.0
+        while ("0" not in router.evicted_ids
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert router.evicted_ids == {"0"}
+        assert flaky.shutdowns == 1
+        router.refresh_telemetry()
+        snap = router.metrics.snapshot()
+        assert snap["fleet/replicas_evicted"] == 1
+        assert snap["fleet/requests_rerouted"] == 1
+        assert snap["fleet/requests_completed"] == 1
+        assert snap["fleet/replicas_total"] == 1
+    finally:
+        router.shutdown()
+
+
+def test_reroute_charges_elapsed_deadline_time():
+    """A re-routed request carries its REMAINING end-to-end deadline to
+    the new replica (the clock does not restart), and one that expired
+    while its replica died finishes "deadline" instead of getting a
+    fresh full-budget generation elsewhere."""
+    flaky = StubReplica("0")
+    backup = StubReplica("1", snapshot={"queue_depth": 5}, autofinish=[7])
+    router = _stub_router([flaky, backup], max_reroutes=2)
+    try:
+        req = router.submit([1, 2], max_new_tokens=1, deadline_secs=30.0)
+        flaky.failed = True
+        flaky.handles[0]._finish([], "error")
+        assert req.result(5.0) == [7]
+        carried = backup.handles[0]
+        # the backup saw a reduced budget, not the original 30s
+        assert req.kwargs["deadline_secs"] < 30.0
+        assert carried.prompt_tokens == [1, 2]
+
+        # expired-while-dying: terminal "deadline", no re-placement
+        router2 = _stub_router(
+            [StubReplica("a"), StubReplica("b", autofinish=[9])],
+            max_reroutes=2,
+        )
+        try:
+            req2 = router2.submit([3], max_new_tokens=1,
+                                  deadline_secs=0.01)
+            replica_a = router2._replicas["a"]
+            time.sleep(0.05)  # deadline passes while the replica dies
+            replica_a.failed = True
+            for handle in replica_a.handles:
+                handle._finish([], "error")
+            deadline = time.monotonic() + 5.0
+            while not req2.done and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert req2.finish_reason == "deadline"
+            assert req2.result(0) == []  # partial-answer contract
+            assert router2._replicas["b"].handles == []  # never re-placed
+        finally:
+            router2.shutdown()
+    finally:
+        router.shutdown()
+
+
+def test_reroute_budget_exhausted_fails_loudly():
+    dead_a = StubReplica("0")
+    dead_b = StubReplica("1")
+    router = _stub_router([dead_a, dead_b], max_reroutes=1)
+    try:
+        req = router.submit([1], max_new_tokens=1)
+        for replica in (dead_a, dead_b):
+            replica.failed = True
+            for handle in replica.handles:
+                if not handle.done:
+                    handle._finish([], "error")
+        # the re-routed copy lands on the OTHER dead replica and dies too;
+        # budget 1 means the router must now fail the fleet request
+        deadline = time.monotonic() + 5.0
+        while not req.done and time.monotonic() < deadline:
+            for replica in (dead_a, dead_b):
+                for handle in replica.handles:
+                    if not handle.done:
+                        handle._finish([], "error")
+            time.sleep(0.005)
+        assert req.done
+        assert req.finish_reason == "error"
+        with pytest.raises(RuntimeError, match="re-route"):
+            req.result(0)
+    finally:
+        router.shutdown()
+
+
+def test_histogram_quantile_interpolates():
+    from deepspeed_tpu.telemetry.registry import Histogram
+
+    hist = Histogram("t", buckets=(10.0, 20.0, 40.0))
+    assert _histogram_quantile(hist, 0.5) == 0.0  # empty
+    for v in (5, 5, 15, 15, 35, 35, 35, 35):
+        hist.observe(v)
+    p50 = _histogram_quantile(hist, 0.5)
+    p99 = _histogram_quantile(hist, 0.99)
+    assert 10.0 <= p50 <= 20.0
+    assert 20.0 < p99 <= 40.0
+
+
+# ---------------------------------------------------------------------------
+# worker RPC protocol (in-process: no spawn, no jax)
+# ---------------------------------------------------------------------------
+class _ChanIn:
+    """Blocking line source driving WorkerServer.run like a real pipe."""
+
+    def __init__(self):
+        self._q = queue.Queue()
+
+    def send(self, line):
+        self._q.put(line + "\n")
+
+    def close(self):
+        self._q.put(None)
+
+    def __iter__(self):
+        while True:
+            line = self._q.get()
+            if line is None:
+                return
+            yield line
+
+
+class _ChanOut:
+    """Collects protocol lines; tests wait on arrival."""
+
+    def __init__(self):
+        self.lines = []
+        self._cond = threading.Condition()
+
+    def write(self, text):
+        with self._cond:
+            self.lines.append(text.strip())
+            self._cond.notify_all()
+
+    def flush(self):
+        pass
+
+    def wait_for(self, predicate, timeout=5.0):
+        import json
+
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                for raw in self.lines:
+                    msg = json.loads(raw)
+                    if predicate(msg):
+                        return msg
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"no matching line in {self.lines}")
+                self._cond.wait(remaining)
+
+
+class _FakeWorkerEngine:
+    """The InferenceEngine surface WorkerServer drives, scripted."""
+
+    def __init__(self):
+        self.scheduler = self
+        self.drained = False
+        self.closed = False
+
+    def serve_forever(self):
+        pass
+
+    def submit(self, prompt, max_new_tokens=32, **kwargs):
+        if prompt == ["reject"]:
+            raise RequestRejected("full", reason="overload")
+        if not prompt:
+            raise ValueError("empty prompt")
+        handle = StubHandle(prompt)
+        handle._finish([t + 1 for t in prompt][:max_new_tokens],
+                       "max_new_tokens")
+        return handle
+
+    def load_snapshot(self):
+        return dict(_IDLE_SNAP)
+
+    def drain(self):
+        self.drained = True
+
+    def close(self):
+        self.closed = True
+
+
+def test_worker_server_protocol_roundtrip():
+    import json
+
+    stdin, stdout = _ChanIn(), _ChanOut()
+    engine = _FakeWorkerEngine()
+    server = WorkerServer(stdin, stdout, lambda spec: engine,
+                          poll_interval=0.001)
+    thread = threading.Thread(target=server.run, daemon=True)
+    thread.start()
+    stdin.send(json.dumps({"op": "init", "spec": {}}))
+    stdout.wait_for(lambda m: m.get("event") == "ready")
+    stdin.send(json.dumps({
+        "op": "submit", "id": 1, "prompt": [10, 20], "max_new_tokens": 2,
+    }))
+    stdout.wait_for(
+        lambda m: m.get("event") == "reply" and m.get("id") == 1
+        and "error" not in m
+    )
+    fin = stdout.wait_for(
+        lambda m: m.get("event") == "finished" and m.get("id") == 1
+    )
+    assert fin["tokens"] == [11, 21]
+    assert fin["reason"] == "max_new_tokens"
+    # a rejected submit carries the machine-readable reason through
+    stdin.send(json.dumps(
+        {"op": "submit", "id": 2, "prompt": ["reject"]}
+    ))
+    rej = stdout.wait_for(
+        lambda m: m.get("event") == "reply" and m.get("id") == 2
+    )
+    assert rej["reason"] == "overload" and rej["error"]
+    stdin.send(json.dumps({"op": "snapshot", "id": 3}))
+    snap = stdout.wait_for(
+        lambda m: m.get("event") == "reply" and m.get("id") == 3
+    )
+    assert snap["snapshot"]["queue_depth"] == 0
+    stdin.send(json.dumps({"op": "drain"}))
+    stdin.send(json.dumps({"op": "shutdown"}))
+    thread.join(5.0)
+    assert not thread.is_alive()
+    assert engine.drained and engine.closed
+
+
+# ---------------------------------------------------------------------------
+# real engines: drain steering, rolling restart, parity
+# ---------------------------------------------------------------------------
+def _small_model(seed=0):
+    cfg = GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False,
+    )
+    model = GPT2LMHeadModel(cfg)
+    ids0 = jnp.asarray(
+        np.random.default_rng(seed).integers(0, VOCAB, (1, 8)), jnp.int32
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(seed),
+         "dropout": jax.random.PRNGKey(seed + 1)},
+        ids0, ids0,
+    )["params"]
+    return cfg, model, params
+
+
+_ENGINE_BLOCK = {
+    "max_batch_slots": 2, "max_seq_len": 48, "prefill_len": 16,
+    "sampling": {"greedy": True},
+}
+
+
+def _factory(model, params):
+    def build():
+        return deepspeed_tpu.init_inference(
+            model=model, model_parameters=params,
+            config={"inference": dict(_ENGINE_BLOCK)},
+        )
+
+    return build
+
+
+def _prompts(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return [
+        [int(t) for t in rng.integers(0, VOCAB, k)]
+        for k in rng.integers(5, 12, n)
+    ]
+
+
+def test_fleet_drain_steers_traffic_while_inflight_finishes():
+    cfg, model, params = _small_model()
+    router = deepspeed_tpu.init_fleet(
+        engine_factory=_factory(model, params),
+        config={"serving": {"replicas": 2}},
+    )
+    try:
+        long_req = router.submit(_prompts(1)[0], max_new_tokens=24)
+        target = long_req.replica_id
+        other = next(r for r in router.replica_ids if r != target)
+        router.drain(target)
+        after = [router.submit(p, max_new_tokens=4) for p in _prompts(3, 7)]
+        for req in after:
+            req.result(60.0)
+            assert req.replica_id == other  # steered away from the drain
+        assert long_req.result(60.0)  # in-flight work still finished
+        assert long_req.replica_id == target
+        assert long_req.reroutes == 0
+    finally:
+        router.shutdown()
+
+
+def test_rolling_restart_exactly_once_and_bitwise_parity():
+    """The acceptance pin: a rolling restart across 2 replicas under
+    concurrent traffic finishes every submitted request exactly once
+    (none lost, none duplicated), keeps routable capacity at/above the
+    configured floor, and greedy outputs stay bitwise-identical to a
+    single-replica run of the same prompts."""
+    cfg, model, params = _small_model()
+    prompts = _prompts(4, seed=3)
+
+    single = _factory(model, params)()
+    reference = single.generate(prompts, max_new_tokens=8)
+    single.close()
+
+    router = deepspeed_tpu.init_fleet(
+        engine_factory=_factory(model, params),
+        config={"serving": {"replicas": 2, "capacity_floor": 0.5}},
+    )
+    floor_breached = []
+    available = router.metrics.gauge("fleet/replicas_available")
+    try:
+        results = {}
+        errors = []
+
+        def pump(i):
+            try:
+                req = router.submit(prompts[i % 4], max_new_tokens=8)
+                results.setdefault(i, []).append(req.result(120.0))
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append((i, e))
+
+        threads = [
+            threading.Thread(target=pump, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+
+        watching = threading.Event()
+
+        def watch_floor():
+            while not watching.is_set():
+                if available.value < 1.0:
+                    floor_breached.append(available.value)
+                time.sleep(0.002)
+
+        watcher = threading.Thread(target=watch_floor, daemon=True)
+        watcher.start()
+        router.rolling_restart(wait_timeout=60.0)
+        for t in threads:
+            t.join(120.0)
+        watching.set()
+        watcher.join(5.0)
+
+        assert not errors, errors
+        assert len(results) == 8  # every submission answered...
+        for i, answers in results.items():
+            assert len(answers) == 1  # ...exactly once
+            assert answers[0] == reference[i % 4]  # ...bitwise greedy
+        assert sum(router.routed_counts.values()) >= 8
+        snap = router.metrics.snapshot()
+        assert snap["fleet/replica_restarts"] == 2
+        assert snap["fleet/requests_completed"] == 8
+        assert snap["fleet/ttft_ms/count"] == 8
+        # capacity floor held for the whole restart (1 of 2 replicas)
+        assert not floor_breached, floor_breached
+    finally:
+        router.shutdown()
+
+
+def test_rolling_restart_refuses_impossible_floor():
+    router = _stub_router([StubReplica("0", autofinish=[1])],
+                          capacity_floor=0.9)
+    try:
+        with pytest.raises(RuntimeError, match="capacity floor"):
+            router.rolling_restart()
+    finally:
+        router.shutdown()
+
+
+def test_subprocess_replica_end_to_end_greedy_parity():
+    """One worker subprocess serving the tiniest GPT-2: submissions cross
+    the pipe, answers match an in-process engine of the same seed
+    bitwise, and shutdown reaps the process."""
+    from deepspeed_tpu.serving import SubprocessReplica
+
+    model_kw = {
+        "vocab_size": 64, "n_positions": 32, "n_embd": 16, "n_layer": 1,
+        "n_head": 2, "use_flash": False,
+    }
+    engine_block = {
+        "max_batch_slots": 2, "max_seq_len": 24, "prefill_len": 8,
+        "sampling": {"greedy": True},
+    }
+    spec = {
+        "model": model_kw, "init_seed": 0,
+        "config": {"inference": engine_block},
+    }
+    rng = np.random.default_rng(5)
+    prompts = [[int(t) for t in rng.integers(0, 64, 6)] for _ in range(2)]
+
+    from deepspeed_tpu.serving.worker import build_engine_from_spec
+
+    local = build_engine_from_spec(spec)
+    reference = local.generate(prompts, max_new_tokens=5)
+    local.close()
+
+    replica = SubprocessReplica("sub0", spec, start_timeout=240.0)
+    replica.start()
+    try:
+        snap = replica.load_snapshot()
+        assert snap["alive"] and not snap["failed"]
+        handles = [
+            replica.submit(p, max_new_tokens=5) for p in prompts
+        ]
+        outs = [h.result(120.0) for h in handles]
+        assert outs == reference
+        assert all(h.finish_reason == "max_new_tokens" for h in handles)
+    finally:
+        replica.shutdown()
+    assert not replica.alive and not replica.failed
