@@ -476,6 +476,23 @@ class DeepSpeedConfig:
             samp_dict, C.INFERENCE_SAMPLING_GREEDY,
             C.INFERENCE_SAMPLING_GREEDY_DEFAULT,
         )
+        self.inference_kv_block_size = get_scalar_param(
+            inf_dict, C.INFERENCE_KV_BLOCK_SIZE,
+            C.INFERENCE_KV_BLOCK_SIZE_DEFAULT,
+        )
+        self.inference_kv_pool_blocks = get_scalar_param(
+            inf_dict, C.INFERENCE_KV_POOL_BLOCKS,
+            C.INFERENCE_KV_POOL_BLOCKS_DEFAULT,
+        )
+        pc_dict = get_dict_param(inf_dict, C.INFERENCE_PREFIX_CACHE)
+        self.inference_prefix_cache_enabled = get_scalar_param(
+            pc_dict, C.INFERENCE_PREFIX_CACHE_ENABLED,
+            C.INFERENCE_PREFIX_CACHE_ENABLED_DEFAULT,
+        )
+        self.inference_prefix_cache_suffix_buckets = get_scalar_param(
+            pc_dict, C.INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS,
+            C.INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS_DEFAULT,
+        )
         ckpt_dict = get_dict_param(inf_dict, C.INFERENCE_CHECKPOINT)
         self.inference_checkpoint_load_dir = get_scalar_param(
             ckpt_dict, C.INFERENCE_CHECKPOINT_LOAD_DIR,
@@ -1091,6 +1108,79 @@ class DeepSpeedConfig:
                 f"('' = serve the passed-in parameters), got "
                 f"{self.inference_checkpoint_load_dir!r}"
             )
+        bs = self.inference_kv_block_size
+        if not isinstance(bs, int) or isinstance(bs, bool) or bs < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_KV_BLOCK_SIZE} must be an "
+                f"integer >= 0 tokens per page (0 = contiguous per-slot "
+                f"cache), got {bs!r}"
+            )
+        pool = self.inference_kv_pool_blocks
+        if not isinstance(pool, int) or isinstance(pool, bool) or pool < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_KV_POOL_BLOCKS} must be an "
+                f"integer >= 0 pages (0 = auto-size to the contiguous "
+                f"cache's HBM), got {pool!r}"
+            )
+        if pool > 0 and bs == 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_KV_POOL_BLOCKS}={pool} "
+                f"without {C.INFERENCE_KV_BLOCK_SIZE}: a pool needs a "
+                f"page size (set kv_block_size > 0, e.g. 32)"
+            )
+        if (
+            bs > 0
+            and self.inference_max_seq_len
+            and self.inference_max_seq_len % bs != 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_MAX_SEQ_LEN}="
+                f"{self.inference_max_seq_len} is not a multiple of "
+                f"{C.INFERENCE_KV_BLOCK_SIZE}={bs}: the paged cache's "
+                f"logical extent must equal the contiguous cache's "
+                f"(the bitwise-parity contract)"
+            )
+        pc = self.inference_prefix_cache_enabled
+        if pc is not None and not isinstance(pc, bool):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_PREFIX_CACHE}."
+                f"{C.INFERENCE_PREFIX_CACHE_ENABLED} must be a boolean or "
+                f"null (null = on whenever the cache is paged), got {pc!r}"
+            )
+        if pc is True and bs == 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_PREFIX_CACHE} requires the "
+                f"paged cache: set {C.INFERENCE_KV_BLOCK_SIZE} > 0 "
+                f"(prefixes are shared at page granularity)"
+            )
+        buckets = self.inference_prefix_cache_suffix_buckets
+        if buckets is not None and bs == 0:
+            # same guard as kv_pool_blocks-without-a-page-size: bucket
+            # config on a contiguous cache would be silently inert
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_PREFIX_CACHE}."
+                f"{C.INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS} requires the "
+                f"paged cache: set {C.INFERENCE_KV_BLOCK_SIZE} > 0"
+            )
+        if buckets is not None:
+            if (
+                not isinstance(buckets, list)
+                or not buckets
+                or not all(
+                    isinstance(b, int)
+                    and not isinstance(b, bool)
+                    and b >= 1
+                    for b in buckets
+                )
+                or sorted(buckets) != buckets
+            ):
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{C.INFERENCE_PREFIX_CACHE}."
+                    f"{C.INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS} must be an "
+                    f"ascending non-empty list of integers >= 1 (each a "
+                    f"compiled suffix-prefill width) or null (auto "
+                    f"ladder), got {buckets!r}"
+                )
 
     def _check_serving(self):
         """Validate the serving block (docs/serving.md): a typo'd backend

@@ -502,6 +502,38 @@ INFERENCE_DRIVER_RESTART_BUDGET_DEFAULT = 0
 # front door (docs/inference.md "Self-healing serving").
 INFERENCE_DEGRADED_QUEUE_RATIO = "degraded_queue_ratio"
 INFERENCE_DEGRADED_QUEUE_RATIO_DEFAULT = 0.75
+# Block-paged KV cache (PagedAttention — docs/inference.md "Paged KV
+# cache"): page size in tokens. 0 => the legacy contiguous per-slot
+# cache ([layers, slots, heads, max_seq_len, head_dim], every slot
+# reserving max_seq_len rows). > 0 => a global pool of fixed-size pages
+# indirected through per-slot block tables; max_seq_len must divide by
+# it (the bitwise-parity contract needs identical logical cache
+# extents). 32 is the tuned default for TPU serving configs.
+INFERENCE_KV_BLOCK_SIZE = "kv_block_size"
+INFERENCE_KV_BLOCK_SIZE_DEFAULT = 0
+# Usable pages in the pool (excluding the null page). 0 => auto: slots *
+# (max_seq_len / kv_block_size) — the contiguous cache's capacity plus
+# ONE extra page (the never-allocated null page), so paging at the
+# default is a fragmentation win at essentially the same HBM. Set LOWER
+# to serve more slots per HBM byte: admission reserves only
+# ceil((prompt + max_new) / kv_block_size) pages per request, so short
+# traffic packs several requests into one contiguous slot's worth of
+# pages.
+INFERENCE_KV_POOL_BLOCKS = "kv_pool_blocks"
+INFERENCE_KV_POOL_BLOCKS_DEFAULT = 0
+# Cross-request prefix caching over the page pool: full prompt pages are
+# content-hashed (vLLM chain scheme), reference-counted, and shared, so
+# a templated prefix (system prompt, few-shot header) prefills ONCE
+# fleet-wide and later requests compute only their unique suffix.
+# "enabled" null => on whenever kv_block_size > 0; explicitly true
+# REQUIRES the paged cache. "suffix_buckets" fixes the padded suffix
+# widths the hit-path prefill compiles for (null => a power-of-two
+# ladder from kv_block_size up to prefill_len).
+INFERENCE_PREFIX_CACHE = "prefix_cache"
+INFERENCE_PREFIX_CACHE_ENABLED = "enabled"
+INFERENCE_PREFIX_CACHE_ENABLED_DEFAULT = None
+INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS = "suffix_buckets"
+INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS_DEFAULT = None
 # Optional checkpoint to serve from: loaded through the resilience
 # verified-load path (manifest check + host-side parse + newest-valid
 # fallback) before params pin to device shardings.

@@ -1,12 +1,17 @@
 """Continuous-batching inference: KV-cache decode + a serving front door.
 
 The repo's fourth subsystem (next to telemetry/, resilience/, and the
-runtime/staging input pipeline), docs/inference.md. Three layers:
+runtime/staging input pipeline), docs/inference.md. Four layers:
 
   decode.py    — KV-cache prefill + fixed-shape incremental decode over
                  the GPT-2 parameter trees (ops/transformer.py grew the
                  block-level ``return_kv`` / ``transformer_block_decode``
-                 modes this drives).
+                 modes this drives), in two cache layouts: the contiguous
+                 per-slot block and the block-paged page pool
+                 (``kv_block_size`` > 0).
+  paging.py    — the host-side page allocator behind the paged layout:
+                 free list, prefix-hash registry, refcounts, LRU
+                 eviction — cross-request prefix caching lives here.
   sampling.py  — jitted greedy/temperature/top-k/top-p sampling with
                  explicit PRNG-key threading.
   engine.py /  — ``init_inference()``: verified param load, device
@@ -16,14 +21,21 @@ runtime/staging input pipeline), docs/inference.md. Three layers:
 
 from .decode import (
     KVCache,
+    KVPool,
     gpt2_decode_step,
+    gpt2_decode_step_paged,
     gpt2_prefill,
+    gpt2_prefill_suffix,
     init_kv_cache,
+    init_kv_pool,
     write_prefill_to_cache,
+    write_prefill_to_pool,
 )
 from .engine import InferenceEngine, init_inference
+from .paging import NULL_BLOCK, BlockPool, PoolExhausted, hash_full_blocks
 from .sampling import sample_tokens
 from .scheduler import (
+    REJECT_CAPACITY,
     REJECT_DEADLINE,
     REJECT_DRAINING,
     REJECT_OVERLOAD,
@@ -35,16 +47,26 @@ from .scheduler import (
 )
 
 __all__ = [
+    "REJECT_CAPACITY",
     "REJECT_DEADLINE",
     "REJECT_DRAINING",
     "REJECT_OVERLOAD",
     "REJECT_RATE_LIMIT",
     "REJECT_REASONS",
     "KVCache",
+    "KVPool",
+    "NULL_BLOCK",
+    "BlockPool",
+    "PoolExhausted",
+    "hash_full_blocks",
     "gpt2_decode_step",
+    "gpt2_decode_step_paged",
     "gpt2_prefill",
+    "gpt2_prefill_suffix",
     "init_kv_cache",
+    "init_kv_pool",
     "write_prefill_to_cache",
+    "write_prefill_to_pool",
     "InferenceEngine",
     "init_inference",
     "sample_tokens",

@@ -15,12 +15,24 @@ on (Orca, vLLM — PAPERS.md):
            written into the cache, attention taken over the cache —
            O(max_len) per token.
 
-Cache layout is ``[layers, slots, heads, max_len, head_dim]``: the
-leading ``layers`` axis matches the scanned parameter stack (one
+Two cache layouts share the decode arithmetic:
+
+  CONTIGUOUS ``[layers, slots, heads, max_len, head_dim]`` — every slot
+           reserves ``max_len`` rows (the legacy layout, and the parity
+           reference).
+  PAGED    ``[layers, num_blocks, block_size, heads, head_dim]`` — a
+           global pool of fixed-size pages indirected through per-slot
+           block tables (PagedAttention; docs/inference.md "Paged KV
+           cache"), with page 0 the never-allocated null page. Bitwise
+           greedy parity with the contiguous path is pinned in
+           tests/unit/test_paged_kv.py.
+
+The leading ``layers`` axis matches the scanned parameter stack (one
 ``lax.scan`` drives both), ``slots`` is the continuous-batching batch
 width (scheduler.py), and ``heads`` shards over the mesh's ``model``
-axis via :func:`models.gpt2.kv_cache_partition_specs` — the same
-Megatron head split the qkv weights carry.
+axis via :func:`models.gpt2.kv_cache_partition_specs` /
+:func:`models.gpt2.kv_pool_partition_specs` — the same Megatron head
+split the qkv weights carry.
 
 Every function here is pure and fixed-shape: tokens/positions are
 ``[slots]`` arrays whatever subset of slots is live, so requests joining
@@ -37,6 +49,8 @@ import jax.numpy as jnp
 from ..ops.transformer import (
     transformer_block_apply,
     transformer_block_decode,
+    transformer_block_decode_paged,
+    transformer_block_prefill_paged,
 )
 
 
@@ -121,6 +135,123 @@ def write_prefill_to_cache(cache: KVCache, slot, ks, vs):
         )
 
     return KVCache(k=place(cache.k, ks), v=place(cache.v, vs))
+
+
+class KVPool(typing.NamedTuple):
+    """Block-paged decode cache: ``k``/``v`` each ``[layers, num_blocks,
+    block_size, heads, head_dim]`` — a global pool of fixed-size pages
+    shared by every slot through per-slot block tables (PagedAttention,
+    vLLM — PAPERS.md). Physical page 0 is the NULL page: never allocated,
+    the target of every unassigned block-table entry, so dead-slot writes
+    and gathers of unwritten positions stay harmless. Positions sit
+    block-major (page, offset) so both the prefill scatter and the decode
+    scatter index two adjacent axes; ``heads`` shards over the mesh's
+    ``model`` axis via :func:`models.gpt2.kv_pool_partition_specs`."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_blocks(self):
+        """Physical pages INCLUDING the null page."""
+        return self.k.shape[1]
+
+    @property
+    def block_size(self):
+        return self.k.shape[2]
+
+
+def init_kv_pool(config, num_blocks, block_size, dtype=jnp.float32):
+    """Zero-filled page pool for a GPT2Config: ``num_blocks`` usable
+    pages plus the null page at physical index 0."""
+    shape = (
+        config.n_layer,
+        int(num_blocks) + 1,  # + the null page
+        int(block_size),
+        config.n_head,
+        config.n_embd // config.n_head,
+    )
+    return KVPool(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def write_prefill_to_pool(pool: KVPool, ks, vs, block_ids, offsets):
+    """Install one cold-prefilled request's k/v ([L, 1, heads, S, hd])
+    into its pages: position ``j`` lands at ``(block_ids[j],
+    offsets[j])``. Padding rows beyond the prompt carry NULL_BLOCK in
+    ``block_ids`` (the slot never allocated pages for them), so their
+    garbage k/v sinks into the sacrificial page."""
+    # [L, 1, heads, S, hd] -> [L, S, heads, hd]
+    k_rows = jnp.squeeze(ks, 1).transpose(0, 2, 1, 3)
+    v_rows = jnp.squeeze(vs, 1).transpose(0, 2, 1, 3)
+    k = pool.k.at[:, block_ids, offsets, :, :].set(
+        k_rows.astype(pool.k.dtype)
+    )
+    v = pool.v.at[:, block_ids, offsets, :, :].set(
+        v_rows.astype(pool.v.dtype)
+    )
+    return KVPool(k=k, v=v)
+
+
+def gpt2_decode_step_paged(config, params, tokens, positions,
+                           pool: KVPool, block_tables):
+    """One incremental token for every slot over the paged pool — the
+    block-table twin of :func:`gpt2_decode_step` (identical embedding,
+    layer-scan, and head arithmetic through the shared decode core, so
+    greedy rollouts are bitwise against the contiguous path). ``tokens``
+    / ``positions`` are [slots] int32; ``block_tables`` [slots,
+    max_blocks] int32 holds physical page ids (0 = null page). Returns
+    ``(logits [slots, vocab_padded], pool)``."""
+    tp = params["transformer"]
+    layer_cfg = config.layer_config()
+    x = tp["wte"][tokens] + tp["wpe"][positions]  # [slots, H]
+    x = x[:, None, :]  # [slots, 1, H]
+
+    def body(x, xs):
+        pl, kp, vp = xs
+        x, kp, vp = transformer_block_decode_paged(
+            layer_cfg, pl, x, kp, vp, block_tables, positions
+        )
+        return x, (kp, vp)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (tp["h"], pool.k, pool.v)
+    )
+    logits = _final_norm_and_logits(config, tp, x)
+    return logits[:, 0, :], KVPool(k=k_pool, v=v_pool)
+
+
+def gpt2_prefill_suffix(config, params, tokens, start_pos,
+                        pool: KVPool, block_tables):
+    """Prefill a prompt's UNIQUE SUFFIX against its cached prefix pages:
+    the prefix-cache hit path. ``tokens`` [B, S] is the suffix padded to
+    a fixed bucket, ``start_pos`` [B] the cached prefix length (a whole
+    number of pages). Each layer writes the suffix's k/v into the slot's
+    own pages and attends causally over prefix + suffix through the
+    gathered page view — compute scales with the suffix bucket, not the
+    prompt, which is where the templated-traffic TTFT win comes from.
+    Returns ``(logits [B, S, vocab_padded], pool)``; row ``suffix_len-1``
+    seeds generation. Padding rows' positions clamp into the position
+    table (their logits and cache writes are garbage the masks and
+    decode overwrites keep inert)."""
+    tp = params["transformer"]
+    s = tokens.shape[1]
+    layer_cfg = config.layer_config()
+    positions = start_pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    positions = jnp.minimum(positions, tp["wpe"].shape[0] - 1)
+    x = tp["wte"][tokens] + tp["wpe"][positions]
+
+    def body(x, xs):
+        pl, kp, vp = xs
+        x, kp, vp = transformer_block_prefill_paged(
+            layer_cfg, pl, x, kp, vp, block_tables, start_pos
+        )
+        return x, (kp, vp)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (tp["h"], pool.k, pool.v)
+    )
+    logits = _final_norm_and_logits(config, tp, x)
+    return logits, KVPool(k=k_pool, v=v_pool)
 
 
 def gpt2_decode_step(config, params, tokens, positions, cache: KVCache):

@@ -32,17 +32,22 @@ import jax.numpy as jnp
 
 from ..config import constants as C
 from ..config.config import DeepSpeedConfig, DeepSpeedConfigError
-from ..models.gpt2 import kv_cache_partition_specs
+from ..models.gpt2 import kv_cache_partition_specs, kv_pool_partition_specs
 from ..parallel import mesh as mesh_lib
 from ..telemetry.manager import build_telemetry, register_inference_metrics
 from ..telemetry.registry import MetricsRegistry
 from ..utils.logging import log_dist
 from .decode import (
     gpt2_decode_step,
+    gpt2_decode_step_paged,
     gpt2_prefill,
+    gpt2_prefill_suffix,
     init_kv_cache,
+    init_kv_pool,
     write_prefill_to_cache,
+    write_prefill_to_pool,
 )
+from .paging import NULL_BLOCK, BlockPool, PoolExhausted, hash_full_blocks
 from .sampling import sample_tokens
 from .scheduler import ContinuousBatchingScheduler, RequestRejected  # noqa: F401  (re-exported)
 
@@ -141,6 +146,46 @@ class InferenceEngine:
             jnp.bfloat16 if cfg.inference_dtype == "bf16" else jnp.float32
         )
 
+        # ---- paged-cache geometry (docs/inference.md "Paged KV cache") -
+        self.kv_block_size = int(cfg.inference_kv_block_size)
+        self.paged = self.kv_block_size > 0
+        if self.paged:
+            if self.max_seq_len % self.kv_block_size != 0:
+                # config-level validation only sees an explicit
+                # max_seq_len; the model-derived default lands here
+                raise DeepSpeedConfigError(
+                    f"resolved max_seq_len={self.max_seq_len} is not a "
+                    f"multiple of inference.kv_block_size="
+                    f"{self.kv_block_size} (model n_positions="
+                    f"{mcfg.n_positions}); the paged cache's logical "
+                    f"extent must equal the contiguous cache's"
+                )
+            self.blocks_per_slot = self.max_seq_len // self.kv_block_size
+            self.kv_pool_blocks = (
+                int(cfg.inference_kv_pool_blocks)
+                or self.num_slots * self.blocks_per_slot
+            )
+            enabled = cfg.inference_prefix_cache_enabled
+            self.prefix_cache_enabled = True if enabled is None else enabled
+            buckets = cfg.inference_prefix_cache_suffix_buckets
+            if buckets is None:
+                # power-of-two ladder from one page up to the prefill
+                # window: each bucket is one compiled suffix-prefill
+                # program, so the ladder bounds hit-path compile count
+                buckets, b = [], self.kv_block_size
+                while b < self.prefill_len:
+                    buckets.append(b)
+                    b *= 2
+                buckets.append(self.prefill_len)
+            self._suffix_buckets = sorted(
+                {min(int(b), self.prefill_len) for b in buckets}
+            )
+        else:
+            self.blocks_per_slot = 0
+            self.kv_pool_blocks = 0
+            self.prefix_cache_enabled = False
+            self._suffix_buckets = []
+
         # ---- telemetry + metrics --------------------------------------
         n_params = sum(
             int(np.prod(p.shape))
@@ -205,17 +250,37 @@ class InferenceEngine:
         )
 
         # ---- KV cache + jitted programs -------------------------------
-        from .decode import KVCache
+        from .decode import KVCache, KVPool
 
-        cache_sharding = NamedSharding(self._mesh, kv_cache_partition_specs())
-        # kept for reset_decode_state: driver auto-restart re-inits the
-        # cache into the same shardings without touching the pinned params
-        self._cache_sharding = KVCache(k=cache_sharding, v=cache_sharding)
+        if self.paged:
+            pool_sharding = NamedSharding(
+                self._mesh, kv_pool_partition_specs()
+            )
+            self._cache_sharding = KVPool(k=pool_sharding, v=pool_sharding)
+            # host-side allocator: page free list, prefix-hash registry,
+            # refcounts, eviction LRU (inference/paging.py)
+            self.block_pool = BlockPool(
+                self.kv_pool_blocks, self.kv_block_size
+            )
+            self._block_tables = np.zeros(
+                (self.num_slots, self.blocks_per_slot), np.int32
+            )
+            self._slot_blocks = {}  # slot -> this request's page ids
+            self._slot_prefix_len = {}  # slot -> cached-prefix tokens
+            self._slot_hashes = {}  # slot -> prompt's full-page hash chain
+        else:
+            cache_sharding = NamedSharding(
+                self._mesh, kv_cache_partition_specs()
+            )
+            # kept for reset_decode_state: driver auto-restart re-inits
+            # the cache into the same shardings without touching the
+            # pinned params
+            self._cache_sharding = KVCache(
+                k=cache_sharding, v=cache_sharding
+            )
+            self.block_pool = None
         self._cache = jax.device_put(
-            init_kv_cache(
-                mcfg, self.num_slots, self.max_seq_len, self.compute_dtype
-            ),
-            self._cache_sharding,
+            self._init_cache_host(), self._cache_sharding
         )
         self._key = jax.random.PRNGKey(rng_seed)
         self._lengths = np.zeros(self.num_slots, np.int32)
@@ -240,16 +305,41 @@ class InferenceEngine:
         self._jit_prefill = jax.jit(
             lambda p, toks: gpt2_prefill(mcfg, p, toks)
         )
-        self._jit_write_prefill = jax.jit(
-            write_prefill_to_cache,
-            donate_argnums=(0,) if donate_cache else (),
-        )
-        self._jit_decode = jax.jit(
-            lambda p, toks, pos, temps, key, cache: self._decode_and_sample(
-                p, toks, pos, temps, key, cache
-            ),
-            donate_argnums=(5,) if donate_cache else (),
-        )
+        if self.paged:
+            self._jit_write_prefill = jax.jit(
+                write_prefill_to_pool,
+                donate_argnums=(0,) if donate_cache else (),
+            )
+            self._jit_decode = jax.jit(
+                lambda p, toks, pos, temps, key, pool, tables: (
+                    self._decode_and_sample_paged(
+                        p, toks, pos, temps, key, pool, tables
+                    )
+                ),
+                donate_argnums=(5,) if donate_cache else (),
+            )
+            # one compiled program per suffix bucket (jit specializes on
+            # the padded suffix shape); start_pos stays a traced array so
+            # every prefix length shares the bucket's program
+            self._jit_prefill_suffix = jax.jit(
+                lambda p, suf, sp, pool, bt: gpt2_prefill_suffix(
+                    mcfg, p, suf, sp, pool, bt
+                ),
+                donate_argnums=(3,) if donate_cache else (),
+            )
+        else:
+            self._jit_write_prefill = jax.jit(
+                write_prefill_to_cache,
+                donate_argnums=(0,) if donate_cache else (),
+            )
+            self._jit_decode = jax.jit(
+                lambda p, toks, pos, temps, key, cache: (
+                    self._decode_and_sample(
+                        p, toks, pos, temps, key, cache
+                    )
+                ),
+                donate_argnums=(5,) if donate_cache else (),
+            )
         # first token rides a traced last-prompt-row index so every prompt
         # length reuses ONE compiled program (an eager logits[:, plen-1]
         # slice would compile per distinct length and trip the
@@ -259,6 +349,17 @@ class InferenceEngine:
                 jax.lax.dynamic_slice_in_dim(logits, idx, 1, axis=1)[:, 0, :],
                 key, temp, **self._sampling_statics,
             )
+        )
+
+        # ---- KV metric streams ----------------------------------------
+        self._kv_occupancy = self.metrics.gauge("infer/kv_pool_occupancy")
+        self._kv_bytes = self.metrics.gauge("infer/kv_cache_bytes")
+        self._prefix_hits = self.metrics.counter("infer/prefix_hits")
+        self._prefix_misses = self.metrics.counter("infer/prefix_misses")
+        self._kv_reclaimed = self.metrics.counter("infer/kv_blocks_reclaimed")
+        self._reclaimed_synced = 0
+        self._kv_bytes.set(
+            int(self._cache.k.nbytes) + int(self._cache.v.nbytes)
         )
 
         # ---- scheduler ------------------------------------------------
@@ -285,9 +386,29 @@ class InferenceEngine:
             f"{self.prefill_len}), dtype "
             f"{cfg.inference_dtype}, queue depth "
             f"{cfg.inference_queue_depth}"
+            + (
+                f", paged KV cache ({self.kv_pool_blocks} pages x "
+                f"{self.kv_block_size} tokens, prefix cache "
+                f"{'on' if self.prefix_cache_enabled else 'off'})"
+                if self.paged else ", contiguous KV cache"
+            )
             + (f", serving checkpoint {self.loaded_tag}"
                if self.loaded_tag else ""),
             ranks=[0],
+        )
+
+    def _init_cache_host(self):
+        """Fresh zeroed decode cache (host-side values; the caller
+        device_puts into the pinned shardings): the contiguous per-slot
+        block or the paged page pool, per the engine's mode."""
+        if self.paged:
+            return init_kv_pool(
+                self.model_config, self.kv_pool_blocks, self.kv_block_size,
+                self.compute_dtype,
+            )
+        return init_kv_cache(
+            self.model_config, self.num_slots, self.max_seq_len,
+            self.compute_dtype,
         )
 
     # -- device hooks (called by the scheduler) -------------------------
@@ -301,41 +422,246 @@ class InferenceEngine:
         )
         return next_tokens, cache
 
+    def _decode_and_sample_paged(self, params, tokens, positions, temps,
+                                 key, pool, tables):
+        logits, pool = gpt2_decode_step_paged(
+            self.model_config, params, tokens, positions, pool, tables
+        )
+        next_tokens = sample_tokens(
+            logits, key, temps, **self._sampling_statics
+        )
+        return next_tokens, pool
+
+    # -- paged-pool accounting (scheduler admission hooks) --------------
+    def kv_blocks_needed(self, prompt_len, max_new_tokens):
+        """Worst-case pages one request reserves at admission: every
+        token it may cache, prompt plus generation budget, capped at the
+        sequence limit. Reserving the worst case up front means decode
+        NEVER allocates mid-flight — a running request cannot hit pool
+        exhaustion between tokens, so admission is the only capacity
+        gate (docs/inference.md weighs this against lazy growth)."""
+        total = min(int(prompt_len) + int(max_new_tokens), self.max_seq_len)
+        return self.block_pool.blocks_for(total)
+
+    def kv_blocks_available(self):
+        """Pages an admission could obtain right now (free + evictable
+        cached): the REJECT_CAPACITY gate's denominator."""
+        return self.block_pool.available_blocks
+
+    def kv_pool_total_blocks(self):
+        return self.block_pool.num_blocks
+
+    def reserve_request(self, slot, prompt_tokens, max_new_tokens):
+        """Slot-join page allocation: look up the longest cached prefix
+        (acquiring shared references on its pages), then allocate private
+        pages for everything else this request may write. Raises
+        :class:`paging.PoolExhausted` — the scheduler defers the request
+        to the next step boundary — with no pages held. Returns the
+        cached prefix length in tokens (0 = cold)."""
+        if not self.paged:
+            return 0
+        plen = len(prompt_tokens)
+        needed = self.kv_blocks_needed(plen, max_new_tokens)
+        # cheap pressure short-circuit BEFORE the O(prompt) hash chain: a
+        # deferred request retries here every step, and even a full
+        # prefix hit (at most the prompt's full pages minus one) cannot
+        # shrink the private need below this floor
+        min_private = needed - (plen - 1) // self.kv_block_size
+        if self.block_pool.available_blocks < min_private:
+            raise PoolExhausted(
+                min_private, self.block_pool.available_blocks
+            )
+        hashes = None
+        if self.prefix_cache_enabled:
+            hashes = hash_full_blocks(prompt_tokens, self.kv_block_size)
+            prefix_len, shared = self.block_pool.match_prefix(
+                prompt_tokens, hashes=hashes
+            )
+            if prefix_len and self._suffix_bucket(
+                plen - prefix_len, prefix_len
+            ) is None:
+                # no compiled suffix width fits this (suffix, prefix)
+                # pair — e.g. a small user-configured bucket list, or a
+                # bucket that would pad past max_seq_len and clamp its
+                # garbage rows into the slot's REAL last page: fall back
+                # to the always-correct cold full prefill (a miss, not a
+                # hit — the pages still share on the next request)
+                self.block_pool.release(shared)
+                prefix_len, shared = 0, []
+        else:
+            prefix_len, shared = 0, []
+        try:
+            private = self.block_pool.alloc(needed - len(shared))
+        except Exception:
+            if shared:
+                self.block_pool.release(shared)
+            raise
+        if self.prefix_cache_enabled:
+            (self._prefix_hits if prefix_len else self._prefix_misses).inc()
+        blocks = shared + private
+        self._slot_blocks[slot] = blocks
+        self._slot_prefix_len[slot] = prefix_len
+        self._slot_hashes[slot] = hashes
+        row = np.zeros(self.blocks_per_slot, np.int32)
+        row[: len(blocks)] = blocks
+        self._block_tables[slot] = row
+        self._sync_pool_metrics()
+        return prefix_len
+
+    def release_slot(self, slot):
+        """Return a finished/evicted request's pages to the pool (shared
+        prefix pages decref; full prompt pages stay cached for the next
+        request with that prefix) and NULL its block-table row so the
+        dead slot's ride-along decode writes sink into the sacrificial
+        page instead of pages the pool may hand to someone else."""
+        if not self.paged:
+            return
+        blocks = self._slot_blocks.pop(slot, None)
+        self._slot_prefix_len.pop(slot, None)
+        self._slot_hashes.pop(slot, None)
+        if blocks:
+            self.block_pool.release(blocks)
+        self._block_tables[slot] = NULL_BLOCK
+        self._sync_pool_metrics()
+
+    def _sync_pool_metrics(self):
+        pool = self.block_pool
+        self._kv_occupancy.set(pool.used_blocks)
+        if pool.reclaimed > self._reclaimed_synced:
+            self._kv_reclaimed.inc(pool.reclaimed - self._reclaimed_synced)
+            self._reclaimed_synced = pool.reclaimed
+
+    def kv_snapshot(self):
+        """Pool/prefix-cache state for ``load_snapshot()`` — the numbers
+        the fleet router's placement and per-replica gauges read."""
+        if not self.paged:
+            return {}
+        hits = self._prefix_hits.value
+        misses = self._prefix_misses.value
+        return {
+            "kv_blocks_total": self.block_pool.num_blocks,
+            "kv_blocks_free": self.block_pool.available_blocks,
+            "kv_blocks_used": self.block_pool.used_blocks,
+            "prefix_hits": hits,
+            "prefix_misses": misses,
+            "prefix_hit_rate": (
+                hits / (hits + misses) if hits + misses else 0.0
+            ),
+        }
+
     def prefill_request(self, slot, prompt_tokens, temperature):
         """Run one request's prefill into ``slot``: cache rows 0..P-1
         written, first token sampled from the prompt's last logit row.
-        Returns the first generated token (a host int)."""
+        On the paged path the pages come from :meth:`reserve_request`
+        (already called at slot join); a cached-prefix hit skips the
+        shared pages' compute entirely and prefills only the unique
+        suffix. Returns the first generated token (a host int)."""
         plen = len(prompt_tokens)
-        padded = np.zeros((1, self.prefill_len), np.int32)
-        padded[0, :plen] = prompt_tokens
-        logits, ks, vs = self._jit_prefill(self.params, jnp.asarray(padded))
-        self._cache = self._jit_write_prefill(
-            self._cache, jnp.int32(slot), ks, vs
-        )
-        self._key, sub = jax.random.split(self._key)
-        first = self._jit_first_token(
-            logits, jnp.int32(plen - 1), sub,
-            jnp.full((1,), temperature, jnp.float32),
-        )
-        first = int(np.asarray(first)[0])
+        prefix_len = self._slot_prefix_len.get(slot, 0) if self.paged else 0
+        if prefix_len > 0:
+            first = self._prefill_suffix(
+                slot, prompt_tokens, prefix_len, temperature
+            )
+        else:
+            padded = np.zeros((1, self.prefill_len), np.int32)
+            padded[0, :plen] = prompt_tokens
+            logits, ks, vs = self._jit_prefill(
+                self.params, jnp.asarray(padded)
+            )
+            if self.paged:
+                # position j -> (its page, its offset); padding rows past
+                # the prompt carry the null page
+                blocks = self._slot_blocks[slot]
+                block_ids = np.zeros(self.prefill_len, np.int32)
+                block_ids[:plen] = np.repeat(
+                    blocks, self.kv_block_size
+                )[:plen]
+                offsets = (
+                    np.arange(self.prefill_len, dtype=np.int32)
+                    % self.kv_block_size
+                )
+                self._cache = self._jit_write_prefill(
+                    self._cache, ks, vs,
+                    jnp.asarray(block_ids), jnp.asarray(offsets),
+                )
+            else:
+                self._cache = self._jit_write_prefill(
+                    self._cache, jnp.int32(slot), ks, vs
+                )
+            self._key, sub = jax.random.split(self._key)
+            first = self._jit_first_token(
+                logits, jnp.int32(plen - 1), sub,
+                jnp.full((1,), temperature, jnp.float32),
+            )
+            first = int(np.asarray(first)[0])
+        if self.paged and self.prefix_cache_enabled:
+            # publish this prompt's full pages so later requests share
+            # them (no-op for pages already in the registry; the hash
+            # chain was computed once at reserve time)
+            self.block_pool.register_prefix(
+                prompt_tokens, self._slot_blocks[slot],
+                hashes=self._slot_hashes.get(slot),
+            )
         self._lengths[slot] = plen
         self._last_tokens[slot] = first
         self._temps[slot] = temperature
         return first
 
-    def reset_decode_state(self):
-        """Rebuild the decode-side state (KV cache, slot bookkeeping)
-        from scratch; the PINNED params are untouched — this is the
-        driver auto-restart path after a decode crash
-        (scheduler._recover_driver_crash), a cache re-init rather than a
-        weight reload."""
-        self._cache = jax.device_put(
-            init_kv_cache(
-                self.model_config, self.num_slots, self.max_seq_len,
-                self.compute_dtype,
-            ),
-            self._cache_sharding,
+    def _suffix_bucket(self, suffix_len, prefix_len):
+        """Smallest compiled suffix width that (a) holds the suffix and
+        (b) keeps every PADDED row's position inside max_seq_len — a
+        bucket padding past the sequence limit would clamp its garbage
+        rows' block index into the slot's real last page and overwrite
+        written prompt k/v. None when no bucket qualifies (the caller
+        falls back to the cold full prefill)."""
+        for b in self._suffix_buckets:
+            if b >= suffix_len and prefix_len + b <= self.max_seq_len:
+                return b
+        return None
+
+    def _prefill_suffix(self, slot, prompt_tokens, prefix_len, temperature):
+        """Prefix-cache hit: prefill ``prompt[prefix_len:]`` only, padded
+        to the smallest compiled suffix bucket, attending over the shared
+        prefix pages — the near-zero-TTFT path for templated traffic."""
+        suffix = prompt_tokens[prefix_len:]
+        bucket = self._suffix_bucket(len(suffix), prefix_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(suffix)] = suffix
+        logits, self._cache = self._jit_prefill_suffix(
+            self.params,
+            jnp.asarray(padded),
+            jnp.full((1,), prefix_len, jnp.int32),
+            self._cache,
+            jnp.asarray(self._block_tables[slot:slot + 1]),
         )
+        self._key, sub = jax.random.split(self._key)
+        first = self._jit_first_token(
+            logits, jnp.int32(len(suffix) - 1), sub,
+            jnp.full((1,), temperature, jnp.float32),
+        )
+        return int(np.asarray(first)[0])
+
+    def reset_decode_state(self):
+        """Rebuild the decode-side state (KV cache or page pool, slot
+        bookkeeping, block tables) from scratch; the PINNED params are
+        untouched — this is the driver auto-restart path after a decode
+        crash (scheduler._recover_driver_crash), a cache re-init rather
+        than a weight reload."""
+        self._cache = jax.device_put(
+            self._init_cache_host(), self._cache_sharding
+        )
+        if self.paged:
+            # the pool's pages (and any cached prefixes) died with the
+            # cache contents: fresh allocator, nulled tables
+            self.block_pool = BlockPool(
+                self.kv_pool_blocks, self.kv_block_size
+            )
+            self._reclaimed_synced = 0
+            self._block_tables[:] = NULL_BLOCK
+            self._slot_blocks.clear()
+            self._slot_prefix_len.clear()
+            self._slot_hashes.clear()
+            self._sync_pool_metrics()
         self._lengths[:] = 0
         self._last_tokens[:] = 0
         log_dist(
@@ -351,14 +677,25 @@ class InferenceEngine:
         # through the scheduler's step, exercising the auto-restart path
         self.resilience.faults.maybe_raise("decode.step")
         self._key, sub = jax.random.split(self._key)
-        next_tokens, self._cache = self._jit_decode(
-            self.params,
-            jnp.asarray(self._last_tokens),
-            jnp.asarray(self._lengths),
-            jnp.asarray(self._temps),
-            sub,
-            self._cache,
-        )
+        if self.paged:
+            next_tokens, self._cache = self._jit_decode(
+                self.params,
+                jnp.asarray(self._last_tokens),
+                jnp.asarray(self._lengths),
+                jnp.asarray(self._temps),
+                sub,
+                self._cache,
+                jnp.asarray(self._block_tables),
+            )
+        else:
+            next_tokens, self._cache = self._jit_decode(
+                self.params,
+                jnp.asarray(self._last_tokens),
+                jnp.asarray(self._lengths),
+                jnp.asarray(self._temps),
+                sub,
+                self._cache,
+            )
         next_tokens = np.asarray(next_tokens)
         out = []
         for slot in active_slots:

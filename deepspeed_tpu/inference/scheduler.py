@@ -34,6 +34,7 @@ configured budget instead of fail-finishing everything on the first
 crash.
 """
 
+import collections
 import itertools
 import queue
 import threading
@@ -41,6 +42,7 @@ import time
 
 from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS
 from ..utils.logging import logger
+from .paging import PoolExhausted
 
 
 # Machine-readable rejection reason codes carried by RequestRejected (and
@@ -50,8 +52,10 @@ REJECT_OVERLOAD = "overload"      # queue full / degraded shedding / fleet full
 REJECT_DEADLINE = "deadline"      # deadline unmeetable at an admission gate
 REJECT_RATE_LIMIT = "rate_limit"  # per-tenant token bucket empty
 REJECT_DRAINING = "draining"      # draining or shut-down front door
+REJECT_CAPACITY = "capacity"      # KV page pool exhausted (paged cache)
 REJECT_REASONS = (
     REJECT_OVERLOAD, REJECT_DEADLINE, REJECT_RATE_LIMIT, REJECT_DRAINING,
+    REJECT_CAPACITY,
 )
 
 
@@ -162,6 +166,11 @@ class ContinuousBatchingScheduler:
         self._degraded_ratio = float(degraded_queue_ratio)
         self._draining = False
         self._slots = [None] * self.num_slots
+        # requests popped from the queue whose page allocation came up
+        # short (paged engines only): they hold no slot and no pages, and
+        # re-enter admission FIRST at the next step boundary, once a
+        # finishing request has released pages
+        self._deferred = collections.deque()
         self._registry = registry
         self._telemetry = telemetry
         self._export_interval = max(1, int(export_interval))
@@ -206,6 +215,14 @@ class ContinuousBatchingScheduler:
         """Current health state (module constants HEALTH_*)."""
         return self._update_health()
 
+    def _waiting_depth(self):
+        """Requests waiting for a slot: the bounded queue PLUS the
+        deferred line (popped but parked on page pressure) — the one
+        number every queue_depth gauge write and the degraded-health
+        threshold use, so the reported backlog never flickers between
+        definitions."""
+        return self._queue.qsize() + len(self._deferred)
+
     def _update_health(self):
         """healthy -> degraded -> draining, from queue pressure and the
         drain/stop flags; mirrors onto the infer/health_state gauge."""
@@ -213,7 +230,7 @@ class ContinuousBatchingScheduler:
             h = HEALTH_DRAINING
         elif (
             self._queue.maxsize > 0
-            and self._queue.qsize()
+            and self._waiting_depth()
             >= self._degraded_ratio * self._queue.maxsize
         ):
             h = HEALTH_DEGRADED
@@ -236,11 +253,11 @@ class ContinuousBatchingScheduler:
         the queue here also refreshes the infer/queue_depth gauge, so an
         IDLE replica reports a live value instead of whatever the last
         drive-loop iteration left behind."""
-        depth = self._queue.qsize()
+        depth = self._waiting_depth()
         self._queue_depth.set(depth)
         active = len(self.active_slots)
         decode_n = self._token_latency_ms.count
-        return {
+        snap = {
             "queue_depth": depth,
             "queue_capacity": self._queue.maxsize,
             "active_slots": active,
@@ -260,6 +277,13 @@ class ContinuousBatchingScheduler:
             "stopped": self._stop.is_set(),
             "driver_failed": self.driver_failed,
         }
+        kv = getattr(self._engine, "kv_snapshot", None)
+        if kv is not None:
+            # paged engines add pool/prefix-cache state (kv_blocks_free,
+            # prefix_hit_rate, ...) — what capacity-aware placement and
+            # the per-replica fleet gauges read (docs/serving.md)
+            snap.update(kv())
+        return snap
 
     # -- front door -----------------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens=32, temperature=None,
@@ -325,6 +349,31 @@ class ContinuousBatchingScheduler:
                 f"prompt of {n} tokens leaves no room to generate under "
                 f"max_seq_len={self.max_seq_len}"
             )
+        if getattr(self._engine, "paged", False):
+            # KV page-pool capacity gate: a request the pool cannot hold
+            # RIGHT NOW sheds with the typed "capacity" reason so a fleet
+            # router can distinguish "replica out of KV pages" from
+            # "replica overloaded" and place elsewhere. (A request racing
+            # in behind this check simply defers at the slot-join
+            # boundary until pages free — the gate is load shedding, not
+            # the correctness mechanism.)
+            needed = self._engine.kv_blocks_needed(n, int(max_new_tokens))
+            total = self._engine.kv_pool_total_blocks()
+            if needed > total:
+                raise ValueError(
+                    f"request needs {needed} KV pages (prompt {n} + "
+                    f"max_new_tokens {max_new_tokens}) but the pool holds "
+                    f"only {total}; raise inference.kv_pool_blocks or "
+                    f"lower the generation budget"
+                )
+            available = self._engine.kv_blocks_available()
+            if needed > available:
+                self._rejected.inc()
+                raise RequestRejected(
+                    f"KV page pool exhausted: request needs {needed} "
+                    f"pages, {available} free or evictable (of {total})",
+                    reason=REJECT_CAPACITY,
+                )
         req = InferenceRequest(
             prompt_tokens,
             max_new_tokens=max_new_tokens,
@@ -361,13 +410,22 @@ class ContinuousBatchingScheduler:
                 "scheduler is shut down", reason=REJECT_DRAINING
             )
         self._admitted.inc()
-        self._queue_depth.set(self._queue.qsize())
+        self._queue_depth.set(self._waiting_depth())
         return req
 
     # -- scheduling -----------------------------------------------------
     @property
     def active_slots(self):
         return [i for i, r in enumerate(self._slots) if r is not None]
+
+    def _free_slot(self, slot):
+        """Vacate ``slot`` and hand its KV pages back to a paged engine
+        (shared prefix pages decref, private ones free; the block-table
+        row nulls so the dead slot's ride-along writes stay harmless)."""
+        self._slots[slot] = None
+        release = getattr(self._engine, "release_slot", None)
+        if release is not None:
+            release(slot)
 
     def _prefill_estimate_secs(self):
         """Observed mean prefill wall time — the admission-time lower
@@ -396,12 +454,20 @@ class ContinuousBatchingScheduler:
                 and req.deadline is not None
                 and now >= req.deadline
             ):
-                self._slots[slot] = None
+                self._free_slot(slot)
                 self._deadline_misses.inc()
                 req._finish(_FINISH_DEADLINE)
-        # queued requests: finish in place under the queue mutex (state
-        # only — no structural mutation); _admit pops and discards
-        # already-finished entries
+        # queued/deferred requests: finish in place (state only — no
+        # structural mutation); _admit pops and discards already-finished
+        # entries
+        for req in list(self._deferred):
+            if (
+                req.deadline is not None
+                and not req.done
+                and now >= req.deadline
+            ):
+                self._deadline_misses.inc()
+                req._finish(_FINISH_DEADLINE)
         with self._queue.mutex:
             for req in self._queue.queue:
                 if (
@@ -412,21 +478,34 @@ class ContinuousBatchingScheduler:
                     self._deadline_misses.inc()
                     req._finish(_FINISH_DEADLINE)
 
+    def _next_admission_candidate(self):
+        """Next request to try admitting: deferred (pages came up short
+        at an earlier step) before freshly queued."""
+        if self._deferred:
+            return self._deferred.popleft()
+        try:
+            req = self._queue.get_nowait()
+        except queue.Empty:
+            return None
+        self._queue_depth.set(self._waiting_depth())
+        return req
+
     def _admit(self):
         """Fill free slots from the queue: prefill each admitted request
         and sample its first token (TTFT ends here). Requests whose
         deadline is unmeetable finish with reason ``"deadline"`` without
-        taking the slot."""
+        taking the slot. On a paged engine the slot join first reserves
+        the request's worst-case KV pages; a shortfall DEFERS the request
+        (no slot, no pages) until a finishing request frees pages."""
+        reserve = getattr(self._engine, "reserve_request", None)
         for slot, occupant in enumerate(self._slots):
             if occupant is not None:
                 continue
             req = None
             while req is None:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
+                req = self._next_admission_candidate()
+                if req is None:
                     break
-                self._queue_depth.set(self._queue.qsize())
                 if req.done:
                     # already finished in the queue (deadline sweep):
                     # just discard the husk
@@ -441,13 +520,23 @@ class ContinuousBatchingScheduler:
             if req is None:
                 break
             t0 = time.monotonic()
-            self._queue_wait_ms.observe((t0 - req.submitted_at) * 1e3)
             # the request OWNS the slot before prefill runs: a prefill
             # that raises (device OOM, injected chaos) then leaves it in
             # the slot table, where the crash-recovery / fail-finish
             # sweeps reach it — popped-but-unplaced requests would hang
             # their result() waiters forever
             self._slots[slot] = req
+            if reserve is not None:
+                try:
+                    reserve(slot, req.prompt_tokens, req.max_new_tokens)
+                except PoolExhausted:
+                    # no pages right now: park the request at the head of
+                    # the deferred line and stop admitting this step —
+                    # an active request's release is what unblocks it
+                    self._slots[slot] = None
+                    self._deferred.appendleft(req)
+                    break
+            self._queue_wait_ms.observe((t0 - req.submitted_at) * 1e3)
             first = self._engine.prefill_request(
                 slot, req.prompt_tokens, req.temperature
             )
@@ -473,8 +562,7 @@ class ContinuousBatchingScheduler:
         elif len(req.prompt_tokens) + len(req.tokens) >= self.max_seq_len:
             reason = _FINISH_LENGTH
         if reason is not None:
-            slot = self._slots.index(req)
-            self._slots[slot] = None
+            self._free_slot(self._slots.index(req))
             self._completed.inc()
             req._finish(reason)
 
@@ -535,7 +623,7 @@ class ContinuousBatchingScheduler:
         reload."""
         for slot, req in enumerate(self._slots):
             if req is not None:
-                self._slots[slot] = None
+                self._free_slot(slot)
                 req._finish(_FINISH_ERROR)
         reset = getattr(self._engine, "reset_decode_state", None)
         if reset is not None:
@@ -569,7 +657,9 @@ class ContinuousBatchingScheduler:
         crashes auto-restart within ``driver_restart_budget``."""
         with self._drive_lock:
             while not self._stop.is_set() and (
-                self._step_recovering() or not self._queue.empty()
+                self._step_recovering()
+                or not self._queue.empty()
+                or self._deferred
             ):
                 pass
             self._flush_rate()
@@ -648,6 +738,8 @@ class ContinuousBatchingScheduler:
         self._update_health()  # gauge lands on draining
 
     def _fail_finish_outstanding(self):
+        while self._deferred:
+            self._deferred.popleft()._finish(_FINISH_CANCELLED)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -656,7 +748,7 @@ class ContinuousBatchingScheduler:
             req._finish(_FINISH_CANCELLED)
         for slot, req in enumerate(self._slots):
             if req is not None:
-                self._slots[slot] = None
+                self._free_slot(slot)
                 req._finish(_FINISH_CANCELLED)
         self._queue_depth.set(0)
         self._occupancy.set(0)

@@ -364,6 +364,18 @@ def kv_cache_partition_specs(mp_axis=MODEL_AXIS):
     return P(None, None, mp_axis, None, None)
 
 
+def kv_pool_partition_specs(mp_axis=MODEL_AXIS):
+    """PartitionSpec for the block-paged decode pool laid out ``[layers,
+    num_blocks, block_size, heads, head_dim]`` (inference/decode.py:
+    KVPool): same Megatron head split as :func:`kv_cache_partition_specs`
+    — each chip holds its own heads' rows of EVERY page, so block-table
+    gathers and the single-token scatters stay chip-local along the
+    sharded axis. Pages/offsets stay unsharded: the block table reassigns
+    them every admission and eviction, and resharding pages would thrash
+    exactly the way resharding slots would."""
+    return P(None, None, None, mp_axis, None)
+
+
 def partition_specs(params, mp_axis=MODEL_AXIS, pipeline=False):
     """Megatron-style tensor-parallel PartitionSpecs for a GPT2LMHeadModel
     param tree (same structure, PartitionSpec leaves).

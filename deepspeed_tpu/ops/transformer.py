@@ -359,6 +359,74 @@ def transformer_block_apply(
     return block(hidden_states)
 
 
+def _decode_block_core(cfg, p, hidden_states, positions, kv_commit):
+    """The shared single-token decode block: LN/qkv/attention/FFN, with
+    the CACHE CONTAINER abstracted behind ``kv_commit(k_new, v_new) ->
+    (k_full, v_full, carry)`` — ``k_full``/``v_full`` are [B, heads, K,
+    hd] views holding every cached position (whatever the physical
+    layout), ``carry`` is the updated container state threaded back to
+    the caller. The contiguous and paged paths share every arithmetic op
+    through this function, which is what makes their greedy decode
+    bitwise-identical (pinned in tests/unit/test_paged_kv.py): identical
+    einsum contractions over identical K, and masked positions contribute
+    exactly 0.0 whatever garbage the physical layout parks there."""
+    H = cfg.hidden_size
+    heads = cfg.heads
+    head_dim = H // heads
+    b = hidden_states.shape[0]
+
+    def ln(x, scale, bias):
+        return layer_norm_apply(cfg, x, scale, bias)
+
+    # ---- attention sublayer, incremental ------------------------------
+    residual = hidden_states
+    attn_in = (
+        ln(hidden_states, p["attn_nw"], p["attn_nb"])
+        if cfg.pre_layer_norm else hidden_states
+    )
+    qkv = attn_in @ p["attn_qkvw"] + p["attn_qkvb"]  # [B, 1, 3H]
+    q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, heads, head_dim)
+    k_new = k_new.reshape(b, heads, head_dim)
+    v_new = v_new.reshape(b, heads, head_dim)
+
+    k_full, v_full, carry = kv_commit(k_new, v_new)
+    max_len = k_full.shape[2]
+
+    # [B, heads, max_len] scores in f32 (MXU-accumulate dtype discipline
+    # of ops/attention.py); future positions masked by validity, so the
+    # garbage beyond each row's length never contributes
+    sm_scale = 1.0 / (head_dim ** 0.5)
+    s = jnp.einsum(
+        "bhd,bhkd->bhk", q, k_full, preferred_element_type=jnp.float32
+    ) * sm_scale
+    valid = (
+        jax.lax.broadcasted_iota(jnp.int32, (b, 1, max_len), 2)
+        <= positions[:, None, None]
+    )
+    s = jnp.where(valid, s, NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum(
+        "bhk,bhkd->bhd", probs.astype(v_full.dtype), v_full
+    )
+    ctx = ctx.reshape(b, 1, H)
+    attn_out = ctx @ p["attn_ow"] + p["attn_ob"]
+    x = residual + attn_out
+    if not cfg.pre_layer_norm:
+        x = ln(x, p["attn_nw"], p["attn_nb"])
+
+    # ---- feed-forward sublayer (identical to the training block) ------
+    residual = x
+    ff_in = ln(x, p["norm_w"], p["norm_b"]) if cfg.pre_layer_norm else x
+    h = ff_in @ p["inter_w"] + p["inter_b"]
+    h = nn.gelu(h, approximate=True)
+    h = h @ p["output_w"] + p["output_b"]
+    x = residual + h
+    if not cfg.pre_layer_norm:
+        x = ln(x, p["norm_w"], p["norm_b"])
+    return x, carry
+
+
 def transformer_block_decode(
     cfg: DeepSpeedTransformerConfig,
     p: dict,
@@ -386,62 +454,172 @@ def transformer_block_decode(
     tests/unit/test_inference.py). Returns ``(out [B,1,H], k_cache,
     v_cache)`` with the updated caches.
     """
+    b = hidden_states.shape[0]
+
+    def commit(k_new, v_new):
+        # scatter this token's k/v into the cache at its position
+        # (advanced indexing pairs the two [B] index arrays, so row i
+        # writes cache[i, :, positions[i]]); positions are clamped by the
+        # caller's length accounting, and jit scatter drops OOB writes
+        rows = jnp.arange(b)
+        kc = k_cache.at[rows, :, positions, :].set(
+            k_new.astype(k_cache.dtype)
+        )
+        vc = v_cache.at[rows, :, positions, :].set(
+            v_new.astype(v_cache.dtype)
+        )
+        return kc, vc, (kc, vc)
+
+    x, (kc, vc) = _decode_block_core(cfg, p, hidden_states, positions, commit)
+    return x, kc, vc
+
+
+def transformer_block_decode_paged(
+    cfg: DeepSpeedTransformerConfig,
+    p: dict,
+    hidden_states,
+    k_pool,
+    v_pool,
+    block_tables,
+    positions,
+):
+    """One incremental-decode step over a BLOCK-PAGED KV cache.
+
+    Same computation as :func:`transformer_block_decode` (it runs the
+    identical ``_decode_block_core``), but the cache container is a
+    global page pool ``k_pool``/``v_pool`` [num_blocks, block_size,
+    heads, hd] indirected through ``block_tables`` [B, max_blocks] int32
+    (PagedAttention, vLLM — PAPERS.md): slot i's logical position ``pos``
+    lives at physical page ``block_tables[i, pos // block_size]``, offset
+    ``pos % block_size``. Physical block 0 is the NULL page — unallocated
+    table entries point at it, so dead slots' ride-along writes and
+    gathers of never-written positions land in a sacrificial page whose
+    garbage the validity mask zeroes out of every softmax.
+
+    The write is a 2-element scatter per row; attention gathers the
+    slot's pages back into a [B, heads, max_blocks*block_size, hd] view
+    and runs the exact contiguous einsum over it — index arrays, not
+    shapes, so slots joining/leaving/evicting never recompile. Returns
+    ``(out [B,1,H], k_pool, v_pool)``.
+    """
+    block_size = k_pool.shape[1]
+    max_blocks = block_tables.shape[1]
+    b = hidden_states.shape[0]
+
+    rows = jnp.arange(b)
+    block_idx = jnp.minimum(positions // block_size, max_blocks - 1)
+    phys = block_tables[rows, block_idx]  # [B]
+    offs = positions % block_size  # [B]
+
+    def commit(k_new, v_new):
+        kp = k_pool.at[phys, offs, :, :].set(k_new.astype(k_pool.dtype))
+        vp = v_pool.at[phys, offs, :, :].set(v_new.astype(v_pool.dtype))
+        # gather each slot's pages into the contiguous logical view the
+        # shared core attends over: [B, MB, bs, heads, hd] -> [B, heads,
+        # MB*bs, hd] (transposed to the contiguous cache's layout so the
+        # einsum contraction is the same HLO, hence bitwise)
+        k_full = kp[block_tables].reshape(
+            b, max_blocks * block_size, kp.shape[2], kp.shape[3]
+        ).transpose(0, 2, 1, 3)
+        v_full = vp[block_tables].reshape(
+            b, max_blocks * block_size, vp.shape[2], vp.shape[3]
+        ).transpose(0, 2, 1, 3)
+        return k_full, v_full, (kp, vp)
+
+    x, (kp, vp) = _decode_block_core(cfg, p, hidden_states, positions, commit)
+    return x, kp, vp
+
+
+def transformer_block_prefill_paged(
+    cfg: DeepSpeedTransformerConfig,
+    p: dict,
+    hidden_states,
+    k_pool,
+    v_pool,
+    block_tables,
+    start_pos,
+):
+    """Suffix prefill through one block against cached prefix pages: the
+    CROSS-REQUEST PREFIX CACHE's compute-skip path (docs/inference.md).
+
+    ``hidden_states`` [B, S, H] holds the prompt's UNIQUE SUFFIX (padded
+    to a fixed bucket), whose first token sits at absolute position
+    ``start_pos`` [B] — the length of the shared, already-cached prefix
+    (always a whole number of pages). The block projects qkv for the
+    suffix tokens, writes their k/v into the slot's own pages, and runs
+    causal attention over the ENTIRE gathered page view — cached prefix
+    pages (computed once by whichever request was cold first) plus the
+    suffix's just-written pages — so a templated prompt pays compute for
+    its unique tail only. Eval-mode arithmetic mirroring
+    :func:`transformer_block_apply`; padding rows write beyond the prompt
+    into positions later overwritten by decode (and masked until then).
+    Returns ``(out [B,S,H], k_pool, v_pool)``.
+    """
     H = cfg.hidden_size
     heads = cfg.heads
     head_dim = H // heads
-    b = hidden_states.shape[0]
-    max_len = k_cache.shape[2]
+    b, s, _ = hidden_states.shape
+    block_size = k_pool.shape[1]
+    max_blocks = block_tables.shape[1]
+    kv_len = max_blocks * block_size
 
     def ln(x, scale, bias):
         return layer_norm_apply(cfg, x, scale, bias)
 
-    # ---- attention sublayer, incremental ------------------------------
+    # ---- attention sublayer ------------------------------------------
     residual = hidden_states
     attn_in = (
         ln(hidden_states, p["attn_nw"], p["attn_nb"])
         if cfg.pre_layer_norm else hidden_states
     )
-    qkv = attn_in @ p["attn_qkvw"] + p["attn_qkvb"]  # [B, 1, 3H]
+    qkv = attn_in @ p["attn_qkvw"] + p["attn_qkvb"]  # [B, S, 3H]
     q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, heads, head_dim)
-    k_new = k_new.reshape(b, heads, head_dim)
-    v_new = v_new.reshape(b, heads, head_dim)
 
-    # scatter this token's k/v into the cache at its position (advanced
-    # indexing pairs the two [B] index arrays, so row i writes
-    # cache[i, :, positions[i]]); positions are clamped by the caller's
-    # length accounting, and jit scatter drops OOB writes anyway
-    rows = jnp.arange(b)
-    k_cache = k_cache.at[rows, :, positions, :].set(
-        k_new.astype(k_cache.dtype)
-    )
-    v_cache = v_cache.at[rows, :, positions, :].set(
-        v_new.astype(v_cache.dtype)
-    )
+    def split_heads(t):
+        return t.reshape(b, s, heads, head_dim).transpose(0, 2, 1, 3)
 
-    # [B, heads, max_len] scores in f32 (MXU-accumulate dtype discipline
-    # of ops/attention.py); future positions masked by validity, so the
-    # garbage beyond each row's length never contributes
+    qh = split_heads(q)  # [B, heads, S, hd]
+
+    # absolute position of each suffix row, its page, and its offset
+    positions = start_pos[:, None] + jax.lax.broadcasted_iota(
+        jnp.int32, (b, s), 1
+    )  # [B, S]
+    block_idx = jnp.minimum(positions // block_size, max_blocks - 1)
+    phys = jnp.take_along_axis(block_tables, block_idx, axis=1)  # [B, S]
+    offs = positions % block_size
+    k_rows = k_new.reshape(b, s, heads, head_dim)  # [B, S, heads, hd]
+    v_rows = v_new.reshape(b, s, heads, head_dim)
+    k_pool = k_pool.at[phys, offs, :, :].set(k_rows.astype(k_pool.dtype))
+    v_pool = v_pool.at[phys, offs, :, :].set(v_rows.astype(v_pool.dtype))
+
+    # gather prefix + suffix pages into the logical view and attend
+    # causally: suffix row j (absolute position start+j) sees key
+    # positions <= start+j — the cached prefix in full, the suffix up to
+    # and including itself
+    k_full = k_pool[block_tables].reshape(
+        b, kv_len, heads, head_dim
+    ).transpose(0, 2, 1, 3)  # [B, heads, K, hd]
+    v_full = v_pool[block_tables].reshape(
+        b, kv_len, heads, head_dim
+    ).transpose(0, 2, 1, 3)
     sm_scale = 1.0 / (head_dim ** 0.5)
-    s = jnp.einsum(
-        "bhd,bhkd->bhk", q, k_cache, preferred_element_type=jnp.float32
+    scores = jnp.einsum(
+        "bhsd,bhkd->bhsk", qh, k_full, preferred_element_type=jnp.float32
     ) * sm_scale
-    valid = (
-        jax.lax.broadcasted_iota(jnp.int32, (b, 1, max_len), 2)
-        <= positions[:, None, None]
-    )
-    s = jnp.where(valid, s, NEG_INF)
-    probs = jax.nn.softmax(s, axis=-1)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, kv_len), 3)
+    valid = kpos <= positions[:, None, :, None]  # [B, 1, S, K]
+    scores = jnp.where(valid, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
     ctx = jnp.einsum(
-        "bhk,bhkd->bhd", probs.astype(v_cache.dtype), v_cache
+        "bhsk,bhkd->bhsd", probs.astype(v_full.dtype), v_full
     )
-    ctx = ctx.reshape(b, 1, H)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, H)
     attn_out = ctx @ p["attn_ow"] + p["attn_ob"]
     x = residual + attn_out
     if not cfg.pre_layer_norm:
         x = ln(x, p["attn_nw"], p["attn_nb"])
 
-    # ---- feed-forward sublayer (identical to the training block) ------
+    # ---- feed-forward sublayer ---------------------------------------
     residual = x
     ff_in = ln(x, p["norm_w"], p["norm_b"]) if cfg.pre_layer_norm else x
     h = ff_in @ p["inter_w"] + p["inter_b"]
@@ -450,7 +628,7 @@ def transformer_block_decode(
     x = residual + h
     if not cfg.pre_layer_norm:
         x = ln(x, p["norm_w"], p["norm_b"])
-    return x, k_cache, v_cache
+    return x, k_pool, v_pool
 
 
 class DeepSpeedTransformerLayer(nn.Module):

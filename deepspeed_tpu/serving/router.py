@@ -44,7 +44,7 @@ from ..inference.scheduler import (
     REJECT_DRAINING,
     RequestRejected,
 )
-from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS
+from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS, histogram_quantile
 from ..utils.logging import logger
 from .admission import AdmissionController, FleetOverloaded, RateLimited  # noqa: F401  (re-exported)
 
@@ -102,11 +102,20 @@ class RoundRobin:
 class PrefixAffinity:
     """Prompt-prefix-hash affinity over a least-loaded base: identical
     templated prefixes (system prompts, few-shot headers) land on the
-    replica that already served them. ``last_hit`` reports whether the
-    most recent choice was an affinity hit (the router's counter reads
-    it). The affinity map is an LRU bounded at ``max_entries`` —
+    replica that already served them — which, on paged replicas with the
+    cross-request prefix cache (docs/inference.md "Paged KV cache"),
+    means the prefix's pages are physically resident there and the
+    request prefills only its unique suffix. ``last_hit`` reports whether
+    the most recent choice was an affinity hit (the router's counter
+    reads it). The affinity map is an LRU bounded at ``max_entries`` —
     high-cardinality traffic must not grow router memory without bound,
-    and affinity only pays off for recently-hot prefixes anyway."""
+    and affinity only pays off for recently-hot prefixes anyway.
+
+    Capacity-aware: a sticky replica whose snapshot reports an exhausted
+    KV page pool (``kv_blocks_free == 0``) is SKIPPED for this placement
+    — stickiness would bounce off its typed ``capacity`` rejection and
+    fall through anyway; better to re-pin to a replica that can actually
+    hold the request (the affinity entry moves with it)."""
 
     name = "prefix_affinity"
 
@@ -125,8 +134,10 @@ class PrefixAffinity:
     def choose(self, candidates, prompt_tokens):
         key = self._key(prompt_tokens)
         sticky = self._affinity.get(key)
-        for rid, _snap in candidates:
+        for rid, snap in candidates:
             if rid == sticky:
+                if snap.get("kv_blocks_free", 1) <= 0:
+                    break  # out of KV pages: re-pin below
                 self._affinity.move_to_end(key)
                 self.last_hit = True
                 return rid
@@ -156,24 +167,9 @@ PLACEMENT_POLICIES = {
 }
 
 
-def _histogram_quantile(hist, q):
-    """Linear-interpolated quantile from a fixed-bucket histogram (the
-    Prometheus histogram_quantile estimate). 0.0 with no observations."""
-    counts = hist.bucket_counts
-    total = sum(counts)
-    if total == 0:
-        return 0.0
-    rank = q * total
-    cumulative = 0
-    lower = 0.0
-    for i, upper in enumerate(hist.thresholds):
-        prev = cumulative
-        cumulative += counts[i]
-        if cumulative >= rank:
-            frac = (rank - prev) / max(counts[i], 1)
-            return lower + (upper - lower) * frac
-        lower = upper
-    return hist.thresholds[-1]  # +Inf bucket: clamp to the last edge
+# moved to telemetry/registry.py (bench.py --infer shares it); the old
+# name stays importable for existing callers
+_histogram_quantile = histogram_quantile
 
 
 # ---------------------------------------------------------------------------
@@ -685,6 +681,8 @@ class FleetRouter:
         total_queue = 0
         total_active = 0
         available = 0
+        prefix_hits = 0
+        prefix_lookups = 0
         routable = self._routable_ids()
         for rid in self._order:
             if rid in self._evicted:
@@ -703,6 +701,23 @@ class FleetRouter:
                 reg.gauge(f"{prefix}/requests_shed").set(
                     snap["requests_shed"]
                 )
+                if "prefix_hit_rate" in snap:
+                    # paged replicas report their REAL prefix-cache
+                    # effectiveness — the ground truth behind the
+                    # router-side affinity_hits counter (a placement hit
+                    # only pays off when the replica actually reuses the
+                    # pages)
+                    reg.gauge(f"{prefix}/prefix_hit_rate").set(
+                        snap["prefix_hit_rate"]
+                    )
+                    reg.gauge(f"{prefix}/kv_blocks_free").set(
+                        snap.get("kv_blocks_free", 0)
+                    )
+                    prefix_hits += snap.get("prefix_hits", 0)
+                    prefix_lookups += (
+                        snap.get("prefix_hits", 0)
+                        + snap.get("prefix_misses", 0)
+                    )
                 total_queue += snap["queue_depth"]
                 total_active += snap["active_slots"]
                 # degraded replicas still take priority-0 traffic, so
@@ -716,8 +731,11 @@ class FleetRouter:
             len(self._order) - len(self._evicted)
         )
         reg.gauge("fleet/replicas_available").set(available)
-        self._ttft_p50.set(_histogram_quantile(self._ttft, 0.50))
-        self._ttft_p99.set(_histogram_quantile(self._ttft, 0.99))
+        reg.gauge("fleet/prefix_hit_rate").set(
+            prefix_hits / prefix_lookups if prefix_lookups else 0.0
+        )
+        self._ttft_p50.set(histogram_quantile(self._ttft, 0.50))
+        self._ttft_p99.set(histogram_quantile(self._ttft, 0.99))
         self._last_refresh = self._clock()
         self._refreshes += 1
         if self._telemetry is not None and self._telemetry.enabled:

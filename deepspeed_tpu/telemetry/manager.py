@@ -105,6 +105,14 @@ INFERENCE_METRICS = (
     ("gauge", "infer/health_state", "serving health: 0 healthy, 1 degraded (shedding priority > 0), 2 draining"),
     ("counter", "infer/driver_restarts", "decode-driver auto-restarts from pinned params after a decode crash"),
     ("counter", "infer/requests_shed", "priority > 0 submissions shed at the front door while degraded"),
+    # paged KV cache + cross-request prefix caching (docs/inference.md
+    # "Paged KV cache"; all four stay 0 on a contiguous-cache engine
+    # except kv_cache_bytes, which reports the contiguous cache's size)
+    ("gauge", "infer/kv_pool_occupancy", "KV pages pinned by live requests (paged cache; cached refcount-0 pages are not occupancy)"),
+    ("gauge", "infer/kv_cache_bytes", "device bytes held by the decode KV cache or page pool (k + v)"),
+    ("counter", "infer/prefix_hits", "admissions that reused cached prefix pages (only the unique suffix was prefilled)"),
+    ("counter", "infer/prefix_misses", "admissions that found no cached prefix pages (cold full prefill)"),
+    ("counter", "infer/kv_blocks_reclaimed", "cached refcount-0 pages evicted LRU-first to satisfy new allocations"),
 )
 
 
@@ -129,6 +137,7 @@ SERVING_METRICS = (
     ("counter", "fleet/affinity_hits", "placements that landed on the prompt prefix's affinity replica"),
     ("counter", "fleet/replica_restarts", "replica restarts driven by the router (rolling_restart or explicit restart)"),
     ("counter", "fleet/replicas_evicted", "replicas evicted after their decode driver failed past its restart budget"),
+    ("gauge", "fleet/prefix_hit_rate", "fleet-wide prefix-cache hit rate (sum of replica hits / lookups at the last refresh; 0 with no paged replicas)"),
 )
 
 

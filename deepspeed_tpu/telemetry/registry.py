@@ -116,6 +116,29 @@ class Histogram:
         return tuple(self._counts)
 
 
+def histogram_quantile(hist, q):
+    """Linear-interpolated quantile from a fixed-bucket :class:`Histogram`
+    (the Prometheus ``histogram_quantile`` estimate). 0.0 with no
+    observations; observations in the +Inf bucket clamp to the last
+    finite edge. Shared by the fleet router's TTFT p50/p99 gauges and
+    ``bench.py --infer``'s p99 token latency."""
+    counts = hist.bucket_counts
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cumulative = 0
+    lower = 0.0
+    for i, upper in enumerate(hist.thresholds):
+        prev = cumulative
+        cumulative += counts[i]
+        if cumulative >= rank:
+            frac = (rank - prev) / max(counts[i], 1)
+            return lower + (upper - lower) * frac
+        lower = upper
+    return hist.thresholds[-1]  # +Inf bucket: clamp to the last edge
+
+
 class MetricsRegistry:
     """Thread-safe get-or-create registry of the three instrument kinds."""
 

@@ -48,6 +48,11 @@ def main():
                 "max_batch_slots": 4,
                 "max_seq_len": min(128, cfg.n_positions),
                 "prefill_len": 32,
+                # paged KV cache: each tenant's 16-token template is one
+                # full page, prefilled once per replica and shared by
+                # reference across every later request that carries it
+                # (docs/inference.md "Paged KV cache")
+                "kv_block_size": 16,
                 "sampling": {"greedy": True},
             }},
         )
@@ -69,12 +74,13 @@ def main():
     )
 
     # each tenant class has its own templated prefix (its "system
-    # prompt"): prefix affinity pins each template to ONE replica — the
-    # seam a cross-request prefix cache would exploit — while distinct
-    # templates spread over the fleet by load
+    # prompt"): prefix affinity pins each template to ONE replica, whose
+    # paged prefix cache then prefills it once and serves every later
+    # request's unique tail from shared pages — distinct templates
+    # spread over the fleet by load
     prefixes = {
-        "paid": [int(t) for t in rng.integers(0, cfg.vocab_size, 8)],
-        "free": [int(t) for t in rng.integers(0, cfg.vocab_size, 8)],
+        "paid": [int(t) for t in rng.integers(0, cfg.vocab_size, 16)],
+        "free": [int(t) for t in rng.integers(0, cfg.vocab_size, 16)],
     }
     tenants = ["paid", "paid", "free", "free", "free"]
     results, rejected = {}, []
@@ -127,6 +133,9 @@ def main():
     print(f"fleet TTFT: p50={snap['fleet/ttft_p50_ms']:.0f}ms "
           f"p99={snap['fleet/ttft_p99_ms']:.0f}ms "
           f"(n={snap['fleet/ttft_ms/count']:.0f})")
+    print(f"prefix cache: fleet hit rate "
+          f"{snap['fleet/prefix_hit_rate']:.2f} (suffix-only prefills "
+          f"on the replicas that hold each tenant's template pages)")
     router.shutdown()
 
 

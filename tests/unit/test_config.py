@@ -392,6 +392,56 @@ def test_inference_self_healing_rejects(block):
 
 
 # ---------------------------------------------------------------------------
+# paged KV cache + prefix cache keys (docs/inference.md "Paged KV cache")
+# ---------------------------------------------------------------------------
+def test_paged_kv_defaults_are_contiguous():
+    cfg = make({"train_batch_size": 8})
+    assert cfg.inference_kv_block_size == 0
+    assert cfg.inference_kv_pool_blocks == 0
+    assert cfg.inference_prefix_cache_enabled is None
+    assert cfg.inference_prefix_cache_suffix_buckets is None
+
+
+def test_paged_kv_valid_block_parses():
+    cfg = _inf({"max_seq_len": 256, "kv_block_size": 32,
+                "kv_pool_blocks": 40,
+                "prefix_cache": {"enabled": True,
+                                 "suffix_buckets": [16, 32, 64]}})
+    assert cfg.inference_kv_block_size == 32
+    assert cfg.inference_kv_pool_blocks == 40
+    assert cfg.inference_prefix_cache_enabled is True
+    assert cfg.inference_prefix_cache_suffix_buckets == [16, 32, 64]
+
+
+@pytest.mark.parametrize("block", [
+    {"kv_block_size": -1},
+    {"kv_block_size": 16.0},
+    {"kv_block_size": True},
+    {"kv_pool_blocks": -4},
+    {"kv_pool_blocks": "many"},
+    {"kv_pool_blocks": 8},                     # pool without a page size
+    {"max_seq_len": 100, "kv_block_size": 32}, # not a multiple
+    {"prefix_cache": {"enabled": True}},       # prefix cache needs paging
+    {"prefix_cache": {"suffix_buckets": [16]}},  # buckets need paging too
+    {"kv_block_size": 32, "max_seq_len": 64,
+     "prefix_cache": {"enabled": "yes"}},
+    {"kv_block_size": 32, "max_seq_len": 64,
+     "prefix_cache": {"suffix_buckets": []}},
+    {"kv_block_size": 32, "max_seq_len": 64,
+     "prefix_cache": {"suffix_buckets": [64, 16]}},   # not ascending
+    {"kv_block_size": 32, "max_seq_len": 64,
+     "prefix_cache": {"suffix_buckets": [0, 16]}},
+    {"kv_block_size": 32, "max_seq_len": 64,
+     "prefix_cache": {"suffix_buckets": 32}},
+])
+def test_paged_kv_rejects(block):
+    from deepspeed_tpu.config.config import DeepSpeedConfigError
+
+    with pytest.raises(DeepSpeedConfigError):
+        _inf(block)
+
+
+# ---------------------------------------------------------------------------
 # serving block: fleet size, placement, admission limits (docs/serving.md)
 # ---------------------------------------------------------------------------
 def _srv(block):

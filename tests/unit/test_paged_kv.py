@@ -1,0 +1,535 @@
+"""Paged KV cache + cross-request prefix caching (docs/inference.md
+"Paged KV cache"): bitwise greedy parity against the contiguous path
+(prefill logits, 16-step decode, mid-flight joins, EOS slot reuse), the
+no-recompile pin on the paged path, BlockPool refcount exactness under
+sharing + LRU eviction, the typed REJECT_CAPACITY admission gate, and
+the prefix-hit suffix-prefill path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.config.config import DeepSpeedConfigError
+from deepspeed_tpu.inference import (
+    REJECT_CAPACITY,
+    BlockPool,
+    PoolExhausted,
+    RequestRejected,
+    gpt2_decode_step,
+    gpt2_decode_step_paged,
+    gpt2_prefill,
+    hash_full_blocks,
+    init_kv_cache,
+    init_kv_pool,
+    write_prefill_to_cache,
+    write_prefill_to_pool,
+)
+from deepspeed_tpu.models.gpt2 import (
+    GPT2Config,
+    GPT2LMHeadModel,
+    kv_pool_partition_specs,
+)
+
+VOCAB = 97
+
+
+def _small_model(seed=0, **kw):
+    cfg = GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False, **kw,
+    )
+    model = GPT2LMHeadModel(cfg)
+    ids0 = jnp.asarray(
+        np.random.default_rng(seed).integers(0, VOCAB, (1, 8)), jnp.int32
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(seed),
+         "dropout": jax.random.PRNGKey(seed + 1)},
+        ids0, ids0,
+    )["params"]
+    return cfg, model, params
+
+
+def _engine(model, params, inference=None):
+    block = {"max_batch_slots": 4, "max_seq_len": 48, "prefill_len": 32,
+             "kv_block_size": 8, "sampling": {"greedy": True}}
+    block.update(inference or {})
+    if block.get("kv_block_size") == 0:
+        block.pop("kv_block_size")
+    return deepspeed_tpu.init_inference(
+        model=model, model_parameters=params,
+        config={"inference": block},
+    )
+
+
+def _prompt(n=8, seed=1):
+    return [int(t) for t in np.random.default_rng(seed).integers(0, VOCAB, n)]
+
+
+# ---------------------------------------------------------------------------
+# BlockPool: refcount exactness, sharing, eviction
+# ---------------------------------------------------------------------------
+def test_block_pool_alloc_exactness_and_exhaustion():
+    pool = BlockPool(4, block_size=8)
+    a = pool.alloc(3)
+    assert len(set(a)) == 3 and 0 not in a  # never the null page
+    assert pool.free_blocks == 1 and pool.used_blocks == 3
+    with pytest.raises(PoolExhausted) as exc:
+        pool.alloc(2)  # all-or-nothing: nothing handed out
+    assert exc.value.needed == 2 and exc.value.available == 1
+    assert pool.free_blocks == 1 and pool.used_blocks == 3
+    pool.release(a)
+    assert pool.free_blocks == 4 and pool.used_blocks == 0
+
+
+def test_block_pool_double_free_raises():
+    pool = BlockPool(2, block_size=4)
+    (b,) = pool.alloc(1)
+    pool.release([b])
+    with pytest.raises(ValueError, match="double free"):
+        pool.release([b])
+
+
+def test_block_pool_prefix_sharing_refcounts_exact():
+    """Two requests sharing a prefix hold ONE set of physical pages;
+    releases decref precisely, and the pages survive as cached until the
+    last reference plus the registry eviction are gone."""
+    pool = BlockPool(8, block_size=4)
+    prompt = list(range(11))  # 2 full pages + 3-token tail
+    # request A, cold: needs 3 pages, registers its 2 full ones
+    a_blocks = pool.alloc(3)
+    pool.register_prefix(prompt, a_blocks)
+    # request B, same prompt: matches both full pages
+    prefix_len, shared = pool.match_prefix(prompt)
+    assert prefix_len == 8 and shared == a_blocks[:2]
+    assert pool.refcount(shared[0]) == 2 and pool.refcount(shared[1]) == 2
+    b_blocks = shared + pool.alloc(1)
+    # A finishes: shared pages drop to one reference, stay pinned
+    pool.release(a_blocks)
+    assert pool.refcount(shared[0]) == 1
+    assert pool.used_blocks == 3  # B's three pages
+    # B finishes: registered pages park in the evictable LRU, private
+    # tail pages free outright
+    pool.release(b_blocks)
+    assert pool.used_blocks == 0
+    assert pool.cached_blocks == 2
+    # the cached prefix is still matchable (re-acquire pins it again)
+    prefix_len, again = pool.match_prefix(prompt)
+    assert prefix_len == 8 and again == shared
+    pool.release(again)
+
+
+def test_block_pool_lru_eviction_under_pressure():
+    pool = BlockPool(2, block_size=4)
+    p1, p2 = [0, 1, 2, 3, 99], [7, 6, 5, 4, 99]
+    b1 = pool.alloc(1)
+    pool.register_prefix(p1, b1)
+    pool.release(b1)
+    b2 = pool.alloc(1)
+    pool.register_prefix(p2, b2)
+    pool.release(b2)
+    assert pool.cached_blocks == 2 and pool.available_blocks == 2
+    # allocating 1 evicts the LRU entry (p1's page, cached first)
+    pool.alloc(1)
+    assert pool.reclaimed == 1
+    assert pool.match_prefix(p1) == (0, [])  # evicted
+    got = pool.match_prefix(p2)
+    assert got[0] == 4  # survivor still cached
+    pool.release(got[1])
+
+
+def test_block_pool_never_matches_whole_prompt():
+    """A prompt that is exactly N full pages may share at most N-1: the
+    last token's logits must be computed to seed generation."""
+    pool = BlockPool(4, block_size=4)
+    prompt = list(range(8))  # exactly 2 pages
+    blocks = pool.alloc(2)
+    pool.register_prefix(prompt, blocks)
+    prefix_len, shared = pool.match_prefix(prompt)
+    assert prefix_len == 4 and shared == blocks[:1]
+    pool.release(shared)
+    pool.release(blocks)
+
+
+def test_hash_chain_commits_to_whole_prefix():
+    a = hash_full_blocks([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    b = hash_full_blocks([9, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert len(a) == 2 and len(b) == 2
+    assert a[0] != b[0]
+    # identical second page, different first page => different chain hash
+    assert a[1] != b[1]
+    assert a == hash_full_blocks([1, 2, 3, 4, 5, 6, 7, 8], 4)
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity with the contiguous path
+# ---------------------------------------------------------------------------
+def test_paged_decode_logits_bitwise_match_contiguous():
+    """Acceptance pin: prefill written through pages, then 16 paged
+    decode steps — every step's logits BITWISE-equal to the contiguous
+    cache's (same shared decode core, same einsum HLO, masked garbage
+    contributing exact zeros)."""
+    cfg, model, params = _small_model()
+    prompt = _prompt(11)
+    plen, bs, max_len, slots = len(prompt), 8, 32, 2
+    prefill_len = 16
+    padded = np.zeros((1, prefill_len), np.int32)
+    padded[0, :plen] = prompt
+    logits, ks, vs = jax.jit(
+        lambda p, t: gpt2_prefill(cfg, p, t)
+    )(params, jnp.asarray(padded))
+
+    cache = write_prefill_to_cache(
+        init_kv_cache(cfg, slots, max_len), jnp.int32(0), ks, vs
+    )
+    pool = init_kv_pool(cfg, 6, bs)
+    table = np.zeros((slots, max_len // bs), np.int32)
+    table[0] = [1, 2, 3, 4]  # covers prompt + 16 generated tokens
+    block_ids = np.zeros(prefill_len, np.int32)
+    block_ids[:plen] = [table[0][j // bs] for j in range(plen)]
+    pool = write_prefill_to_pool(
+        pool, ks, vs, jnp.asarray(block_ids),
+        jnp.asarray(np.arange(prefill_len, dtype=np.int32) % bs),
+    )
+
+    jd_c = jax.jit(lambda p, t, po, c: gpt2_decode_step(cfg, p, t, po, c))
+    jd_p = jax.jit(
+        lambda p, t, po, pl, bt: gpt2_decode_step_paged(cfg, p, t, po, pl, bt)
+    )
+    first = int(jnp.argmax(logits[0, plen - 1, :VOCAB]))
+    toks = np.zeros(slots, np.int32)
+    pos = np.zeros(slots, np.int32)
+    toks[0], pos[0] = first, plen
+    for _ in range(16):
+        lc, cache = jd_c(params, jnp.asarray(toks), jnp.asarray(pos), cache)
+        lp, pool = jd_p(
+            params, jnp.asarray(toks), jnp.asarray(pos), pool,
+            jnp.asarray(table),
+        )
+        np.testing.assert_array_equal(np.asarray(lc[0]), np.asarray(lp[0]))
+        toks[0] = int(jnp.argmax(lc[0, :VOCAB]))
+        pos[0] += 1
+
+
+def test_paged_engine_matrix_matches_contiguous():
+    """Engine-level parity matrix: concurrent mixed-length requests,
+    a mid-flight join, and EOS slot reuse all produce exactly the
+    contiguous engine's greedy tokens."""
+    cfg, model, params = _small_model()
+    e_c = _engine(model, params, {"kv_block_size": 0})
+    e_p = _engine(model, params)
+    try:
+        prompts = [_prompt(9, 1), _prompt(5, 2), _prompt(13, 3)]
+        assert e_c.generate(prompts, max_new_tokens=10) == \
+            e_p.generate(prompts, max_new_tokens=10)
+
+        # mid-flight join
+        r1c = e_c.submit(_prompt(8, 4), max_new_tokens=12)
+        r1p = e_p.submit(_prompt(8, 4), max_new_tokens=12)
+        for _ in range(4):
+            e_c.scheduler.step()
+            e_p.scheduler.step()
+        r2c = e_c.submit(_prompt(7, 5), max_new_tokens=8)
+        r2p = e_p.submit(_prompt(7, 5), max_new_tokens=8)
+        e_c.scheduler.run_until_idle()
+        e_p.scheduler.run_until_idle()
+        assert r1c.result(0) == r1p.result(0)
+        assert r2c.result(0) == r2p.result(0)
+
+        # EOS slot reuse: finish one request via EOS, reuse its pages
+        ref = e_c.generate([_prompt(8, 6)], max_new_tokens=8)[0]
+        eos = ref[3]
+        ac = e_c.submit(_prompt(8, 6), max_new_tokens=8, eos_token_id=eos)
+        ap = e_p.submit(_prompt(8, 6), max_new_tokens=8, eos_token_id=eos)
+        e_c.scheduler.run_until_idle()
+        e_p.scheduler.run_until_idle()
+        assert ac.finish_reason == ap.finish_reason == "eos"
+        assert ac.result(0) == ap.result(0)
+        assert e_c.generate([_prompt(6, 9)], max_new_tokens=6) == \
+            e_p.generate([_prompt(6, 9)], max_new_tokens=6)
+    finally:
+        e_c.close()
+        e_p.close()
+
+
+def test_paged_decode_steps_do_not_recompile():
+    """The no-recompile pin holds on the paged path: joins, leaves, page
+    reuse, and warm prefix hits add zero XLA backend compiles."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params)
+    try:
+        recompiles = engine.metrics.counter("jax/recompiles")
+        engine.generate([_prompt(8)], max_new_tokens=4)
+        # warm the prefix-hit suffix program (one bucket)
+        shared = _prompt(16, 7)
+        engine.generate([shared + _prompt(3, 8)], max_new_tokens=4)
+        engine.generate([shared + _prompt(3, 9)], max_new_tokens=4)
+        warm = recompiles.value
+        assert warm > 0
+
+        r1 = engine.submit(_prompt(5, 5), max_new_tokens=6)
+        engine.scheduler.step()
+        r2 = engine.submit(_prompt(11, 6), max_new_tokens=5)
+        r3 = engine.submit(shared + _prompt(2, 10), max_new_tokens=4)
+        engine.scheduler.run_until_idle()
+        assert all(r.done for r in (r1, r2, r3))
+        assert recompiles.value == warm, (
+            f"paged decode path recompiled: {recompiles.value - warm} new "
+            "backend compiles after warmup"
+        )
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# prefix cache at the engine level
+# ---------------------------------------------------------------------------
+def test_prefix_hit_counts_and_matches_cold_generation():
+    cfg, model, params = _small_model()
+    engine = _engine(model, params)
+    cold_engine = _engine(model, params, {"prefix_cache": {"enabled": False}})
+    try:
+        shared = _prompt(16, 7)  # two full pages at kv_block_size=8
+        pa = shared + _prompt(4, 8)
+        pb = shared + _prompt(4, 9)
+        engine.generate([pa], max_new_tokens=6)
+        snap0 = engine.metrics.snapshot()
+        hot = engine.generate([pb], max_new_tokens=6)[0]
+        snap1 = engine.metrics.snapshot()
+        assert snap1["infer/prefix_hits"] == snap0["infer/prefix_hits"] + 1
+        assert snap0["infer/prefix_misses"] >= 1  # the cold admission
+        assert hot == cold_engine.generate([pb], max_new_tokens=6)[0]
+    finally:
+        engine.close()
+        cold_engine.close()
+
+
+def test_engine_refcounts_exact_under_concurrent_sharing():
+    """Two live requests share prefix pages (refcount 2 on device-backed
+    pages); finishing one keeps the other decoding correctly; finishing
+    both leaves zero pinned pages and a warm cache."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params)
+    try:
+        shared = _prompt(16, 7)
+        pa, pb = shared + _prompt(4, 8), shared + _prompt(5, 9)
+        # a cold pass registers the template's two full pages
+        engine.generate([shared + _prompt(3, 10)], max_new_tokens=2)
+        ra = engine.submit(pa, max_new_tokens=10)
+        rb = engine.submit(pb, max_new_tokens=4)
+        engine.scheduler.step()  # both admitted: prefix pages shared
+        shared_pages = engine.block_pool._registry.values()
+        assert all(
+            engine.block_pool.refcount(b) == 2 for b in shared_pages
+        )
+        engine.scheduler.run_until_idle()  # rb finishes first (4 tokens)
+        assert ra.result(0) and rb.result(0)
+        assert engine.block_pool.used_blocks == 0
+        assert engine.metrics.gauge("infer/kv_pool_occupancy").value == 0
+        # the finished requests' outputs match a fresh engine's
+        check = _engine(model, params, {"prefix_cache": {"enabled": False}})
+        assert ra.tokens == check.generate([pa], max_new_tokens=10)[0]
+        assert rb.tokens == check.generate([pb], max_new_tokens=4)[0]
+        check.close()
+    finally:
+        engine.close()
+
+
+def test_eviction_under_pressure_reclaims_cached_pages():
+    """Filling the pool evicts cached refcount-0 prefix pages LRU-first
+    (counted on infer/kv_blocks_reclaimed) and the evicted prefix simply
+    misses on its next use — no correctness impact."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {"kv_pool_blocks": 6})
+    try:
+        shared = _prompt(16, 7)  # caches 2 pages once finished
+        out1 = engine.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        assert engine.block_pool.cached_blocks == 2
+        # four concurrent 1-page... (8 tokens prompt + 8 new = 2 pages
+        # each) => 2 requests need 4 pages; free = 4, so eviction bites
+        rs = [engine.submit(_prompt(8, 20 + i), max_new_tokens=8)
+              for i in range(3)]
+        engine.scheduler.run_until_idle()
+        assert all(len(r.result(0)) == 8 for r in rs)
+        snap = engine.metrics.snapshot()
+        assert snap["infer/kv_blocks_reclaimed"] >= 1
+        # evicted template re-serves correctly (cold again)
+        out2 = engine.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        assert out2 == out1
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# capacity admission gate
+# ---------------------------------------------------------------------------
+def test_pool_exhaustion_rejects_with_typed_capacity_reason():
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {
+        "kv_pool_blocks": 2, "max_batch_slots": 2,
+    })
+    try:
+        r = engine.submit(_prompt(8, 1), max_new_tokens=8)  # 2 pages
+        engine.scheduler.step()  # admitted: pool now empty
+        with pytest.raises(RequestRejected) as exc:
+            engine.submit(_prompt(8, 2), max_new_tokens=8)
+        assert exc.value.reason == REJECT_CAPACITY
+        assert engine.metrics.snapshot()["infer/requests_rejected"] == 1
+        engine.scheduler.run_until_idle()
+        assert len(r.result(0)) == 8
+        # pages released: the same submission is admittable again
+        r2 = engine.submit(_prompt(8, 2), max_new_tokens=8)
+        engine.scheduler.run_until_idle()
+        assert len(r2.result(0)) == 8
+    finally:
+        engine.close()
+
+
+def test_request_that_can_never_fit_raises_value_error():
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {
+        "kv_pool_blocks": 2, "max_batch_slots": 2,
+    })
+    try:
+        with pytest.raises(ValueError, match="KV pages"):
+            engine.submit(_prompt(10, 1), max_new_tokens=30)
+    finally:
+        engine.close()
+
+
+def test_admission_defers_until_pages_free_then_completes():
+    """Requests racing past the submit-time gate defer at the slot-join
+    boundary and complete once earlier requests release pages — queue
+    deeper than the pool drains without losses."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {
+        "kv_pool_blocks": 4, "max_batch_slots": 4,
+    })
+    try:
+        rs = [engine.submit(_prompt(8, 30 + i), max_new_tokens=6)
+              for i in range(2)]  # 2 pages each: pool exactly full
+        engine.scheduler.run_until_idle()
+        assert all(len(r.result(0)) == 6 for r in rs)
+        assert engine.block_pool.used_blocks == 0
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# geometry, snapshot, config
+# ---------------------------------------------------------------------------
+def test_kv_pool_layout_and_specs():
+    cfg, model, params = _small_model()
+    pool = init_kv_pool(cfg, 6, 8)
+    # + the null page at physical index 0
+    assert pool.k.shape == (cfg.n_layer, 7, 8, cfg.n_head,
+                            cfg.n_embd // cfg.n_head)
+    assert pool.num_blocks == 7 and pool.block_size == 8
+    spec = kv_pool_partition_specs()
+    assert spec[3] == "model" and spec[1] is None
+
+
+def test_load_snapshot_reports_pool_and_prefix_state():
+    cfg, model, params = _small_model()
+    engine = _engine(model, params)
+    try:
+        shared = _prompt(16, 7)
+        engine.generate([shared + _prompt(4, 8)], max_new_tokens=2)
+        engine.generate([shared + _prompt(4, 9)], max_new_tokens=2)
+        snap = engine.load_snapshot()
+        assert snap["kv_blocks_total"] == engine.block_pool.num_blocks
+        assert snap["kv_blocks_used"] == 0
+        assert snap["prefix_hits"] == 1 and snap["prefix_misses"] == 1
+        assert snap["prefix_hit_rate"] == 0.5
+        assert snap["kv_blocks_free"] > 0
+        bytes_gauge = engine.metrics.gauge("infer/kv_cache_bytes").value
+        assert bytes_gauge == (
+            int(engine._cache.k.nbytes) + int(engine._cache.v.nbytes)
+        )
+    finally:
+        engine.close()
+
+
+def test_engine_rejects_block_size_not_dividing_max_seq():
+    cfg, model, params = _small_model()
+    with pytest.raises(DeepSpeedConfigError, match="multiple"):
+        deepspeed_tpu.init_inference(
+            model=model, model_parameters=params,
+            config={"inference": {"max_seq_len": 48, "kv_block_size": 7}},
+        )
+
+
+def test_long_suffix_falls_back_cold_instead_of_corrupting_pages():
+    """Regression: a hit whose smallest fitting suffix bucket would pad
+    past max_seq_len must fall back to a COLD full prefill — the padded
+    rows' positions would clamp into the slot's real last page and
+    overwrite written prompt k/v (observed as silently wrong
+    generations). Geometry: max_seq=64, bs=16, bucket ladder 16/32/64;
+    template=16, suffix=40 -> bucket 64 pads positions 16..79 > 63."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {
+        "max_seq_len": 64, "prefill_len": 64, "kv_block_size": 16,
+    })
+    ref = _engine(model, params, {
+        "max_seq_len": 64, "prefill_len": 64, "kv_block_size": 0,
+    })
+    try:
+        template = _prompt(16, 7)  # one full 16-token page
+        engine.generate([template + _prompt(4, 8)], max_new_tokens=2)
+        hits0 = engine.metrics.snapshot()["infer/prefix_hits"]
+        long_tail = template + _prompt(40, 9)  # suffix 40: no safe bucket
+        out = engine.generate([long_tail], max_new_tokens=6)[0]
+        snap = engine.metrics.snapshot()
+        assert snap["infer/prefix_hits"] == hits0  # counted as a miss
+        assert out == ref.generate([long_tail], max_new_tokens=6)[0]
+        # a SHORT suffix on the same template still hits and is correct
+        short = template + _prompt(4, 10)
+        out2 = engine.generate([short], max_new_tokens=6)[0]
+        assert engine.metrics.snapshot()["infer/prefix_hits"] == hits0 + 1
+        assert out2 == ref.generate([short], max_new_tokens=6)[0]
+    finally:
+        engine.close()
+        ref.close()
+
+
+def test_user_bucket_list_too_small_falls_back_cold():
+    """Regression: an explicit suffix_buckets list whose largest bucket
+    is smaller than a hit's suffix must not crash (numpy broadcast
+    error through the decode-crash path) — it serves cold instead."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {
+        "max_seq_len": 64, "prefill_len": 64, "kv_block_size": 16,
+        "prefix_cache": {"suffix_buckets": [16]},
+    })
+    try:
+        template = _prompt(16, 7)
+        engine.generate([template + _prompt(4, 8)], max_new_tokens=2)
+        long_tail = template + _prompt(40, 9)
+        out = engine.generate([long_tail], max_new_tokens=4)[0]
+        assert len(out) == 4
+        check = _engine(model, params, {
+            "max_seq_len": 64, "prefill_len": 64, "kv_block_size": 0,
+        })
+        assert out == check.generate([long_tail], max_new_tokens=4)[0]
+        check.close()
+    finally:
+        engine.close()
+
+
+def test_driver_restart_resets_pool_and_serves_on():
+    """After a decode crash past the cache (driver auto-restart), the
+    pool rebuilds empty and subsequent paged requests serve exactly."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {"driver_restart_budget": 1})
+    try:
+        ref = engine.generate([_prompt(8, 1)], max_new_tokens=6)[0]
+        engine.scheduler._recover_driver_crash()
+        assert engine.block_pool.used_blocks == 0
+        assert engine.block_pool.cached_blocks == 0
+        out = engine.generate([_prompt(8, 1)], max_new_tokens=6)[0]
+        assert out == ref
+    finally:
+        engine.close()

@@ -169,6 +169,58 @@ def test_prefix_affinity_hits_and_forgets():
     assert policy.last_hit is False
 
 
+def test_prefix_affinity_skips_sticky_replica_out_of_kv_pages():
+    """A sticky replica whose snapshot reports an exhausted KV page pool
+    is skipped for the placement (it would only bounce the request off
+    its typed 'capacity' rejection) and the affinity entry re-pins."""
+    policy = PrefixAffinity(prefix_tokens=4)
+    prefix = [7, 7, 7, 7]
+    both = [
+        ("0", dict(_IDLE_SNAP, queue_depth=9, kv_blocks_free=8)),
+        ("1", dict(_IDLE_SNAP, queue_depth=0, kv_blocks_free=8)),
+    ]
+    assert policy.choose(both, prefix + [1]) == "1"  # pins to 1
+    starved = [
+        ("0", dict(_IDLE_SNAP, queue_depth=0, kv_blocks_free=8)),
+        ("1", dict(_IDLE_SNAP, queue_depth=9, kv_blocks_free=0)),
+    ]
+    # sticky replica 1 is out of pages: fall through to least-loaded
+    assert policy.choose(starved, prefix + [2]) == "0"
+    assert policy.last_hit is False
+    # the entry moved with the traffic: replica 0 is the new sticky
+    assert policy.choose(starved, prefix + [3]) == "0"
+    assert policy.last_hit is True
+    # snapshots WITHOUT the field (contiguous replicas) keep stickiness
+    legacy = PrefixAffinity(prefix_tokens=4)
+    assert legacy.choose(both, prefix + [1]) == "1"
+    heavy1 = [
+        ("0", dict(_IDLE_SNAP, queue_depth=0)),
+        ("1", dict(_IDLE_SNAP, queue_depth=9)),
+    ]
+    assert legacy.choose(heavy1, prefix + [2]) == "1"
+    assert legacy.last_hit is True
+
+
+def test_router_mirrors_replica_prefix_cache_gauges():
+    """Paged replicas' prefix_hit_rate / kv_blocks_free land on the
+    per-replica fleet gauges and aggregate into fleet/prefix_hit_rate."""
+    a = StubReplica("0", snapshot={
+        "prefix_hits": 3, "prefix_misses": 1, "prefix_hit_rate": 0.75,
+        "kv_blocks_free": 5, "kv_blocks_total": 8, "kv_blocks_used": 3,
+    }, autofinish=[1])
+    b = StubReplica("1", autofinish=[2])  # contiguous: no kv fields
+    router = _stub_router([a, b])
+    try:
+        router.refresh_telemetry()
+        snap = router.metrics.snapshot()
+        assert snap["fleet/replica0/prefix_hit_rate"] == 0.75
+        assert snap["fleet/replica0/kv_blocks_free"] == 5
+        assert "fleet/replica1/prefix_hit_rate" not in snap
+        assert snap["fleet/prefix_hit_rate"] == 0.75
+    finally:
+        router.shutdown()
+
+
 def test_router_prefix_affinity_counts_hits():
     a = StubReplica("0", autofinish=[1])
     b = StubReplica("1", autofinish=[2])
