@@ -25,7 +25,7 @@ from .ops.optimizers import Adam, Lamb, Lion, Optimizer, SGD
 from .ops.transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
 from .runtime.engine import DeepSpeedEngine
 from .version import __version__
-from . import checkpointing
+from . import adapters, checkpointing
 
 
 def initialize(
@@ -172,6 +172,7 @@ __all__ = [
     "init_inference",
     "init_distributed",
     "add_config_arguments",
+    "adapters",
     "checkpointing",
     "DeepSpeedConfig",
     "DeepSpeedEngine",

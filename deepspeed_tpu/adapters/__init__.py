@@ -1,0 +1,49 @@
+"""Multi-tenant LoRA adapters (docs/adapters.md).
+
+One base model, thousands of per-tenant rank-r adapters: the fine-tune
+path freezes the base and optimizes/checkpoints only the A/B pairs
+(runtime/engine.py, ``"adapters"`` config block), and the serving path
+batches MANY adapters through one fixed-shape decode program via an
+in-HBM adapter pool + per-slot adapter ids (inference/engine.py).
+Anchors: LoRA (Hu et al.), S-LoRA, Punica — PAPERS.md "Adapters".
+"""
+
+from .lora import (
+    LORA_TARGET_DIMS,
+    LORA_TARGET_PARALLEL,
+    LORA_TARGETS,
+    adapter_host_template,
+    adapter_layer_stacks,
+    adapter_num_params,
+    init_lora_params,
+    is_lora_name,
+    lora_scaling,
+    merge_lora_params,
+    resolve_lora_targets,
+    split_lora_params,
+)
+from .pool import (
+    IDENTITY_ADAPTER,
+    AdapterPool,
+    AdapterPoolFull,
+    AdapterUnavailable,
+)
+
+__all__ = [
+    "LORA_TARGETS",
+    "LORA_TARGET_DIMS",
+    "LORA_TARGET_PARALLEL",
+    "AdapterPool",
+    "AdapterPoolFull",
+    "AdapterUnavailable",
+    "IDENTITY_ADAPTER",
+    "adapter_host_template",
+    "adapter_layer_stacks",
+    "adapter_num_params",
+    "init_lora_params",
+    "is_lora_name",
+    "lora_scaling",
+    "merge_lora_params",
+    "resolve_lora_targets",
+    "split_lora_params",
+]

@@ -1,0 +1,225 @@
+"""LoRA adapter parameter trees: init, split/merge, pool stacking.
+
+The low-rank math itself lives in ``ops/transformer.py`` (``apply_lora``
+inside every block path); this module owns the PYTREE surgery around it:
+
+  init   — :func:`init_lora_params` grows a fresh adapter tree beside an
+           existing base tree (A ~ N(0, std), B = 0: the initial delta is
+           exactly zero, so fine-tuning starts from the base model).
+  split  — :func:`split_lora_params` separates a mixed tree into
+           ``(base, adapters)`` by the ``*_lora_a`` / ``*_lora_b`` leaf
+           names. The training engine freezes the base tree and feeds
+           ONLY the adapter tree to the optimizer/ZeRO/checkpoint
+           machinery — which is the whole reason adapter checkpoints are
+           tiny and the base stays bitwise-frozen (docs/adapters.md).
+  merge  — :func:`merge_lora_params` overlays adapters back onto the
+           base inside the loss closure (pure dict ops, jit-safe).
+  stacks — :func:`adapter_layer_stacks` pulls a fine-tuned adapter tree
+           apart into the ``{target: (A, B)}`` row layout the serving
+           engine writes into its in-HBM adapter pool.
+
+Works on any pytree-of-dicts whose leaf names follow the transformer's
+param layout — arrays and PartitionSpec trees alike (the engine splits
+its model-parallel specs with the same function it splits params with).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.transformer import (  # noqa: F401  (re-exported)
+    LORA_TARGET_DIMS,
+    LORA_TARGET_PARALLEL,
+    LORA_TARGETS,
+    lora_scaling,
+    resolve_lora_targets,
+)
+
+_LORA_SUFFIXES = ("_lora_a", "_lora_b")
+
+
+def is_lora_name(name):
+    """True for the adapter leaf names the flax layer creates."""
+    return str(name).endswith(_LORA_SUFFIXES)
+
+
+def split_lora_params(tree):
+    """Split a nested-dict pytree into ``(base, adapters)`` by leaf name.
+
+    Both outputs keep the original nesting (empty subtrees dropped), so
+    ``merge_lora_params(base, adapters)`` reconstructs the input exactly.
+    Leaves are returned by reference — no copies.
+    """
+    base, adapters = {}, {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            b, a = split_lora_params(v)
+            if b:
+                base[k] = b
+            if a:
+                adapters[k] = a
+        elif is_lora_name(k):
+            adapters[k] = v
+        else:
+            base[k] = v
+    return base, adapters
+
+
+def merge_lora_params(base, adapters):
+    """Overlay an adapter tree onto a base tree (new dicts, shared
+    leaves). Pure python dict traversal over (possibly traced) leaves —
+    safe inside jit, where the training loss closure runs it every
+    micro-step."""
+    if not isinstance(adapters, dict):
+        return adapters
+    out = dict(base)
+    for k, v in adapters.items():
+        cur = out.get(k)
+        if isinstance(cur, dict) and isinstance(v, dict):
+            out[k] = merge_lora_params(cur, v)
+        else:
+            out[k] = v
+    return out
+
+
+def init_lora_params(base_params, rank, targets=None, rng=None,
+                     stddev=0.02, dtype=jnp.float32):
+    """Fresh adapter tree shaped to ``base_params``' layer stacks.
+
+    Every dict in ``base_params`` holding a target matrix (shape
+    ``[*lead, in, out]`` — the scanned stacks carry a leading ``layers``
+    axis) gains ``{target}_lora_a`` ``[*lead, in, rank]`` ~ N(0,
+    ``stddev``) and ``{target}_lora_b`` ``[*lead, rank, out]`` = 0, so
+    the initial delta is exactly zero. RNG folds in a per-target counter
+    — deterministic for a given ``rng``.
+    """
+    rank = int(rank)
+    if rank < 1:
+        raise ValueError(f"LoRA rank must be >= 1, got {rank}")
+    targets = resolve_lora_targets(targets)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    counter = [0]
+
+    def walk(node):
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict):
+                sub = walk(v)
+                if sub:
+                    out[k] = sub
+                continue
+            if k in targets and getattr(v, "ndim", 0) >= 2:
+                shape = tuple(v.shape)
+                counter[0] += 1
+                key = jax.random.fold_in(rng, counter[0])
+                out[f"{k}_lora_a"] = (
+                    jax.random.normal(key, (*shape[:-1], rank), dtype)
+                    * stddev
+                )
+                out[f"{k}_lora_b"] = jnp.zeros(
+                    (*shape[:-2], rank, shape[-1]), dtype
+                )
+        return out
+
+    adapters = walk(base_params)
+    if not adapters:
+        raise ValueError(
+            f"no LoRA target matrices {list(targets)} found in the "
+            "parameter tree — is this a GPT-2/BERT transformer param "
+            "tree (TRANSFORMER_PARAM_LAYOUT names)?"
+        )
+    return adapters
+
+
+def adapter_host_template(base_params, rank, targets=None):
+    """Host-side numpy zeros tree with :func:`init_lora_params`' exact
+    structure/shapes — the ``params_template`` a verified checkpoint
+    load (runtime/checkpointing.load_module_state) maps an adapter-only
+    checkpoint onto. Built from the base leaves' SHAPES alone (no device
+    transfer, no RNG): the serving engine calls this against its pinned
+    device params on every checkpoint-backed ``load_adapter``."""
+    rank = int(rank)
+    if rank < 1:
+        raise ValueError(f"LoRA rank must be >= 1, got {rank}")
+    targets = resolve_lora_targets(targets)
+
+    def walk(node):
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict):
+                sub = walk(v)
+                if sub:
+                    out[k] = sub
+                continue
+            if k in targets and getattr(v, "ndim", 0) >= 2:
+                shape = tuple(v.shape)
+                out[f"{k}_lora_a"] = np.zeros(
+                    (*shape[:-1], rank), np.float32
+                )
+                out[f"{k}_lora_b"] = np.zeros(
+                    (*shape[:-2], rank, shape[-1]), np.float32
+                )
+        return out
+
+    template = walk(base_params)
+    if not template:
+        raise ValueError(
+            f"no LoRA target matrices {list(targets)} found in the "
+            "parameter tree"
+        )
+    return template
+
+
+def adapter_layer_stacks(adapter_tree, targets=None):
+    """Flatten a fine-tuned adapter tree into ``{target: (A, B)}`` pool
+    rows (A ``[layers, in, r]``, B ``[layers, r, out]``) for the serving
+    engine's in-HBM adapter pool. Raises when a target's pair is
+    missing, duplicated across subtrees, or un-stacked (no layers axis —
+    the serving pool is built for the scanned GPT-2/BERT stacks)."""
+    targets = resolve_lora_targets(targets)
+    found = {}
+
+    def walk(node):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                walk(v)
+            elif is_lora_name(k):
+                target, ab = str(k).rsplit("_lora_", 1)
+                if target not in targets:
+                    continue
+                slot = found.setdefault(target, {})
+                if ab in slot:
+                    raise ValueError(
+                        f"adapter tree holds {k!r} in more than one "
+                        "subtree — cannot map it onto one pool row"
+                    )
+                slot[ab] = v
+
+    walk(adapter_tree)
+    out = {}
+    for t in targets:
+        pair = found.get(t, {})
+        if "a" not in pair or "b" not in pair:
+            raise ValueError(
+                f"adapter tree is missing {t}_lora_a/{t}_lora_b "
+                f"(targets {list(targets)}; found "
+                f"{sorted(found)})"
+            )
+        a, b = pair["a"], pair["b"]
+        if getattr(a, "ndim", 0) != 3 or getattr(b, "ndim", 0) != 3:
+            raise ValueError(
+                f"adapter {t} factors must be layer-stacked "
+                f"[layers, dim, rank]; got shapes "
+                f"{getattr(a, 'shape', None)} / {getattr(b, 'shape', None)}"
+            )
+        out[t] = (a, b)
+    return out
+
+
+def adapter_num_params(adapter_tree):
+    """Total adapter parameters (the <2%-of-base bookkeeping number)."""
+    return sum(
+        int(np.prod(leaf.shape))
+        for leaf in jax.tree_util.tree_leaves(adapter_tree)
+    )

@@ -544,6 +544,35 @@ INFERENCE_CHECKPOINT_TAG = "tag"
 INFERENCE_CHECKPOINT_TAG_DEFAULT = None  # None => the 'latest' pointer
 
 #############################################
+# Multi-tenant LoRA adapters (deepspeed_tpu/adapters/, docs/adapters.md):
+# one base model, per-tenant rank-r A/B pairs. In initialize() the block
+# freezes the base and trains/checkpoints ONLY the adapter leaves; in
+# init_inference() it allocates the in-HBM adapter pool that batched
+# multi-LoRA decode gathers per slot (LoRA / S-LoRA / Punica —
+# PAPERS.md "Adapters"). Absent from the reference.
+#############################################
+ADAPTERS = "adapters"
+ADAPTERS_ENABLED = "enabled"
+ADAPTERS_ENABLED_DEFAULT = False
+# Low-rank dimension r of every A [in, r] / B [r, out] pair.
+ADAPTERS_RANK = "rank"
+ADAPTERS_RANK_DEFAULT = 8
+# Delta scaling numerator: delta = (alpha / rank) * x @ A @ B.
+# 0 => alpha = rank (scaling 1.0).
+ADAPTERS_ALPHA = "alpha"
+ADAPTERS_ALPHA_DEFAULT = 0.0
+# Projection matrices adapted (ops/transformer.py LORA_TARGETS).
+# null => all four: attn_qkvw, attn_ow, inter_w, output_w.
+ADAPTERS_TARGETS = "targets"
+ADAPTERS_TARGETS_DEFAULT = None
+# Serving only: loadable slots in the in-HBM adapter pool (id 0, the
+# all-zeros identity, rides extra). Loading past this evicts the
+# least-recently-used IDLE adapter; a pool whose every adapter has live
+# requests rejects the load.
+ADAPTERS_POOL_SLOTS = "pool_slots"
+ADAPTERS_POOL_SLOTS_DEFAULT = 8
+
+#############################################
 # Multi-replica serving tier (deepspeed_tpu/serving/, docs/serving.md):
 # a FleetRouter in front of N inference-engine replicas — placement,
 # per-tenant admission, and rolling-restart lifecycle. The DeepSpeed-
@@ -568,7 +597,9 @@ SERVING_VALID_BACKENDS = ("in_process", "subprocess")
 # into) falling back to least-loaded, "round_robin" ignores load.
 SERVING_PLACEMENT = "placement"
 SERVING_PLACEMENT_DEFAULT = "least_loaded"
-SERVING_VALID_PLACEMENTS = ("least_loaded", "prefix_affinity", "round_robin")
+SERVING_VALID_PLACEMENTS = (
+    "least_loaded", "prefix_affinity", "round_robin", "adapter_affinity",
+)
 # Prompt tokens hashed for prefix affinity (the templated-system-prompt
 # span; prompts shorter than this hash whole).
 SERVING_AFFINITY_PREFIX_TOKENS = "affinity_prefix_tokens"

@@ -38,6 +38,16 @@ Every function here is pure and fixed-shape: tokens/positions are
 ``[slots]`` arrays whatever subset of slots is live, so requests joining
 or leaving the batch NEVER retrigger compilation (pinned by
 tests/unit/test_inference.py via the jax/recompiles counter).
+
+Multi-tenant LoRA (docs/adapters.md): every entry point optionally takes
+``adapters`` — an in-HBM pool ``{target: (A [L, n_adapters+1, in, r],
+B [L, n_adapters+1, r, out])}`` with row 0 the all-zeros identity — plus
+per-slot ``adapter_ids`` [B] int32 and a static ``lora_scale``. The layer
+scan slices the pool alongside the param stacks and the block applies
+per-slot GATHERED A/B matmuls (ops/transformer.py:apply_lora): ids are
+arrays, not shapes, so a batch mixing any adapters (including ids never
+seen before) runs the one compiled program — the same indirection trick
+as the block tables (pinned in tests/unit/test_adapters.py).
 """
 
 import typing
@@ -92,7 +102,18 @@ def _final_norm_and_logits(config, tp, x):
     return x @ tp["wte"].T
 
 
-def gpt2_prefill(config, params, tokens):
+def _layer_lora(adapters, adapter_ids, lora_scale):
+    """(scan-xs adapter pytree, per-layer lora builder) pair: with no
+    adapter pool the xs contribution is an EMPTY pytree and every layer
+    sees ``lora=None`` — the traced ops are exactly the pre-adapter
+    program's, which is what keeps adapter-disabled engines bitwise."""
+    if adapters is None:
+        return {}, lambda ad: None
+    return dict(adapters), lambda ad: (ad, adapter_ids, lora_scale)
+
+
+def gpt2_prefill(config, params, tokens, adapters=None, adapter_ids=None,
+                 lora_scale=1.0):
     """Full-sequence forward over ``tokens`` [B, S] that ALSO returns each
     layer's k/v projections for the cache.
 
@@ -104,21 +125,27 @@ def gpt2_prefill(config, params, tokens):
     safe without a mask: causality keeps padding columns out of every
     real row, and the padding rows' cache entries sit beyond the row
     length decode masks by (and are overwritten as generation advances).
+    ``adapters``/``adapter_ids`` [B]: the prompt prefills THROUGH its
+    tenant's adapter, so the cache rows seeding decode already carry the
+    adapted k/v (id 0 = base model).
     """
     tp = params["transformer"]
     s = tokens.shape[1]
     layer_cfg = config.layer_config()
     x = tp["wte"][tokens] + tp["wpe"][None, :s, :]
+    ad_xs, lora_of = _layer_lora(adapters, adapter_ids, lora_scale)
 
-    def body(x, pl):
+    def body(x, xs):
+        pl, ad = xs
         x, (k, v) = transformer_block_apply(
             layer_cfg, pl, x, None,
             causal=True, use_flash=config.use_flash, mesh=config.mesh,
             train=False, dropout_rng=None, return_kv=True,
+            lora=lora_of(ad),
         )
         return x, (k, v)
 
-    x, (ks, vs) = jax.lax.scan(body, x, tp["h"])
+    x, (ks, vs) = jax.lax.scan(body, x, (tp["h"], ad_xs))
     logits = _final_norm_and_logits(config, tp, x)
     return logits, ks, vs
 
@@ -174,6 +201,35 @@ def init_kv_pool(config, num_blocks, block_size, dtype=jnp.float32):
     return KVPool(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
+def init_adapter_pool(config, n_adapters, rank, targets=None,
+                      dtype=jnp.float32):
+    """Zero-filled in-HBM LoRA adapter pool for a GPT2Config:
+    ``{target: (A [L, n_adapters+1, in, rank], B [L, n_adapters+1, rank,
+    out])}`` — row 0 is the permanent all-zeros IDENTITY adapter (id 0 =
+    no adapter; its gathered delta is exactly 0.0), rows 1..n_adapters
+    are loadable slots the engine's host-side AdapterPool hands out.
+    Zeros everywhere means a freshly-allocated pool serves base-model
+    traffic before any adapter loads."""
+    from ..ops.transformer import LORA_TARGET_DIMS, resolve_lora_targets
+
+    layer_cfg = config.layer_config()
+    shapes = {
+        "H": config.n_embd,
+        "3H": 3 * config.n_embd,
+        "I": layer_cfg.intermediate,
+    }
+    rank = int(rank)
+    rows = int(n_adapters) + 1  # + the identity row
+    out = {}
+    for t in resolve_lora_targets(targets):
+        din, dout = (shapes[d] for d in LORA_TARGET_DIMS[t])
+        out[t] = (
+            jnp.zeros((config.n_layer, rows, din, rank), dtype),
+            jnp.zeros((config.n_layer, rows, rank, dout), dtype),
+        )
+    return out
+
+
 def write_prefill_to_pool(pool: KVPool, ks, vs, block_ids, offsets):
     """Install one cold-prefilled request's k/v ([L, 1, heads, S, hd])
     into its pages: position ``j`` lands at ``(block_ids[j],
@@ -193,35 +249,41 @@ def write_prefill_to_pool(pool: KVPool, ks, vs, block_ids, offsets):
 
 
 def gpt2_decode_step_paged(config, params, tokens, positions,
-                           pool: KVPool, block_tables):
+                           pool: KVPool, block_tables, adapters=None,
+                           adapter_ids=None, lora_scale=1.0):
     """One incremental token for every slot over the paged pool — the
     block-table twin of :func:`gpt2_decode_step` (identical embedding,
     layer-scan, and head arithmetic through the shared decode core, so
     greedy rollouts are bitwise against the contiguous path). ``tokens``
     / ``positions`` are [slots] int32; ``block_tables`` [slots,
-    max_blocks] int32 holds physical page ids (0 = null page). Returns
-    ``(logits [slots, vocab_padded], pool)``."""
+    max_blocks] int32 holds physical page ids (0 = null page);
+    ``adapter_ids`` [slots] picks each slot's LoRA adapter from the
+    pool (0 = identity). Returns ``(logits [slots, vocab_padded],
+    pool)``."""
     tp = params["transformer"]
     layer_cfg = config.layer_config()
     x = tp["wte"][tokens] + tp["wpe"][positions]  # [slots, H]
     x = x[:, None, :]  # [slots, 1, H]
+    ad_xs, lora_of = _layer_lora(adapters, adapter_ids, lora_scale)
 
     def body(x, xs):
-        pl, kp, vp = xs
+        pl, kp, vp, ad = xs
         x, kp, vp = transformer_block_decode_paged(
-            layer_cfg, pl, x, kp, vp, block_tables, positions
+            layer_cfg, pl, x, kp, vp, block_tables, positions,
+            lora=lora_of(ad),
         )
         return x, (kp, vp)
 
     x, (k_pool, v_pool) = jax.lax.scan(
-        body, x, (tp["h"], pool.k, pool.v)
+        body, x, (tp["h"], pool.k, pool.v, ad_xs)
     )
     logits = _final_norm_and_logits(config, tp, x)
     return logits[:, 0, :], KVPool(k=k_pool, v=v_pool)
 
 
 def gpt2_prefill_suffix(config, params, tokens, start_pos,
-                        pool: KVPool, block_tables):
+                        pool: KVPool, block_tables, adapters=None,
+                        adapter_ids=None, lora_scale=1.0):
     """Prefill a prompt's UNIQUE SUFFIX against its cached prefix pages:
     the prefix-cache hit path. ``tokens`` [B, S] is the suffix padded to
     a fixed bucket, ``start_pos`` [B] the cached prefix length (a whole
@@ -239,45 +301,51 @@ def gpt2_prefill_suffix(config, params, tokens, start_pos,
     positions = start_pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
     positions = jnp.minimum(positions, tp["wpe"].shape[0] - 1)
     x = tp["wte"][tokens] + tp["wpe"][positions]
+    ad_xs, lora_of = _layer_lora(adapters, adapter_ids, lora_scale)
 
     def body(x, xs):
-        pl, kp, vp = xs
+        pl, kp, vp, ad = xs
         x, kp, vp = transformer_block_prefill_paged(
-            layer_cfg, pl, x, kp, vp, block_tables, start_pos
+            layer_cfg, pl, x, kp, vp, block_tables, start_pos,
+            lora=lora_of(ad),
         )
         return x, (kp, vp)
 
     x, (k_pool, v_pool) = jax.lax.scan(
-        body, x, (tp["h"], pool.k, pool.v)
+        body, x, (tp["h"], pool.k, pool.v, ad_xs)
     )
     logits = _final_norm_and_logits(config, tp, x)
     return logits, KVPool(k=k_pool, v=v_pool)
 
 
-def gpt2_decode_step(config, params, tokens, positions, cache: KVCache):
+def gpt2_decode_step(config, params, tokens, positions, cache: KVCache,
+                     adapters=None, adapter_ids=None, lora_scale=1.0):
     """One incremental token for every slot.
 
     ``tokens`` [slots] int32 (each slot's previous token), ``positions``
     [slots] int32 (that token's position == tokens already cached for the
-    slot). Returns ``(logits [slots, vocab_padded], cache)`` with this
-    step's k/v written. Dead slots ride along (fixed shape); their writes
-    land at their stale position and their logits are discarded by the
-    scheduler.
+    slot). ``adapter_ids`` [slots] picks each slot's LoRA adapter from
+    the in-HBM pool (0 = identity — dead slots and base-model requests
+    gather exact zeros). Returns ``(logits [slots, vocab_padded],
+    cache)`` with this step's k/v written. Dead slots ride along (fixed
+    shape); their writes land at their stale position and their logits
+    are discarded by the scheduler.
     """
     tp = params["transformer"]
     layer_cfg = config.layer_config()
     x = tp["wte"][tokens] + tp["wpe"][positions]  # [slots, H]
     x = x[:, None, :]  # [slots, 1, H]
+    ad_xs, lora_of = _layer_lora(adapters, adapter_ids, lora_scale)
 
     def body(x, xs):
-        pl, kc, vc = xs
+        pl, kc, vc, ad = xs
         x, kc, vc = transformer_block_decode(
-            layer_cfg, pl, x, kc, vc, positions
+            layer_cfg, pl, x, kc, vc, positions, lora=lora_of(ad)
         )
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
-        body, x, (tp["h"], cache.k, cache.v)
+        body, x, (tp["h"], cache.k, cache.v, ad_xs)
     )
     logits = _final_norm_and_logits(config, tp, x)
     return logits[:, 0, :], KVCache(k=k_cache, v=v_cache)

@@ -30,9 +30,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..adapters.pool import AdapterPool, AdapterUnavailable
 from ..config import constants as C
 from ..config.config import DeepSpeedConfig, DeepSpeedConfigError
-from ..models.gpt2 import kv_cache_partition_specs, kv_pool_partition_specs
+from ..models.gpt2 import (
+    adapter_pool_partition_specs,
+    kv_cache_partition_specs,
+    kv_pool_partition_specs,
+)
 from ..parallel import mesh as mesh_lib
 from ..telemetry.manager import build_telemetry, register_inference_metrics
 from ..telemetry.registry import MetricsRegistry
@@ -42,6 +47,7 @@ from .decode import (
     gpt2_decode_step_paged,
     gpt2_prefill,
     gpt2_prefill_suffix,
+    init_adapter_pool,
     init_kv_cache,
     init_kv_pool,
     write_prefill_to_cache,
@@ -186,6 +192,40 @@ class InferenceEngine:
             self.prefix_cache_enabled = False
             self._suffix_buckets = []
 
+        # ---- multi-tenant LoRA geometry (docs/adapters.md) ------------
+        self.multi_lora = bool(cfg.adapters_enabled)
+        if self.multi_lora:
+            from ..adapters.lora import split_lora_params
+            from ..ops.transformer import lora_scaling, resolve_lora_targets
+
+            _, embedded = split_lora_params(model_parameters)
+            if embedded:
+                # per-tenant adapters ride the in-HBM pool; param-tree
+                # *_lora_* leaves would ALSO apply per-layer — a silent
+                # double application. (A module config with lora_rank > 0
+                # over a BASE tree is fine: a fine-tune engine mutates
+                # the shared config, and the per-layer branch no-ops when
+                # the leaves are absent.)
+                raise DeepSpeedConfigError(
+                    "multi-LoRA serving wants the BASE param tree: "
+                    "model_parameters carries *_lora_* leaves — split "
+                    "them out (adapters.split_lora_params) and load them "
+                    "with engine.load_adapter() instead"
+                )
+            self.adapter_rank = int(cfg.adapters_rank)
+            self.adapter_targets = resolve_lora_targets(
+                cfg.adapters_targets
+            )
+            self.adapter_scale = lora_scaling(
+                self.adapter_rank, float(cfg.adapters_alpha or 0.0)
+            )
+            self.adapter_pool_slots = int(cfg.adapters_pool_slots)
+        else:
+            self.adapter_rank = 0
+            self.adapter_targets = ()
+            self.adapter_scale = 1.0
+            self.adapter_pool_slots = 0
+
         # ---- telemetry + metrics --------------------------------------
         n_params = sum(
             int(np.prod(p.shape))
@@ -282,6 +322,55 @@ class InferenceEngine:
         self._cache = jax.device_put(
             self._init_cache_host(), self._cache_sharding
         )
+
+        # ---- in-HBM adapter pool + host registry ----------------------
+        # docs/adapters.md: {target: (A [L, n+1, in, r], B [L, n+1, r,
+        # out])} with row 0 the permanent identity; the host-side
+        # AdapterPool owns name->row assignment, per-slot refcounts, and
+        # idle-LRU eviction. Rows are written through one jitted
+        # index-put whose row index is a TRACED scalar — loading the
+        # thousandth adapter compiles nothing new.
+        if self.multi_lora:
+            pool_specs = adapter_pool_partition_specs(self.adapter_targets)
+            self._adapter_shardings = {
+                t: tuple(NamedSharding(self._mesh, s) for s in pair)
+                for t, pair in pool_specs.items()
+            }
+            self._adapter_pool = jax.device_put(
+                init_adapter_pool(
+                    mcfg, self.adapter_pool_slots, self.adapter_rank,
+                    self.adapter_targets, self.compute_dtype,
+                ),
+                self._adapter_shardings,
+            )
+            self.adapter_registry = AdapterPool(self.adapter_pool_slots)
+            self._slot_adapters = np.zeros(self.num_slots, np.int32)
+            self._slot_adapter_names = {}  # slot -> adapter name
+            # checkpoint-load template, built lazily from target SHAPES
+            # (adapter_host_template) and cached — shapes never change
+            self._adapter_template = None
+
+            def _pool_write(pool, rows, idx):
+                return jax.tree_util.tree_map(
+                    lambda p, r: p.at[:, idx].set(r.astype(p.dtype)),
+                    pool, rows,
+                )
+
+            # the pool is donated through the row write (like the KV
+            # cache through decode): without donation every load briefly
+            # holds TWO copies of the whole [L, n+1, ...] pool in HBM.
+            # CPU ignores donation; skip it there to keep test logs quiet.
+            self._jit_pool_write = jax.jit(
+                _pool_write,
+                donate_argnums=(
+                    (0,) if jax.devices()[0].platform != "cpu" else ()
+                ),
+            )
+        else:
+            self._adapter_pool = None
+            self.adapter_registry = None
+            self._slot_adapters = None
+            self._slot_adapter_names = {}
         self._key = jax.random.PRNGKey(rng_seed)
         self._lengths = np.zeros(self.num_slots, np.int32)
         self._last_tokens = np.zeros(self.num_slots, np.int32)
@@ -302,44 +391,63 @@ class InferenceEngine:
         # per-call warning would bury test logs
         platform = jax.devices()[0].platform
         donate_cache = platform != "cpu"
-        self._jit_prefill = jax.jit(
-            lambda p, toks: gpt2_prefill(mcfg, p, toks)
-        )
+        # Multi-LoRA engines append (adapter_pool, adapter_ids) as
+        # trailing *args to every program — call sites pass them only in
+        # that mode, so each engine traces ONE arity. An adapter-disabled
+        # engine therefore traces the EXACT pre-adapter programs (the
+        # adapter-off bitwise-parity contract, tests/unit/test_adapters).
+        lora_kw = dict(lora_scale=self.adapter_scale)
+
+        def _split_ad(ad):
+            # (adapters, adapter_ids) from the trailing args, or Nones
+            return ad if ad else (None, None)
+
+        def prefill_fn(p, toks, *ad):
+            apool, aids = _split_ad(ad)
+            return gpt2_prefill(
+                mcfg, p, toks, adapters=apool, adapter_ids=aids, **lora_kw
+            )
+
+        self._jit_prefill = jax.jit(prefill_fn)
         if self.paged:
             self._jit_write_prefill = jax.jit(
                 write_prefill_to_pool,
                 donate_argnums=(0,) if donate_cache else (),
             )
-            self._jit_decode = jax.jit(
-                lambda p, toks, pos, temps, key, pool, tables: (
-                    self._decode_and_sample_paged(
-                        p, toks, pos, temps, key, pool, tables
-                    )
-                ),
-                donate_argnums=(5,) if donate_cache else (),
-            )
-            # one compiled program per suffix bucket (jit specializes on
-            # the padded suffix shape); start_pos stays a traced array so
-            # every prefix length shares the bucket's program
+
+            def decode_fn(p, toks, pos, temps, key, pool, tables, *ad):
+                return self._decode_and_sample_paged(
+                    p, toks, pos, temps, key, pool, tables, *_split_ad(ad)
+                )
+
+            # one compiled suffix-prefill program per suffix bucket (jit
+            # specializes on the padded suffix shape); start_pos stays a
+            # traced array so every prefix length shares the bucket's
+            # program
+            def suffix_fn(p, suf, sp, pool, bt, *ad):
+                apool, aids = _split_ad(ad)
+                return gpt2_prefill_suffix(
+                    mcfg, p, suf, sp, pool, bt, adapters=apool,
+                    adapter_ids=aids, **lora_kw,
+                )
+
             self._jit_prefill_suffix = jax.jit(
-                lambda p, suf, sp, pool, bt: gpt2_prefill_suffix(
-                    mcfg, p, suf, sp, pool, bt
-                ),
-                donate_argnums=(3,) if donate_cache else (),
+                suffix_fn, donate_argnums=(3,) if donate_cache else ()
             )
         else:
             self._jit_write_prefill = jax.jit(
                 write_prefill_to_cache,
                 donate_argnums=(0,) if donate_cache else (),
             )
-            self._jit_decode = jax.jit(
-                lambda p, toks, pos, temps, key, cache: (
-                    self._decode_and_sample(
-                        p, toks, pos, temps, key, cache
-                    )
-                ),
-                donate_argnums=(5,) if donate_cache else (),
-            )
+
+            def decode_fn(p, toks, pos, temps, key, cache, *ad):
+                return self._decode_and_sample(
+                    p, toks, pos, temps, key, cache, *_split_ad(ad)
+                )
+
+        self._jit_decode = jax.jit(
+            decode_fn, donate_argnums=(5,) if donate_cache else ()
+        )
         # first token rides a traced last-prompt-row index so every prompt
         # length reuses ONE compiled program (an eager logits[:, plen-1]
         # slice would compile per distinct length and trip the
@@ -361,6 +469,25 @@ class InferenceEngine:
         self._kv_bytes.set(
             int(self._cache.k.nbytes) + int(self._cache.v.nbytes)
         )
+
+        # ---- adapters/* metric streams (docs/observability.md) --------
+        if self.multi_lora:
+            from ..telemetry.manager import register_adapter_metrics
+
+            register_adapter_metrics(self.metrics)
+            self._adapter_occupancy = self.metrics.gauge(
+                "adapters/pool_occupancy"
+            )
+            self.metrics.gauge("adapters/pool_slots").set(
+                self.adapter_pool_slots
+            )
+            self._adapter_loads = self.metrics.counter("adapters/loads")
+            self._adapter_evictions = self.metrics.counter(
+                "adapters/evictions"
+            )
+            self._adapter_requests = self.metrics.counter(
+                "adapters/requests"
+            )
 
         # ---- scheduler ------------------------------------------------
         self.scheduler = ContinuousBatchingScheduler(
@@ -413,9 +540,11 @@ class InferenceEngine:
 
     # -- device hooks (called by the scheduler) -------------------------
     def _decode_and_sample(self, params, tokens, positions, temps, key,
-                           cache):
+                           cache, adapters=None, adapter_ids=None):
         logits, cache = gpt2_decode_step(
-            self.model_config, params, tokens, positions, cache
+            self.model_config, params, tokens, positions, cache,
+            adapters=adapters, adapter_ids=adapter_ids,
+            lora_scale=self.adapter_scale,
         )
         next_tokens = sample_tokens(
             logits, key, temps, **self._sampling_statics
@@ -423,9 +552,12 @@ class InferenceEngine:
         return next_tokens, cache
 
     def _decode_and_sample_paged(self, params, tokens, positions, temps,
-                                 key, pool, tables):
+                                 key, pool, tables, adapters=None,
+                                 adapter_ids=None):
         logits, pool = gpt2_decode_step_paged(
-            self.model_config, params, tokens, positions, pool, tables
+            self.model_config, params, tokens, positions, pool, tables,
+            adapters=adapters, adapter_ids=adapter_ids,
+            lora_scale=self.adapter_scale,
         )
         next_tokens = sample_tokens(
             logits, key, temps, **self._sampling_statics
@@ -473,7 +605,13 @@ class InferenceEngine:
             )
         hashes = None
         if self.prefix_cache_enabled:
-            hashes = hash_full_blocks(prompt_tokens, self.kv_block_size)
+            # salted by the slot's adapter identity: adapted prefills
+            # write adapter-specific k/v, so pages never share across
+            # adapters (or across reloads of one adapter's weights)
+            hashes = hash_full_blocks(
+                prompt_tokens, self.kv_block_size,
+                salt=self._adapter_salt(slot),
+            )
             prefix_len, shared = self.block_pool.match_prefix(
                 prompt_tokens, hashes=hashes
             )
@@ -513,7 +651,14 @@ class InferenceEngine:
         prefix pages decref; full prompt pages stay cached for the next
         request with that prefix) and NULL its block-table row so the
         dead slot's ride-along decode writes sink into the sacrificial
-        page instead of pages the pool may hand to someone else."""
+        page instead of pages the pool may hand to someone else. Also
+        drops the slot's adapter pin (its id resets to the identity, so
+        the dead slot's ride-along gathers read the zero rows)."""
+        if self.multi_lora:
+            name = self._slot_adapter_names.pop(slot, None)
+            if name is not None:
+                self.adapter_registry.release(name)
+            self._slot_adapters[slot] = 0
         if not self.paged:
             return
         blocks = self._slot_blocks.pop(slot, None)
@@ -549,6 +694,203 @@ class InferenceEngine:
             ),
         }
 
+    # -- multi-tenant LoRA adapters (docs/adapters.md) ------------------
+    def _require_multi_lora(self):
+        if not self.multi_lora:
+            raise DeepSpeedConfigError(
+                'this engine has no adapter pool; enable the "adapters" '
+                "config block to serve LoRA adapters"
+            )
+
+    def load_adapter(self, name, adapter_state=None, load_dir=None,
+                     tag=None):
+        """Install (or hot-reload) tenant adapter ``name`` into the
+        in-HBM pool and return its pool row index.
+
+        Weights come from ``adapter_state`` — a fine-tuned adapter tree
+        (an adapter-mode training engine's ``engine.params``) — or from
+        ``load_dir``: an adapter-only checkpoint committed by the
+        training engine's atomic protocol, read through the resilience
+        verified-load path (manifest check, host-side parse, newest-valid
+        fallback) and validated against this pool's rank/targets via the
+        checkpoint's self-describing ``adapters`` client state. Loading
+        past ``adapters.pool_slots`` evicts the least-recently-used IDLE
+        adapter; a pool whose every adapter has live requests raises
+        :class:`~deepspeed_tpu.adapters.AdapterPoolFull`. The row write
+        is one jitted index-put with a TRACED row index — the thousandth
+        load compiles nothing.
+        """
+        self._require_multi_lora()
+        from ..adapters.lora import (
+            adapter_host_template,
+            adapter_layer_stacks,
+        )
+
+        if (adapter_state is None) == (load_dir is None):
+            raise ValueError(
+                "pass exactly one of adapter_state (a fine-tuned adapter "
+                "tree) or load_dir (an adapter-only checkpoint directory)"
+            )
+        if load_dir is not None:
+            from ..runtime.checkpointing import load_module_state
+
+            if self._adapter_template is None:
+                # shape-only walk over the PINNED params (no device
+                # transfer), cached: target shapes never change between
+                # loads
+                self._adapter_template = adapter_host_template(
+                    self.params, self.adapter_rank, self.adapter_targets
+                )
+            adapter_state, client_state, ckpt_tag = load_module_state(
+                load_dir, self._adapter_template, tag=tag,
+                resilience=self.resilience,
+            )
+            if adapter_state is None:
+                raise RuntimeError(
+                    f"no loadable adapter checkpoint under {load_dir!r} "
+                    "(see the resilience/corruption_fallbacks counter)"
+                )
+            meta = (client_state or {}).get("adapters")
+            if meta is not None:
+                from ..ops.transformer import lora_scaling
+
+                # alpha compares as the RESOLVED scale (alpha 0 => rank):
+                # a scale mismatch would silently rescale every delta the
+                # tenant fine-tuned
+                ckpt_scale = lora_scaling(
+                    meta.get("rank", self.adapter_rank),
+                    meta.get("alpha", 0.0),
+                )
+                if (
+                    int(meta.get("rank", self.adapter_rank))
+                    != self.adapter_rank
+                    or tuple(meta.get("targets", self.adapter_targets))
+                    != tuple(self.adapter_targets)
+                    or ckpt_scale != self.adapter_scale
+                ):
+                    raise DeepSpeedConfigError(
+                        f"adapter checkpoint {ckpt_tag!r} was fine-tuned "
+                        f"with rank={meta.get('rank')}/alpha="
+                        f"{meta.get('alpha')}/targets={meta.get('targets')}"
+                        f" but this pool serves rank={self.adapter_rank}/"
+                        f"scale={self.adapter_scale}/targets="
+                        f"{list(self.adapter_targets)}"
+                    )
+        stacks = adapter_layer_stacks(adapter_state, self.adapter_targets)
+        for t, (a, b) in stacks.items():
+            la, lb = self._adapter_pool[t]
+            want = (
+                (la.shape[0], *la.shape[2:]), (lb.shape[0], *lb.shape[2:]),
+            )
+            if (tuple(a.shape), tuple(b.shape)) != want:
+                raise ValueError(
+                    f"adapter {name!r} target {t}: shapes "
+                    f"{tuple(a.shape)}/{tuple(b.shape)} do not fit the "
+                    f"pool rows {want[0]}/{want[1]} (model/rank mismatch?)"
+                )
+        idx, evicted = self.adapter_registry.assign(name)
+        self._adapter_pool = self._jit_pool_write(
+            self._adapter_pool,
+            {t: (jnp.asarray(a), jnp.asarray(b))
+             for t, (a, b) in stacks.items()},
+            jnp.int32(idx),
+        )
+        self._adapter_loads.inc()
+        if evicted is not None:
+            self._adapter_evictions.inc()
+            log_dist(
+                f"adapter pool full: evicted idle adapter {evicted!r} "
+                f"for {name!r} (row {idx})", ranks=[0],
+            )
+        self._adapter_occupancy.set(self.adapter_registry.used_slots)
+        log_dist(
+            f"loaded adapter {name!r} into pool row {idx} "
+            f"({self.adapter_registry.used_slots}/"
+            f"{self.adapter_pool_slots} slots)", ranks=[0],
+        )
+        return idx
+
+    def unload_adapter(self, name):
+        """Explicitly evict ``name`` (refused while live requests decode
+        against it); frees its pool row for the next load."""
+        self._require_multi_lora()
+        idx = self.adapter_registry.remove(name)
+        self._adapter_evictions.inc()
+        self._adapter_occupancy.set(self.adapter_registry.used_slots)
+        return idx
+
+    def resolve_adapter(self, name):
+        """Submit-time validation + per-adapter accounting: returns the
+        adapter's CURRENT pool row. Raises
+        :class:`~deepspeed_tpu.adapters.AdapterUnavailable` (a
+        ValueError) for an unloaded name — THIS engine can never serve
+        it, but the typed subclass lets a fleet router fall through to a
+        replica that holds the adapter."""
+        self._require_multi_lora()
+        try:
+            idx = self.adapter_registry.index_of(name)
+        except KeyError:
+            raise AdapterUnavailable(
+                f"adapter {name!r} is not loaded (loaded: "
+                f"{self.adapter_registry.loaded}); call "
+                "engine.load_adapter() first"
+            ) from None
+        self.adapter_registry.count_request(name)
+        self._adapter_requests.inc()
+        self.metrics.counter(f"adapters/requests/{name}").inc()
+        return idx
+
+    def assign_slot_adapter(self, slot, name):
+        """Slot-join hook (scheduler._admit): pin ``name`` for the slot's
+        lifetime and point the slot's adapter id at its pool row. Returns
+        False when the adapter was evicted between submit and join — the
+        scheduler fail-finishes that request instead of serving it the
+        identity (or another tenant's) weights."""
+        if not self.multi_lora:
+            return True
+        if name is None:
+            # clear any stale name too: the slot's prefix-cache salt must
+            # be the BASE salt, not a previous occupant's adapter
+            self._slot_adapter_names.pop(slot, None)
+            self._slot_adapters[slot] = 0
+            return True
+        try:
+            idx = self.adapter_registry.acquire(name)
+        except KeyError:
+            return False
+        self._slot_adapters[slot] = idx
+        self._slot_adapter_names[slot] = name
+        return True
+
+    def _adapter_salt(self, slot):
+        """Prefix-cache hash salt for the slot's adapter: cached k/v are
+        a function of the weights that wrote them, so pages only share
+        within (adapter name, load generation) — base-model pages salt
+        None, and a reloaded adapter's fresh weights never match pages
+        its old weights produced."""
+        if not self.multi_lora:
+            return None
+        name = self._slot_adapter_names.get(slot)
+        if name is None:
+            return None
+        return f"{name}@{self.adapter_registry.generation_of(name)}"
+
+    def adapter_snapshot(self):
+        """Adapter-pool state for ``load_snapshot()`` — what the fleet
+        router's adapter-affinity placement and per-replica gauges read
+        (all JSON-safe for the subprocess-replica RPC)."""
+        if not self.multi_lora:
+            return {}
+        reg = self.adapter_registry
+        return {
+            "adapters_loaded": reg.loaded,
+            "adapter_pool_slots": self.adapter_pool_slots,
+            "adapter_pool_used": reg.used_slots,
+            "adapter_loads": reg.loads,
+            "adapter_evictions": reg.evictions,
+            "adapter_requests": dict(reg.requests),
+        }
+
     def prefill_request(self, slot, prompt_tokens, temperature):
         """Run one request's prefill into ``slot``: cache rows 0..P-1
         written, first token sampled from the prompt's last logit row.
@@ -565,9 +907,18 @@ class InferenceEngine:
         else:
             padded = np.zeros((1, self.prefill_len), np.int32)
             padded[0, :plen] = prompt_tokens
-            logits, ks, vs = self._jit_prefill(
-                self.params, jnp.asarray(padded)
-            )
+            if self.multi_lora:
+                # prefill THROUGH the slot's adapter: the cached k/v that
+                # seed decode must already carry the adapted projections
+                logits, ks, vs = self._jit_prefill(
+                    self.params, jnp.asarray(padded),
+                    self._adapter_pool,
+                    jnp.asarray(self._slot_adapters[slot:slot + 1]),
+                )
+            else:
+                logits, ks, vs = self._jit_prefill(
+                    self.params, jnp.asarray(padded)
+                )
             if self.paged:
                 # position j -> (its page, its offset); padding rows past
                 # the prompt carry the null page
@@ -627,13 +978,21 @@ class InferenceEngine:
         bucket = self._suffix_bucket(len(suffix), prefix_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : len(suffix)] = suffix
-        logits, self._cache = self._jit_prefill_suffix(
+        args = (
             self.params,
             jnp.asarray(padded),
             jnp.full((1,), prefix_len, jnp.int32),
             self._cache,
             jnp.asarray(self._block_tables[slot:slot + 1]),
         )
+        if self.multi_lora:
+            # a hit only ever matches pages salted with this same
+            # adapter, so the suffix continues the adapter's own prefix
+            args = args + (
+                self._adapter_pool,
+                jnp.asarray(self._slot_adapters[slot:slot + 1]),
+            )
+        logits, self._cache = self._jit_prefill_suffix(*args)
         self._key, sub = jax.random.split(self._key)
         first = self._jit_first_token(
             logits, jnp.int32(len(suffix) - 1), sub,
@@ -664,6 +1023,13 @@ class InferenceEngine:
             self._sync_pool_metrics()
         self._lengths[:] = 0
         self._last_tokens[:] = 0
+        if self.multi_lora:
+            # adapter WEIGHTS survive a decode crash (the pool is pinned
+            # state like the params, not KV garbage); only the slot pins
+            # die with the fail-finished in-flight requests — which
+            # _recover_driver_crash already released via release_slot
+            self._slot_adapters[:] = 0
+            self._slot_adapter_names.clear()
         log_dist(
             "inference decode state reset from pinned params "
             "(driver restart)", ranks=[0],
@@ -677,25 +1043,23 @@ class InferenceEngine:
         # through the scheduler's step, exercising the auto-restart path
         self.resilience.faults.maybe_raise("decode.step")
         self._key, sub = jax.random.split(self._key)
+        args = (
+            self.params,
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(self._lengths),
+            jnp.asarray(self._temps),
+            sub,
+            self._cache,
+        )
         if self.paged:
-            next_tokens, self._cache = self._jit_decode(
-                self.params,
-                jnp.asarray(self._last_tokens),
-                jnp.asarray(self._lengths),
-                jnp.asarray(self._temps),
-                sub,
-                self._cache,
-                jnp.asarray(self._block_tables),
+            args = args + (jnp.asarray(self._block_tables),)
+        if self.multi_lora:
+            # per-slot adapter ids: an index ARRAY like the block tables,
+            # so slots mixing any adapters never change the program
+            args = args + (
+                self._adapter_pool, jnp.asarray(self._slot_adapters),
             )
-        else:
-            next_tokens, self._cache = self._jit_decode(
-                self.params,
-                jnp.asarray(self._last_tokens),
-                jnp.asarray(self._lengths),
-                jnp.asarray(self._temps),
-                sub,
-                self._cache,
-            )
+        next_tokens, self._cache = self._jit_decode(*args)
         next_tokens = np.asarray(next_tokens)
         out = []
         for slot in active_slots:
@@ -717,16 +1081,19 @@ class InferenceEngine:
         return self.scheduler.load_snapshot()
 
     def generate(self, prompts, max_new_tokens=32, temperature=None,
-                 eos_token_id=None):
+                 eos_token_id=None, adapter=None):
         """Synchronous batch generation: submit every prompt (token-id
         lists), drive the scheduler until all finish, return the
-        generated token-id lists in prompt order."""
+        generated token-id lists in prompt order. ``adapter`` names a
+        loaded LoRA adapter applied to every prompt (None = base
+        model)."""
         requests = []
         try:
             for p in prompts:
                 requests.append(self.submit(
                     p, max_new_tokens=max_new_tokens,
                     temperature=temperature, eos_token_id=eos_token_id,
+                    adapter=adapter,
                 ))
         except Exception:
             # a rejected/invalid later prompt must not orphan the earlier

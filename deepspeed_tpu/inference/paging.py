@@ -54,15 +54,23 @@ class PoolExhausted(RuntimeError):
         self.available = int(available)
 
 
-def hash_full_blocks(prompt_tokens, block_size):
+def hash_full_blocks(prompt_tokens, block_size, salt=None):
     """Chain hashes for every FULL page of ``prompt_tokens``: entry i
     covers tokens [0, (i+1)*block_size) — the hash commits to the whole
     prefix, not just the page's own tokens, so two prompts share a page
     only when they agree on EVERYTHING up to its end. sha1 over token
     bytes: deterministic across processes (unlike Python's salted
-    ``hash``) and collision-safe at cache scale."""
+    ``hash``) and collision-safe at cache scale.
+
+    ``salt`` seeds the chain root: cached k/v are a function of the
+    WEIGHTS that produced them, not just the tokens, so requests served
+    under different LoRA adapters must never share pages — the engine
+    salts with the slot's adapter identity (name + load generation, so a
+    reloaded adapter's new weights also never match its old pages)."""
     out = []
     parent = b"kv-prefix-root"
+    if salt is not None:
+        parent = parent + b"#" + str(salt).encode()
     n_full = len(prompt_tokens) // block_size
     for i in range(n_full):
         page = prompt_tokens[i * block_size:(i + 1) * block_size]

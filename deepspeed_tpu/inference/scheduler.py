@@ -96,13 +96,18 @@ class InferenceRequest:
     _ids = itertools.count()
 
     def __init__(self, prompt_tokens, max_new_tokens, temperature,
-                 eos_token_id, deadline_secs=None, priority=0):
+                 eos_token_id, deadline_secs=None, priority=0,
+                 adapter=None):
         self.request_id = next(self._ids)
         self.prompt_tokens = [int(t) for t in prompt_tokens]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
         self.priority = int(priority)
+        # LoRA adapter NAME (docs/adapters.md); resolved to its pool row
+        # at slot join so a hot-reload between submit and join serves the
+        # adapter's newest weights
+        self.adapter = adapter
         self.tokens = []
         self.finish_reason = None
         self.submitted_at = time.monotonic()
@@ -283,12 +288,17 @@ class ContinuousBatchingScheduler:
             # prefix_hit_rate, ...) — what capacity-aware placement and
             # the per-replica fleet gauges read (docs/serving.md)
             snap.update(kv())
+        adapters = getattr(self._engine, "adapter_snapshot", None)
+        if adapters is not None:
+            # multi-LoRA engines add loaded-adapter ids + pool occupancy
+            # — what adapter-affinity placement reads (docs/adapters.md)
+            snap.update(adapters())
         return snap
 
     # -- front door -----------------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens=32, temperature=None,
                eos_token_id=None, timeout=None, deadline_secs=None,
-               priority=0):
+               priority=0, adapter=None):
         """Enqueue a request; returns the :class:`InferenceRequest`
         handle. Raises :class:`RequestRejected` when the bounded queue
         stays full past ``timeout`` (default: the config's
@@ -301,7 +311,9 @@ class ContinuousBatchingScheduler:
         ``inference.deadline_secs``) bounds the request end to end: an
         unmeetable deadline finishes it with reason ``"deadline"`` at
         admission, an expired one frees its slot within one decode
-        step."""
+        step. ``adapter`` names a LoRA adapter loaded into the engine's
+        pool (docs/adapters.md); unloaded names raise ``ValueError`` —
+        a request for an unknown tenant adapter can never be served."""
         if self._stop.is_set():
             self._rejected.inc()
             raise RequestRejected(
@@ -330,6 +342,15 @@ class ContinuousBatchingScheduler:
                 "submission (priority 0 is never shed at this gate)",
                 reason=REJECT_OVERLOAD,
             )
+        if adapter is not None:
+            resolve = getattr(self._engine, "resolve_adapter", None)
+            if resolve is None:
+                raise ValueError(
+                    f"adapter {adapter!r} requested but this engine has "
+                    'no adapter pool (enable the "adapters" config '
+                    "block)"
+                )
+            resolve(adapter)  # ValueError on an unloaded name; counts it
         n = len(prompt_tokens)
         if n == 0:
             raise ValueError("empty prompt")
@@ -386,6 +407,7 @@ class ContinuousBatchingScheduler:
             ),
             deadline_secs=deadline_secs,
             priority=priority,
+            adapter=adapter,
         )
         wait = self._queue_timeout if timeout is None else float(timeout)
         try:
@@ -526,14 +548,29 @@ class ContinuousBatchingScheduler:
             # sweeps reach it — popped-but-unplaced requests would hang
             # their result() waiters forever
             self._slots[slot] = req
+            assign = getattr(self._engine, "assign_slot_adapter", None)
+            if assign is not None and not assign(
+                slot, getattr(req, "adapter", None)
+            ):
+                # the adapter was evicted between submit and slot join:
+                # fail the request loudly rather than decode it against
+                # the identity (or another tenant's) weights; the slot
+                # refills at the next step boundary
+                self._free_slot(slot)
+                req._finish(_FINISH_ERROR)
+                continue
             if reserve is not None:
                 try:
                     reserve(slot, req.prompt_tokens, req.max_new_tokens)
                 except PoolExhausted:
                     # no pages right now: park the request at the head of
                     # the deferred line and stop admitting this step —
-                    # an active request's release is what unblocks it
-                    self._slots[slot] = None
+                    # an active request's release is what unblocks it.
+                    # _free_slot (not a bare table clear): the slot
+                    # already pinned its adapter above, and leaking that
+                    # reference would make the adapter un-evictable (and
+                    # leave a stale prefix-cache salt on the slot)
+                    self._free_slot(slot)
                     self._deferred.appendleft(req)
                     break
             self._queue_wait_ms.observe((t0 - req.submitted_at) * 1e3)

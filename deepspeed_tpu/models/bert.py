@@ -65,6 +65,12 @@ class BertConfig:
     sparse_gradients: bool = dataclasses.field(
         default=False, hash=False, compare=False
     )
+    # LoRA adapters on the block's projection matrices (docs/adapters.md;
+    # 0 = off, bitwise-identical forward). Armed by the engine's
+    # "adapters" config block like GPT2Config's (runtime/engine.py).
+    lora_rank: int = 0
+    lora_alpha: float = 0.0
+    lora_targets: tuple = ()  # () => every LORA_TARGETS matrix
 
     @staticmethod
     def bert_large(**kw):
@@ -92,6 +98,9 @@ class BertConfig:
             gelu_checkpoint=self.gelu_checkpoint,
             attn_dropout_checkpoint=self.attn_dropout_checkpoint,
             remat_policy=self.remat_policy,
+            lora_rank=self.lora_rank,
+            lora_alpha=self.lora_alpha,
+            lora_targets=tuple(self.lora_targets),
         )
 
 

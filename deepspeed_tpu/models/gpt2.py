@@ -72,6 +72,14 @@ class GPT2Config:
     sparse_gradients: bool = dataclasses.field(
         default=False, hash=False, compare=False
     )
+    # LoRA adapters (deepspeed_tpu/adapters/, docs/adapters.md): rank-r
+    # A/B pairs beside the block's projection matrices. 0 = off — the
+    # forward is then bitwise-identical to the adapter-free model.
+    # Usually armed by the engine's "adapters" config block rather than
+    # set by hand (runtime/engine.py injects these like it injects mesh).
+    lora_rank: int = 0
+    lora_alpha: float = 0.0  # 0 => rank (scaling 1.0)
+    lora_targets: tuple = ()  # () => every LORA_TARGETS matrix
 
     @property
     def vocab_padded(self):
@@ -115,6 +123,9 @@ class GPT2Config:
             layer_norm_eps=self.layer_norm_eps,
             normalize_invertible=self.remat,  # remat flag reuse
             remat_policy=self.remat_policy,
+            lora_rank=self.lora_rank,
+            lora_alpha=self.lora_alpha,
+            lora_targets=tuple(self.lora_targets),
         )
 
 
@@ -376,6 +387,32 @@ def kv_pool_partition_specs(mp_axis=MODEL_AXIS):
     return P(None, None, None, mp_axis, None)
 
 
+def adapter_pool_partition_specs(targets=None, mp_axis=MODEL_AXIS):
+    """PartitionSpecs for the serving-side in-HBM adapter pool
+    (inference/engine.py): ``{target: (A, B)}`` with A laid out
+    ``[layers, n_adapters, in, rank]`` and B ``[layers, n_adapters,
+    rank, out]``. The factor carrying the base matrix's Megatron-sharded
+    dim shards on the same ``model`` axis the base weights use
+    (column-parallel => B's output dim; row-parallel => A's input dim) —
+    each chip holds its own shard of EVERY adapter, so the per-slot
+    gathers along the adapter axis stay chip-local along the sharded
+    dim. Layers/adapters/rank replicate (adapters load and evict at
+    runtime; resharding them would thrash exactly like resharding KV
+    slots would)."""
+    from ..ops.transformer import (
+        LORA_TARGET_PARALLEL,
+        resolve_lora_targets,
+    )
+
+    out = {}
+    for t in resolve_lora_targets(targets):
+        if LORA_TARGET_PARALLEL[t] == "row":
+            out[t] = (P(None, None, mp_axis, None), P())
+        else:  # column-parallel: B carries the sharded output dim
+            out[t] = (P(), P(None, None, None, mp_axis))
+    return out
+
+
 def partition_specs(params, mp_axis=MODEL_AXIS, pipeline=False):
     """Megatron-style tensor-parallel PartitionSpecs for a GPT2LMHeadModel
     param tree (same structure, PartitionSpec leaves).
@@ -403,6 +440,26 @@ def partition_specs(params, mp_axis=MODEL_AXIS, pipeline=False):
             from ..ops.moe import moe_leaf_spec
 
             return moe_leaf_spec(names, leaf)
+        lora_name = next(
+            (n for n in names if n and "_lora_" in n), None
+        )
+        if lora_name is not None:
+            # LoRA A/B ride the SAME model axis as their base matrix
+            # (docs/adapters.md): column-parallel bases (qkv, inter_w)
+            # shard their output dim — carried by B [r, out]; row-parallel
+            # bases (attn_ow, output_w) shard their input dim — carried by
+            # A [in, r]. The rank dim never shards (tiny, rarely divides
+            # the axis); the other factor replicates.
+            from ..ops.transformer import LORA_TARGET_PARALLEL
+
+            target, ab = lora_name.rsplit("_lora_", 1)
+            parallel = LORA_TARGET_PARALLEL.get(target)
+            head = (lead,) if nd == 3 else ()  # stacked layers axis
+            if parallel == "column" and ab == "b":
+                return P(*head, None, mp_axis)
+            if parallel == "row" and ab == "a":
+                return P(*head, mp_axis, None)
+            return P(*head, None, None)
         if "wte" in names:
             return P(mp_axis, None)
         if "wpe" in names:

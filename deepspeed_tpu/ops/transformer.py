@@ -74,6 +74,16 @@ class DeepSpeedTransformerConfig:
     # reaches with its per-buffer recompute flags
     # (ds_transformer_cuda.cpp:189-191).
     remat_policy: str = "full"
+    # LoRA adapters (Hu et al. — PAPERS.md "Adapters";
+    # deepspeed_tpu/adapters/, docs/adapters.md): rank-r A/B pairs on the
+    # projection matrices named in ``lora_targets``. 0 = no adapters —
+    # the block then runs the EXACT pre-adapter code path (no extra ops),
+    # so an adapter-free config stays bitwise-identical to today.
+    lora_rank: int = 0
+    # LoRA scaling numerator: delta = (alpha / rank) * x @ A @ B.
+    # 0 => alpha = rank (scaling 1.0), the convention bench/tests use.
+    lora_alpha: float = 0.0
+    lora_targets: tuple = ()  # () => LORA_TARGETS when lora_rank > 0
 
     @property
     def intermediate(self):
@@ -171,6 +181,86 @@ TRANSFORMER_PARAM_LAYOUT = (
 )
 
 
+#: Projection matrices LoRA can target, with their (in, out) dims in the
+#: shape vocabulary of TRANSFORMER_PARAM_LAYOUT — every weight MATRIX of
+#: the block (biases/norms gain nothing from low-rank deltas).
+LORA_TARGETS = ("attn_qkvw", "attn_ow", "inter_w", "output_w")
+LORA_TARGET_DIMS = {
+    "attn_qkvw": ("H", "3H"),
+    "attn_ow": ("H", "H"),
+    "inter_w": ("H", "I"),
+    "output_w": ("I", "H"),
+}
+#: Megatron split of each target's base matrix (models/gpt2.py:
+#: partition_specs): "column" shards the OUTPUT dim over the model axis —
+#: LoRA B ([r, out]) carries that dim, so B shards with it and A
+#: replicates; "row" shards the INPUT dim — A ([in, r]) carries it. The
+#: rank dim never shards (r is tiny and rarely divides the mesh axis).
+LORA_TARGET_PARALLEL = {
+    "attn_qkvw": "column", "inter_w": "column",
+    "attn_ow": "row", "output_w": "row",
+}
+
+
+def resolve_lora_targets(targets):
+    """Normalize + validate a lora_targets value: () / None => every
+    target; anything naming an unknown matrix fails loudly (a typo'd
+    target would otherwise silently train/serve a partial adapter)."""
+    targets = tuple(targets) if targets else LORA_TARGETS
+    unknown = [t for t in targets if t not in LORA_TARGETS]
+    if unknown:
+        raise ValueError(
+            f"unknown LoRA target(s) {unknown}; valid: {list(LORA_TARGETS)}"
+        )
+    if len(set(targets)) != len(targets):
+        raise ValueError(f"duplicate LoRA targets in {targets}")
+    return targets
+
+
+def lora_scaling(rank, alpha=0.0):
+    """delta multiplier: alpha / rank (alpha 0/None => rank => 1.0)."""
+    return (float(alpha) if alpha else float(rank)) / float(rank)
+
+
+def apply_lora(cfg, p, lora, name, x, y):
+    """``y`` (the base projection ``x @ W + b``) plus projection
+    ``name``'s LoRA delta, from one of two adapter sources:
+
+    - ``lora = (pools, ids, scale)`` — the BATCHED multi-adapter serving
+      path (S-LoRA / Punica — PAPERS.md "Adapters"): ``pools`` maps
+      target -> (A [n_adapters, in, r], B [n_adapters, r, out]),
+      ``ids`` [B] int32 picks each slot's adapter (id 0 = the all-zeros
+      identity rows — no adapter). Ids are ARRAYS, not shapes, so a
+      batch mixing any adapters runs ONE compiled program; the gather +
+      einsum is row-independent along the slot dim, which is what makes
+      a mixed batch bitwise-equal to per-adapter single-slot runs.
+    - per-layer ``{name}_lora_a`` / ``{name}_lora_b`` entries riding in
+      the param dict ``p`` (the fine-tune path, ``cfg.lora_rank > 0``):
+      one shared adapter, differentiated with the rest of ``p``.
+
+    Returns ``y`` untouched when neither source names this projection —
+    the adapter-disabled path adds zero ops.
+    """
+    if lora is not None:
+        pools, ids, scale = lora
+        ab = pools.get(name)
+        if ab is None:
+            return y
+        a, b = ab
+        t = jnp.einsum("bsi,bir->bsr", x, a[ids])
+        return y + (scale * jnp.einsum("bsr,bro->bso", t, b[ids])).astype(
+            y.dtype
+        )
+    if getattr(cfg, "lora_rank", 0) > 0 and isinstance(p, dict):
+        a = p.get(f"{name}_lora_a")
+        if a is None:
+            return y
+        b = p[f"{name}_lora_b"]
+        scale = lora_scaling(cfg.lora_rank, cfg.lora_alpha)
+        return y + (scale * ((x @ a) @ b)).astype(y.dtype)
+    return y
+
+
 def layer_norm_apply(cfg: DeepSpeedTransformerConfig, x, scale, bias):
     """The block's LayerNorm (module-level so the KV-cache decode path
     shares the exact arithmetic). stochastic_mode keeps LN statistics in
@@ -206,6 +296,7 @@ def transformer_block_apply(
     dropout_rng=None,
     ffn_fn=None,
     return_kv=False,
+    lora=None,
 ):
     """Pure-function transformer block over the 12-tensor param dict ``p``
     (keys per TRANSFORMER_PARAM_LAYOUT). Shared by the flax layer module
@@ -226,7 +317,12 @@ def transformer_block_apply(
     the values the cache must hold, so no second projection pass runs.
     Result becomes ``(out, (k, v))``; remat is skipped (no backward
     exists to recompute for) and MoE aux / sequence parallelism do not
-    compose with it."""
+    compose with it.
+
+    ``lora``: optional batched adapter source for :func:`apply_lora`
+    (the serving prefill path); per-layer A/B pairs in ``p`` cover the
+    fine-tune path. An ``ffn_fn`` (MoE) replaces the dense FFN, so the
+    inter_w/output_w targets do not apply under it."""
     H = cfg.hidden_size
     heads = cfg.heads
     head_dim = H // heads
@@ -268,7 +364,10 @@ def transformer_block_apply(
             layer_norm(x, p["attn_nw"], p["attn_nb"])
             if cfg.pre_layer_norm else x
         )
-        qkv = attn_in @ p["attn_qkvw"] + p["attn_qkvb"]
+        qkv = apply_lora(
+            cfg, p, lora, "attn_qkvw", attn_in,
+            attn_in @ p["attn_qkvw"] + p["attn_qkvb"],
+        )
         q, k_, v = jnp.split(qkv, 3, axis=-1)
         # [B,S,H] -> [B,heads,S,hd]  (the reference's
         # bias_add_transform_0213, transform_kernels.cu:149)
@@ -315,7 +414,9 @@ def transformer_block_apply(
                 mesh=mesh,
             )
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, H)  # transform4d_0213
-        attn_out = ctx @ p["attn_ow"] + p["attn_ob"]
+        attn_out = apply_lora(
+            cfg, p, lora, "attn_ow", ctx, ctx @ p["attn_ow"] + p["attn_ob"]
+        )
         attn_out = hid_dropout(attn_out, h1_rng)
         x = residual + attn_out
         if not cfg.pre_layer_norm:
@@ -333,9 +434,14 @@ def transformer_block_apply(
             if isinstance(h, tuple):
                 h, ffn_aux = h
         else:
-            h = ff_in @ p["inter_w"] + p["inter_b"]
+            h = apply_lora(
+                cfg, p, lora, "inter_w", ff_in,
+                ff_in @ p["inter_w"] + p["inter_b"],
+            )
             h = nn.gelu(h, approximate=True)  # tanh-approx gelu, gelu_kernels.cu:38
-            h = h @ p["output_w"] + p["output_b"]
+            h = apply_lora(
+                cfg, p, lora, "output_w", h, h @ p["output_w"] + p["output_b"]
+            )
         h = hid_dropout(h, h2_rng)
         x = residual + h
         if not cfg.pre_layer_norm:
@@ -359,7 +465,8 @@ def transformer_block_apply(
     return block(hidden_states)
 
 
-def _decode_block_core(cfg, p, hidden_states, positions, kv_commit):
+def _decode_block_core(cfg, p, hidden_states, positions, kv_commit,
+                       lora=None):
     """The shared single-token decode block: LN/qkv/attention/FFN, with
     the CACHE CONTAINER abstracted behind ``kv_commit(k_new, v_new) ->
     (k_full, v_full, carry)`` — ``k_full``/``v_full`` are [B, heads, K,
@@ -369,7 +476,12 @@ def _decode_block_core(cfg, p, hidden_states, positions, kv_commit):
     through this function, which is what makes their greedy decode
     bitwise-identical (pinned in tests/unit/test_paged_kv.py): identical
     einsum contractions over identical K, and masked positions contribute
-    exactly 0.0 whatever garbage the physical layout parks there."""
+    exactly 0.0 whatever garbage the physical layout parks there.
+
+    ``lora``: optional ``(pools, ids, scale)`` batched-adapter source
+    (:func:`apply_lora`) — per-slot gathered A/B matmuls on every
+    targeted projection, so one fixed-shape decode program serves slots
+    running DIFFERENT adapters concurrently (id 0 = identity)."""
     H = cfg.hidden_size
     heads = cfg.heads
     head_dim = H // heads
@@ -384,7 +496,10 @@ def _decode_block_core(cfg, p, hidden_states, positions, kv_commit):
         ln(hidden_states, p["attn_nw"], p["attn_nb"])
         if cfg.pre_layer_norm else hidden_states
     )
-    qkv = attn_in @ p["attn_qkvw"] + p["attn_qkvb"]  # [B, 1, 3H]
+    qkv = apply_lora(
+        cfg, p, lora, "attn_qkvw", attn_in,
+        attn_in @ p["attn_qkvw"] + p["attn_qkvb"],
+    )  # [B, 1, 3H]
     q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, heads, head_dim)
     k_new = k_new.reshape(b, heads, head_dim)
@@ -410,7 +525,9 @@ def _decode_block_core(cfg, p, hidden_states, positions, kv_commit):
         "bhk,bhkd->bhd", probs.astype(v_full.dtype), v_full
     )
     ctx = ctx.reshape(b, 1, H)
-    attn_out = ctx @ p["attn_ow"] + p["attn_ob"]
+    attn_out = apply_lora(
+        cfg, p, lora, "attn_ow", ctx, ctx @ p["attn_ow"] + p["attn_ob"]
+    )
     x = residual + attn_out
     if not cfg.pre_layer_norm:
         x = ln(x, p["attn_nw"], p["attn_nb"])
@@ -418,9 +535,13 @@ def _decode_block_core(cfg, p, hidden_states, positions, kv_commit):
     # ---- feed-forward sublayer (identical to the training block) ------
     residual = x
     ff_in = ln(x, p["norm_w"], p["norm_b"]) if cfg.pre_layer_norm else x
-    h = ff_in @ p["inter_w"] + p["inter_b"]
+    h = apply_lora(
+        cfg, p, lora, "inter_w", ff_in, ff_in @ p["inter_w"] + p["inter_b"]
+    )
     h = nn.gelu(h, approximate=True)
-    h = h @ p["output_w"] + p["output_b"]
+    h = apply_lora(
+        cfg, p, lora, "output_w", h, h @ p["output_w"] + p["output_b"]
+    )
     x = residual + h
     if not cfg.pre_layer_norm:
         x = ln(x, p["norm_w"], p["norm_b"])
@@ -434,6 +555,7 @@ def transformer_block_decode(
     k_cache,
     v_cache,
     positions,
+    lora=None,
 ):
     """One KV-cache incremental-decode step through the block.
 
@@ -470,7 +592,9 @@ def transformer_block_decode(
         )
         return kc, vc, (kc, vc)
 
-    x, (kc, vc) = _decode_block_core(cfg, p, hidden_states, positions, commit)
+    x, (kc, vc) = _decode_block_core(
+        cfg, p, hidden_states, positions, commit, lora=lora
+    )
     return x, kc, vc
 
 
@@ -482,6 +606,7 @@ def transformer_block_decode_paged(
     v_pool,
     block_tables,
     positions,
+    lora=None,
 ):
     """One incremental-decode step over a BLOCK-PAGED KV cache.
 
@@ -526,7 +651,9 @@ def transformer_block_decode_paged(
         ).transpose(0, 2, 1, 3)
         return k_full, v_full, (kp, vp)
 
-    x, (kp, vp) = _decode_block_core(cfg, p, hidden_states, positions, commit)
+    x, (kp, vp) = _decode_block_core(
+        cfg, p, hidden_states, positions, commit, lora=lora
+    )
     return x, kp, vp
 
 
@@ -538,6 +665,7 @@ def transformer_block_prefill_paged(
     v_pool,
     block_tables,
     start_pos,
+    lora=None,
 ):
     """Suffix prefill through one block against cached prefix pages: the
     CROSS-REQUEST PREFIX CACHE's compute-skip path (docs/inference.md).
@@ -572,7 +700,10 @@ def transformer_block_prefill_paged(
         ln(hidden_states, p["attn_nw"], p["attn_nb"])
         if cfg.pre_layer_norm else hidden_states
     )
-    qkv = attn_in @ p["attn_qkvw"] + p["attn_qkvb"]  # [B, S, 3H]
+    qkv = apply_lora(
+        cfg, p, lora, "attn_qkvw", attn_in,
+        attn_in @ p["attn_qkvw"] + p["attn_qkvb"],
+    )  # [B, S, 3H]
     q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
 
     def split_heads(t):
@@ -614,7 +745,9 @@ def transformer_block_prefill_paged(
         "bhsk,bhkd->bhsd", probs.astype(v_full.dtype), v_full
     )
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, H)
-    attn_out = ctx @ p["attn_ow"] + p["attn_ob"]
+    attn_out = apply_lora(
+        cfg, p, lora, "attn_ow", ctx, ctx @ p["attn_ow"] + p["attn_ob"]
+    )
     x = residual + attn_out
     if not cfg.pre_layer_norm:
         x = ln(x, p["attn_nw"], p["attn_nb"])
@@ -622,9 +755,13 @@ def transformer_block_prefill_paged(
     # ---- feed-forward sublayer ---------------------------------------
     residual = x
     ff_in = ln(x, p["norm_w"], p["norm_b"]) if cfg.pre_layer_norm else x
-    h = ff_in @ p["inter_w"] + p["inter_b"]
+    h = apply_lora(
+        cfg, p, lora, "inter_w", ff_in, ff_in @ p["inter_w"] + p["inter_b"]
+    )
     h = nn.gelu(h, approximate=True)
-    h = h @ p["output_w"] + p["output_b"]
+    h = apply_lora(
+        cfg, p, lora, "output_w", h, h @ p["output_w"] + p["output_b"]
+    )
     x = residual + h
     if not cfg.pre_layer_norm:
         x = ln(x, p["norm_w"], p["norm_b"])
@@ -665,6 +802,24 @@ class DeepSpeedTransformerLayer(nn.Module):
             )
             for name, dims, kind in TRANSFORMER_PARAM_LAYOUT
         }
+        if cfg.lora_rank > 0:
+            # rank-r A/B pairs beside their base matrices: A ~ N(0, std)
+            # and B = 0, so the initial delta is EXACTLY zero and a fresh
+            # adapter starts from the base model's behavior (Hu et al.).
+            # NOTE: a from-scratch init of a rank-r module draws DIFFERENT
+            # base values than a rank-0 init (nn.scan's rng splitting is
+            # call-count based) — to adapt an existing base bitwise, init
+            # the base rank-0 and grow adapters with
+            # adapters.init_lora_params (the engine's "adapters" path).
+            r = int(cfg.lora_rank)
+            for t in resolve_lora_targets(cfg.lora_targets):
+                din, dout = (shapes[d] for d in LORA_TARGET_DIMS[t])
+                p[f"{t}_lora_a"] = self.param(
+                    f"{t}_lora_a", init, (din, r), dtype
+                )
+                p[f"{t}_lora_b"] = self.param(
+                    f"{t}_lora_b", nn.initializers.zeros, (r, dout), dtype
+                )
 
         need_rng = train and (
             cfg.attn_dropout_ratio > 0 or cfg.hidden_dropout_ratio > 0

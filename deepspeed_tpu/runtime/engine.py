@@ -280,6 +280,22 @@ class DeepSpeedEngine:
             self.config
         )
 
+        # ---- multi-tenant LoRA adapters (docs/adapters.md) ------------
+        # With the "adapters" block enabled the TRAINABLE tree is the
+        # rank-r A/B pairs ALONE: the base params freeze into a pinned
+        # compute-dtype tree the loss closure merges back in, and every
+        # downstream stage (ZeRO specs, optimizer state, grad buffer,
+        # checkpoints) sees only the adapter leaves — which is exactly
+        # what makes adapter checkpoints tiny per-tenant artifacts and
+        # the base bitwise-frozen across any number of fine-tune steps.
+        self.adapters_enabled = bool(self.config.adapters_enabled)
+        self.frozen_base_params = None
+        self._frozen_n_params = 0
+        if self.adapters_enabled:
+            model_parameters = self._configure_adapters(
+                model, model_parameters, rng_seed
+            )
+
         # ---- ZeRO shardings -------------------------------------------
         stage = self.config.zero_optimization_stage
         self.zero_stage = stage
@@ -290,8 +306,9 @@ class DeepSpeedEngine:
             lambda p: jnp.array(p, dtype=jnp.float32, copy=True), model_parameters
         )
         # parameter count feeds telemetry's model-TFLOPS gauge (bench.py's
-        # 6*N-per-token accounting)
-        self._n_params = sum(
+        # 6*N-per-token accounting); a LoRA fine-tune still pushes every
+        # token through the frozen base, so those params count too
+        self._n_params = self._frozen_n_params + sum(
             int(np.prod(p.shape))
             for p in jax.tree_util.tree_leaves(params_f32)
         )
@@ -687,6 +704,107 @@ class DeepSpeedEngine:
         raise TypeError(
             "model must be a flax Module or a callable loss_fn(params, batch, rng)"
         )
+
+    def _configure_adapters(self, model, model_parameters, rng_seed):
+        """LoRA fine-tune wiring (docs/adapters.md): split/grow the
+        adapter tree, freeze the base, and return the adapter tree as
+        the engine's trainable parameters.
+
+        The module's config is armed with the block's rank/alpha/targets
+        (the same pre-trace mutation pattern as the mesh injection) so
+        ``model.apply`` consumes the merged tree's ``*_lora_*`` leaves.
+        ``model_parameters`` may already carry adapter leaves (a module
+        initialized with ``lora_rank > 0``, or a resumed fine-tune) —
+        they are split out; otherwise a fresh adapter tree grows beside
+        the base (A ~ N(0, 0.02), B = 0: the first forward is the base
+        model bitwise). The frozen base pins to its model-parallel
+        shardings in the compute dtype and is only ever READ — no
+        optimizer state, no gradients, no donation — so it stays
+        bitwise-identical across every fine-tune step.
+        """
+        from ..adapters import lora as lora_lib
+
+        cfg = self.config
+        rank = int(cfg.adapters_rank)
+        alpha = float(cfg.adapters_alpha or 0.0)
+        targets = lora_lib.resolve_lora_targets(cfg.adapters_targets)
+        mcfg = getattr(model, "config", None)
+        if mcfg is not None and hasattr(mcfg, "lora_rank"):
+            if getattr(mcfg, "lora_rank", 0) == 0:
+                mcfg.lora_rank = rank
+                mcfg.lora_alpha = alpha
+                mcfg.lora_targets = targets
+            elif (
+                int(mcfg.lora_rank) != rank
+                or lora_lib.resolve_lora_targets(mcfg.lora_targets)
+                != targets
+            ):
+                raise DeepSpeedConfigError(
+                    f"model config carries lora_rank="
+                    f"{mcfg.lora_rank}/targets="
+                    f"{tuple(mcfg.lora_targets)} but the adapters block "
+                    f"asks for rank={rank}/targets={targets}; make them "
+                    "agree (or leave the model at lora_rank=0 and let "
+                    "the engine arm it)"
+                )
+        base, adapters = lora_lib.split_lora_params(model_parameters)
+        if not adapters:
+            adapters = lora_lib.init_lora_params(
+                base, rank, targets=targets,
+                rng=jax.random.PRNGKey(rng_seed),
+            )
+        # model-parallel specs split the same way the params do: the
+        # engine's spec machinery sees adapter specs only, the frozen
+        # base keeps its own
+        base_specs = None
+        if self._model_specs is not None:
+            base_specs, adapter_specs = lora_lib.split_lora_params(
+                self._model_specs
+            )
+            self._model_specs = adapter_specs or None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if base_specs:
+            base_shardings = zero_lib.specs_to_shardings(
+                base_specs, self._mesh
+            )
+        else:
+            base_shardings = jax.tree_util.tree_map(
+                lambda _: NamedSharding(self._mesh, PartitionSpec()), base
+            )
+        self.frozen_base_params = jax.device_put(
+            jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p, self.compute_dtype), base
+            ),
+            base_shardings,
+        )
+        self._frozen_n_params = sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(base)
+        )
+        # the loss closes over the frozen tree and differentiates ONLY
+        # the adapter tree — base cotangents are never formed, and the
+        # merge is pure dict surgery inside the jitted program
+        inner_loss = self._loss_fn
+        frozen = self.frozen_base_params
+        merge = lora_lib.merge_lora_params
+
+        def lora_loss(adapter_params, batch, rng):
+            return inner_loss(merge(frozen, adapter_params), batch, rng)
+
+        self._loss_fn = lora_loss
+        self._adapters_meta = {
+            "rank": rank, "alpha": alpha, "targets": list(targets),
+        }
+        n_adapter = lora_lib.adapter_num_params(adapters)
+        log_dist(
+            f"adapters: LoRA fine-tune — rank {rank} on "
+            f"{list(targets)}; {n_adapter} trainable adapter params, "
+            f"{self._frozen_n_params} base params frozen "
+            f"({100.0 * n_adapter / max(self._frozen_n_params, 1):.2f}%)",
+            ranks=[0],
+        )
+        return adapters
 
     def _check_zero_optimizer_tested(self, name):
         """ZeRO wrapping an optimizer outside the tested set requires the
@@ -2165,6 +2283,12 @@ class DeepSpeedEngine:
         # persisted counters must be truthful: settle ALL in-flight
         # device-side skip flags, including the newest window's
         self._reconcile_deferred(keep_last=False)
+        if getattr(self, "adapters_enabled", False):
+            # an adapter-only checkpoint self-describes its geometry:
+            # serving-side load_adapter validates rank/targets against
+            # its own pool before writing any rows
+            client_state = dict(client_state or {})
+            client_state.setdefault("adapters", dict(self._adapters_meta))
         # a large-model save can outlast the watchdog timeout; suspend
         # stall detection for its whole duration, not just a beat around it
         with self.telemetry.liveness_exempt():

@@ -33,6 +33,7 @@ from .admission import (
 from .replica import InProcessReplica, RemoteRequest, SubprocessReplica
 from .router import (
     PLACEMENT_POLICIES,
+    AdapterAffinity,
     FleetRequest,
     FleetRouter,
     LeastLoaded,
@@ -152,6 +153,7 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
 
 
 __all__ = [
+    "AdapterAffinity",
     "AdmissionController",
     "FleetOverloaded",
     "FleetRequest",

@@ -107,6 +107,25 @@ class InProcessReplica(ReplicaBase):
         snap["failed"] = bool(snap["driver_failed"])
         return snap
 
+    def load_adapter(self, name, **kwargs):
+        """Install a LoRA adapter into this replica's in-HBM pool
+        (docs/adapters.md); accepts the engine's ``adapter_state`` /
+        ``load_dir`` / ``tag`` kwargs."""
+        engine = self.engine
+        if engine is None:
+            raise RuntimeError(
+                f"replica {self.replica_id} is not running"
+            )
+        return engine.load_adapter(name, **kwargs)
+
+    def unload_adapter(self, name):
+        engine = self.engine
+        if engine is None:
+            raise RuntimeError(
+                f"replica {self.replica_id} is not running"
+            )
+        return engine.unload_adapter(name)
+
     # -- lifecycle ------------------------------------------------------
     def drain(self):
         engine = self.engine
@@ -385,8 +404,45 @@ class SubprocessReplica(ReplicaBase):
             reason = reply.get("reason")
             if reason in REJECT_REASONS:
                 raise RequestRejected(reply["error"], reason=reason)
+            if reply.get("error_type") == "AdapterUnavailable":
+                from ..adapters.pool import AdapterUnavailable
+
+                # typed across the pipe: the router drops THIS replica
+                # from the candidate set instead of failing the request
+                raise AdapterUnavailable(reply["error"])
             raise ValueError(reply["error"])
         return req
+
+    def load_adapter(self, name, load_dir=None, tag=None, timeout=60.0,
+                     **kwargs):
+        """Install a LoRA adapter on the worker. Only checkpoint-backed
+        loads cross the process boundary (``load_dir``/``tag`` — adapter
+        trees are weights, not JSON; commit them with the training
+        engine's save_checkpoint and load by directory). A generous
+        timeout: the worker reads + verifies + device-puts the rows."""
+        if kwargs:
+            raise ValueError(
+                "subprocess replicas load adapters from checkpoint "
+                f"directories only (load_dir=...); got {sorted(kwargs)}"
+            )
+        if load_dir is None:
+            raise ValueError("load_dir is required")
+        reply = self._call(
+            {"op": "load_adapter", "name": str(name),
+             "load_dir": str(load_dir), "tag": tag},
+            timeout=timeout,
+        )
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return int(reply["index"])
+
+    def unload_adapter(self, name, timeout=30.0):
+        reply = self._call(
+            {"op": "unload_adapter", "name": str(name)}, timeout=timeout
+        )
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return int(reply["index"])
 
     def load_snapshot(self):
         if self._proc is None or self._proc.poll() is not None:

@@ -39,6 +39,7 @@ import math
 import threading
 import time
 
+from ..adapters.pool import AdapterUnavailable
 from ..inference.scheduler import (
     REJECT_DEADLINE,
     REJECT_DRAINING,
@@ -71,8 +72,8 @@ class LeastLoaded:
 
     name = "least_loaded"
 
-    def choose(self, candidates, prompt_tokens):
-        del prompt_tokens
+    def choose(self, candidates, prompt_tokens, context=None):
+        del prompt_tokens, context
         best_i = min(
             range(len(candidates)),
             key=lambda i: (_load_score(candidates[i][1]), i),
@@ -91,8 +92,8 @@ class RoundRobin:
     def __init__(self):
         self._turn = itertools.count()
 
-    def choose(self, candidates, prompt_tokens):
-        del prompt_tokens
+    def choose(self, candidates, prompt_tokens, context=None):
+        del prompt_tokens, context
         return candidates[next(self._turn) % len(candidates)][0]
 
     def forget(self, replica_id):
@@ -131,7 +132,8 @@ class PrefixAffinity:
     def _key(self, prompt_tokens):
         return hash(tuple(prompt_tokens[: self.prefix_tokens]))
 
-    def choose(self, candidates, prompt_tokens):
+    def choose(self, candidates, prompt_tokens, context=None):
+        del context
         key = self._key(prompt_tokens)
         sticky = self._affinity.get(key)
         for rid, snap in candidates:
@@ -158,12 +160,46 @@ class PrefixAffinity:
             del self._affinity[key]
 
 
+class AdapterAffinity:
+    """Adapter-resident placement (docs/adapters.md): a request carrying
+    ``adapter=name`` routes to a replica whose snapshot already reports
+    that adapter in its in-HBM pool (``adapters_loaded``), least-loaded
+    among the holders — landing where the weights are resident avoids a
+    per-replica cold load and keeps the adapter's salted prefix pages
+    hot on the same replica. Requests without an adapter (and adapters
+    no replica holds) fall back to plain least-loaded; ``last_hit``
+    mirrors PrefixAffinity's counted-on-placement contract."""
+
+    name = "adapter_affinity"
+
+    def __init__(self, base=None):
+        self._base = base or LeastLoaded()
+        self.last_hit = False
+
+    def choose(self, candidates, prompt_tokens, context=None):
+        adapter = (context or {}).get("adapter")
+        if adapter is not None:
+            holders = [
+                c for c in candidates
+                if adapter in (c[1].get("adapters_loaded") or ())
+            ]
+            if holders:
+                self.last_hit = True
+                return self._base.choose(holders, prompt_tokens)
+        self.last_hit = False
+        return self._base.choose(candidates, prompt_tokens)
+
+    def forget(self, replica_id):
+        pass
+
+
 PLACEMENT_POLICIES = {
     "least_loaded": lambda cfg: LeastLoaded(),
     "round_robin": lambda cfg: RoundRobin(),
     "prefix_affinity": lambda cfg: PrefixAffinity(
         prefix_tokens=cfg.get("affinity_prefix_tokens", 16)
     ),
+    "adapter_affinity": lambda cfg: AdapterAffinity(),
 }
 
 
@@ -281,6 +317,12 @@ class FleetRouter:
             per_tenant=per_tenant_limits, clock=clock,
         )
         self.routed_counts = {rid: 0 for rid in self._order}
+        # fleet adapter registry: adapters loaded FLEET-WIDE are recorded
+        # (name -> load kwargs) and replayed onto every replica a restart
+        # rebuilds — a rolling restart must not silently shed the tenants'
+        # weights (docs/adapters.md). Targeted loads (replica_ids=...)
+        # stay the caller's business.
+        self._adapter_registry = {}
         self._draining = False
         self._stop = threading.Event()
         self._monitor = None
@@ -311,6 +353,7 @@ class FleetRouter:
         self._affinity_hits = reg.counter("fleet/affinity_hits")
         self._restarts = reg.counter("fleet/replica_restarts")
         self._evictions = reg.counter("fleet/replicas_evicted")
+        self._adapter_loads = reg.counter("fleet/adapter_loads")
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
@@ -394,6 +437,19 @@ class FleetRouter:
                 replica_id, wait_timeout,
             )
         replica.restart()
+        # a rebuilt replica starts with an EMPTY adapter pool: replay the
+        # fleet-wide registry before traffic routes back to it, so tenant
+        # requests never bounce off a restarted replica
+        for name, kwargs in list(self._adapter_registry.items()):
+            try:
+                replica.load_adapter(name, **kwargs)
+                self._adapter_loads.inc()
+            except Exception:
+                logger.exception(
+                    "fleet: reloading adapter %r onto restarted replica "
+                    "%s failed; its requests will fail on this replica",
+                    name, replica_id,
+                )
         self._restarts.inc()
         with self._lock:
             self._evicted.discard(replica_id)
@@ -427,6 +483,56 @@ class FleetRouter:
                 return
             self.restart_replica(rid, wait_timeout=wait_timeout)
         self.refresh_telemetry()
+
+    # -- adapter registry (docs/adapters.md) ----------------------------
+    def load_adapter(self, name, replica_ids=None, **kwargs):
+        """Install LoRA adapter ``name`` on the named (default: every
+        non-evicted) replicas — the fleet's adapter registry write path.
+        ``kwargs`` pass to the replica's ``load_adapter`` (``load_dir``
+        for checkpoint-backed loads — the only cross-process form;
+        ``adapter_state`` additionally works in-process). Returns
+        ``{replica_id: pool row}``; a per-replica failure aborts with the
+        partial result attached (``exc.partial``) so the caller can
+        retry or roll back the replicas that did load. Fleet-wide loads
+        register so restarts REPLAY them onto rebuilt replicas."""
+        fleet_wide = replica_ids is None
+        if replica_ids is None:
+            replica_ids = [
+                rid for rid in self._order if rid not in self._evicted
+            ]
+        results = {}
+        for rid in replica_ids:
+            try:
+                results[rid] = self._replicas[rid].load_adapter(
+                    name, **kwargs
+                )
+            except Exception as e:
+                e.partial = dict(results)
+                raise
+        if fleet_wide:
+            self._adapter_registry[name] = dict(kwargs)
+        self._adapter_loads.inc(len(results))
+        self.refresh_telemetry()
+        return results
+
+    def unload_adapter(self, name, replica_ids=None):
+        """Evict adapter ``name`` from the named (default: all
+        non-evicted) replicas; replicas refusing (live requests) raise.
+        Returns ``{replica_id: freed pool row}``."""
+        if replica_ids is None:
+            self._adapter_registry.pop(name, None)
+            replica_ids = [
+                rid for rid in self._order if rid not in self._evicted
+            ]
+        results = {}
+        for rid in replica_ids:
+            try:
+                results[rid] = self._replicas[rid].unload_adapter(name)
+            except Exception as e:
+                e.partial = dict(results)
+                raise
+        self.refresh_telemetry()
+        return results
 
     # -- submission -----------------------------------------------------
     def submit(self, prompt_tokens, tenant="default", priority=0, **kwargs):
@@ -533,17 +639,25 @@ class FleetRouter:
         that reject at their own door. Returns (inner_handle, replica_id)
         or (None, None)."""
         candidates = list(candidates)
+        context = {
+            "adapter": fleet_req.kwargs.get("adapter"),
+            "tenant": fleet_req.tenant,
+        }
         while candidates:
             with self._placement_lock:
                 rid = self.placement.choose(
-                    candidates, fleet_req.prompt_tokens
+                    candidates, fleet_req.prompt_tokens, context=context
                 )
                 was_hit = getattr(self.placement, "last_hit", False)
             try:
                 inner = self._replicas[rid].submit(
                     fleet_req.prompt_tokens, **fleet_req.kwargs
                 )
-            except RequestRejected:
+            except (RequestRejected, AdapterUnavailable):
+                # AdapterUnavailable is per-REPLICA, not per-request: a
+                # replica missing the adapter (failed restart replay,
+                # targeted load) drops from the candidate set and the
+                # request falls through to a replica that holds it
                 candidates = [c for c in candidates if c[0] != rid]
                 continue
             if was_hit:
@@ -683,6 +797,7 @@ class FleetRouter:
         available = 0
         prefix_hits = 0
         prefix_lookups = 0
+        adapters_resident = set()
         routable = self._routable_ids()
         for rid in self._order:
             if rid in self._evicted:
@@ -718,6 +833,13 @@ class FleetRouter:
                         snap.get("prefix_hits", 0)
                         + snap.get("prefix_misses", 0)
                     )
+                if "adapters_loaded" in snap:
+                    # multi-LoRA replicas report their resident adapters
+                    # — the per-replica gauge adapter-affinity placement
+                    # is effectively acting on
+                    loaded = snap.get("adapters_loaded") or []
+                    reg.gauge(f"{prefix}/adapters_loaded").set(len(loaded))
+                    adapters_resident.update(loaded)
                 total_queue += snap["queue_depth"]
                 total_active += snap["active_slots"]
                 # degraded replicas still take priority-0 traffic, so
@@ -734,6 +856,7 @@ class FleetRouter:
         reg.gauge("fleet/prefix_hit_rate").set(
             prefix_hits / prefix_lookups if prefix_lookups else 0.0
         )
+        reg.gauge("fleet/adapters_loaded").set(len(adapters_resident))
         self._ttft_p50.set(histogram_quantile(self._ttft, 0.50))
         self._ttft_p99.set(histogram_quantile(self._ttft, 0.99))
         self._last_refresh = self._clock()

@@ -12,6 +12,12 @@ Protocol (one JSON object per line):
     {"op": "submit", "id": N, "prompt": [...],
      "max_new_tokens": M, "kwargs": {...}}   admit one request
     {"op": "snapshot", "id": N}              router-facing load snapshot
+    {"op": "load_adapter", "id": N,
+     "name": "...", "load_dir": "...",
+     "tag": ... }                            install a LoRA adapter from
+                                             an adapter-only checkpoint
+    {"op": "unload_adapter", "id": N,
+     "name": "..."}                          evict a LoRA adapter
     {"op": "drain"}                          stop admitting, finish work
     {"op": "shutdown"}                       close the engine and exit
 
@@ -117,7 +123,13 @@ class WorkerServer:
             })
             return
         except (ValueError, TypeError) as e:
-            self._emit({"event": "reply", "id": rpc_id, "error": str(e)})
+            # error_type distinguishes "this replica lacks the adapter"
+            # (AdapterUnavailable — the router falls through to a holder)
+            # from a genuinely invalid request
+            self._emit({
+                "event": "reply", "id": rpc_id, "error": str(e),
+                "error_type": type(e).__name__,
+            })
             return
         with self._state_lock:
             self._tracked[rpc_id] = (req, False)
@@ -128,6 +140,19 @@ class WorkerServer:
             "event": "reply", "id": msg["id"],
             "snapshot": self._engine.load_snapshot(),
         })
+
+    def _op_adapter(self, msg, fn):
+        """Shared load/unload wrapper: adapter management failures are
+        op-level errors (the replica raises them to its caller), never
+        worker crashes."""
+        try:
+            idx = fn()
+        except Exception as e:
+            self._emit({
+                "event": "reply", "id": msg["id"], "error": str(e),
+            })
+            return
+        self._emit({"event": "reply", "id": msg["id"], "index": int(idx)})
 
     def run(self):
         """Serve ops until shutdown/EOF. Returns 0 (clean) or 1 (an op
@@ -145,6 +170,19 @@ class WorkerServer:
                     self._op_submit(msg)
                 elif op == "snapshot":
                     self._op_snapshot(msg)
+                elif op == "load_adapter":
+                    self._op_adapter(
+                        msg,
+                        lambda: self._engine.load_adapter(
+                            msg["name"], load_dir=msg.get("load_dir"),
+                            tag=msg.get("tag"),
+                        ),
+                    )
+                elif op == "unload_adapter":
+                    self._op_adapter(
+                        msg,
+                        lambda: self._engine.unload_adapter(msg["name"]),
+                    )
                 elif op == "drain":
                     self._engine.scheduler.drain()
                 elif op == "shutdown":

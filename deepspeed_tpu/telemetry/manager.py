@@ -138,7 +138,33 @@ SERVING_METRICS = (
     ("counter", "fleet/replica_restarts", "replica restarts driven by the router (rolling_restart or explicit restart)"),
     ("counter", "fleet/replicas_evicted", "replicas evicted after their decode driver failed past its restart budget"),
     ("gauge", "fleet/prefix_hit_rate", "fleet-wide prefix-cache hit rate (sum of replica hits / lookups at the last refresh; 0 with no paged replicas)"),
+    ("counter", "fleet/adapter_loads", "per-replica LoRA adapter installs driven through the router's load_adapter"),
+    ("gauge", "fleet/adapters_loaded", "distinct LoRA adapters resident across the fleet at the last refresh"),
 )
+
+
+# Multi-tenant LoRA serving (deepspeed_tpu/adapters/, docs/adapters.md).
+# Registered by InferenceEngine ONLY when the "adapters" block is enabled
+# — adapter-free engines keep their exports at the pinned INFERENCE_METRICS
+# golden set. Per-adapter request counters ride dynamically as
+# adapters/requests/{name} (like the router's per-replica gauges: tenant
+# names are runtime values, not catalog constants).
+ADAPTER_METRICS = (
+    ("gauge", "adapters/pool_occupancy", "adapter pool rows holding a loaded adapter (the identity row 0 is not counted)"),
+    ("gauge", "adapters/pool_slots", "adapter pool capacity: loadable rows (adapters.pool_slots; identity row 0 rides extra)"),
+    ("counter", "adapters/loads", "adapters installed into the in-HBM pool (hot reloads included)"),
+    ("counter", "adapters/evictions", "adapters evicted from the pool (idle-LRU under load pressure, or explicit unload)"),
+    ("counter", "adapters/requests", "submissions carrying an adapter (per-adapter counts ride adapters/requests/{name})"),
+)
+
+
+def register_adapter_metrics(registry):
+    """Pre-register the adapters/* catalog on ``registry`` (same golden-
+    set contract as the other catalogs: an absent stream means a broken
+    emitter, not an idle pool)."""
+    for kind, name, help_text in ADAPTER_METRICS:
+        getattr(registry, kind)(name, help=help_text)
+    return registry
 
 
 def register_serving_metrics(registry):

@@ -1,0 +1,727 @@
+"""Multi-tenant LoRA adapter tests (deepspeed_tpu/adapters/,
+docs/adapters.md): adapter-off bitwise parity, rank-0/id-0 identity, the
+frozen-base fine-tune contract, mixed-adapter batched decode parity, the
+zero-recompile pin across adapter mix changes, adapter checkpoint
+save/load through the verified path, pool eviction/refcounts, the
+adapter-salted prefix cache, partition-spec placement, and the
+_check_adapters validation matrix."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.adapters import (
+    AdapterPool,
+    AdapterPoolFull,
+    adapter_layer_stacks,
+    adapter_num_params,
+    init_lora_params,
+    merge_lora_params,
+    split_lora_params,
+)
+from deepspeed_tpu.config.config import DeepSpeedConfigError
+from deepspeed_tpu.models.gpt2 import (
+    GPT2Config,
+    GPT2LMHeadModel,
+    adapter_pool_partition_specs,
+    partition_specs,
+)
+
+VOCAB = 97
+
+
+def _small_model(seed=0, **kw):
+    cfg = GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False, **kw,
+    )
+    model = GPT2LMHeadModel(cfg)
+    ids0 = jnp.asarray(
+        np.random.default_rng(seed).integers(0, VOCAB, (1, 8)), jnp.int32
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(seed),
+         "dropout": jax.random.PRNGKey(seed + 1)},
+        ids0, ids0,
+    )["params"]
+    return cfg, model, params
+
+
+def _prompt(n=8, seed=1):
+    return [int(t) for t in np.random.default_rng(seed).integers(0, VOCAB, n)]
+
+
+def _synth_adapter(params, seed, rank=2, scale=0.2):
+    """A synthetic NONZERO adapter (random A and B): behaves differently
+    from the base model, which is what serving tests need to observe."""
+    ada = init_lora_params(
+        jax.tree_util.tree_map(np.asarray, params), rank,
+        rng=jax.random.PRNGKey(seed),
+    )
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(
+            jax.random.normal(
+                jax.random.fold_in(jax.random.PRNGKey(seed), a.size),
+                a.shape,
+            ) * scale,
+            np.float32,
+        ),
+        ada,
+    )
+
+
+def _lora_engine(model, params, inference=None, adapters=None):
+    block = {"max_batch_slots": 3, "max_seq_len": 48, "prefill_len": 16,
+             "sampling": {"greedy": True}}
+    block.update(inference or {})
+    ad = {"enabled": True, "rank": 2, "pool_slots": 4}
+    ad.update(adapters or {})
+    return deepspeed_tpu.init_inference(
+        model=model, model_parameters=params,
+        config={"inference": block, "adapters": ad},
+    )
+
+
+# ---------------------------------------------------------------------------
+# pytree surgery
+# ---------------------------------------------------------------------------
+def test_split_merge_roundtrip_and_fresh_init_shapes():
+    _cfg, _model, params = _small_model(lora_rank=3)
+    base, adapters = split_lora_params(params)
+    assert adapters, "flax-created lora leaves must split out"
+    assert all(
+        "_lora_" not in str(p[-1].key)
+        for p, _ in jax.tree_util.tree_flatten_with_path(base)[0]
+    )
+    rebuilt = merge_lora_params(base, adapters)
+    assert jax.tree_util.tree_structure(rebuilt) == (
+        jax.tree_util.tree_structure(params)
+    )
+    for (kp, a), (_kq, b) in zip(
+        jax.tree_util.tree_flatten_with_path(rebuilt)[0],
+        jax.tree_util.tree_flatten_with_path(params)[0],
+    ):
+        assert a is b, kp
+    # fresh growth beside a rank-0 base: same leaf names/shapes as flax's
+    _c0, _m0, base0 = _small_model()
+    fresh = init_lora_params(base0, 3)
+    assert jax.tree_util.tree_structure(fresh) == (
+        jax.tree_util.tree_structure(adapters)
+    )
+    for (kp, a), (_kq, b) in zip(
+        jax.tree_util.tree_flatten_with_path(fresh)[0],
+        jax.tree_util.tree_flatten_with_path(adapters)[0],
+    ):
+        assert a.shape == b.shape, kp
+    stacks = adapter_layer_stacks(fresh)
+    assert stacks["attn_qkvw"][0].shape == (2, 32, 3)
+    assert stacks["attn_qkvw"][1].shape == (2, 3, 96)
+    assert stacks["output_w"][0].shape == (2, 128, 3)
+
+
+def test_init_lora_params_rejects_bad_rank_and_missing_targets():
+    _cfg, _model, params = _small_model()
+    with pytest.raises(ValueError, match="rank"):
+        init_lora_params(params, 0)
+    with pytest.raises(ValueError, match="unknown LoRA target"):
+        init_lora_params(params, 2, targets=("attn_qkvw", "nope"))
+    with pytest.raises(ValueError, match="no LoRA target"):
+        init_lora_params({"x": np.zeros((4, 4))}, 2)
+
+
+# ---------------------------------------------------------------------------
+# adapter-off / identity parity
+# ---------------------------------------------------------------------------
+def test_fresh_adapter_forward_bitwise_matches_base():
+    """B = 0 at init => the merged rank-r forward IS the base forward,
+    bit for bit (the adapter-off parity contract)."""
+    cfg0, model0, params = _small_model()
+    ids = jnp.asarray([_prompt(12, seed=3)], jnp.int32)
+    base_logits = model0.apply({"params": params}, ids, train=False)
+    cfg_r = GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False, lora_rank=4,
+    )
+    merged = merge_lora_params(
+        params, init_lora_params(params, 4, rng=jax.random.PRNGKey(9))
+    )
+    lora_logits = GPT2LMHeadModel(cfg_r).apply(
+        {"params": merged}, ids, train=False
+    )
+    assert np.array_equal(np.asarray(base_logits), np.asarray(lora_logits))
+
+
+def test_id0_decode_bitwise_matches_adapter_free_engine():
+    """A multi-LoRA engine serving a request WITHOUT an adapter (id 0 =
+    all-zeros identity rows) generates bitwise what an engine with no
+    adapter pool at all generates."""
+    _cfg, model, params = _small_model()
+    prompt = _prompt(9, seed=5)
+    plain = deepspeed_tpu.init_inference(
+        model=model, model_parameters=params,
+        config={"inference": {
+            "max_batch_slots": 3, "max_seq_len": 48, "prefill_len": 16,
+            "sampling": {"greedy": True},
+        }},
+    )
+    base = plain.generate([prompt], max_new_tokens=10)[0]
+    plain.close()
+    eng = _lora_engine(model, params)
+    assert eng.generate([prompt], max_new_tokens=10)[0] == base
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# fine-tune path: frozen base, adapter-only optimizer state, checkpoints
+# ---------------------------------------------------------------------------
+def _finetune_engine(model, params, tmpdir=None, lr=0.1, extra=None):
+    config = {
+        "train_batch_size": 8,  # conftest meshes 8 virtual CPU devices
+        "optimizer": {"type": "adam", "params": {"lr": lr}},
+        "adapters": {"enabled": True, "rank": 2},
+    }
+    config.update(extra or {})
+    engine, _opt, _dl, _sched = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=config,
+    )
+    return engine
+
+
+def test_finetune_updates_only_adapters_base_bitwise_frozen():
+    _cfg, model, params = _small_model()
+    before = jax.tree_util.tree_map(np.asarray, params)
+    engine = _finetune_engine(model, params)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(0, VOCAB, (8, 16)), jnp.int32
+    )
+    # trainable tree is the adapter leaves alone — no base params, so no
+    # base optimizer state either
+    leaf_names = {
+        str(p[-1].key)
+        for p, _ in jax.tree_util.tree_flatten_with_path(engine.params)[0]
+    }
+    assert leaf_names and all("_lora_" in n for n in leaf_names)
+    losses = [float(engine.train_batch([(ids, ids)])) for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+    frozen = jax.tree_util.tree_map(
+        np.asarray, engine.frozen_base_params
+    )
+    for (kp, a), (_kq, b) in zip(
+        jax.tree_util.tree_flatten_with_path(frozen)[0],
+        jax.tree_util.tree_flatten_with_path(before)[0],
+    ):
+        assert np.array_equal(a, b.astype(a.dtype)), kp
+    # the adapters actually moved (B left zero)
+    moved = jax.tree_util.tree_map(np.asarray, engine.params)
+    b_leaves = [
+        a for p, a in jax.tree_util.tree_flatten_with_path(moved)[0]
+        if str(p[-1].key).endswith("_lora_b")
+    ]
+    assert any(np.any(b != 0) for b in b_leaves)
+
+
+def test_finetune_model_config_mismatch_rejected():
+    cfg, model, params = _small_model(lora_rank=3)
+    with pytest.raises(DeepSpeedConfigError, match="lora_rank"):
+        _finetune_engine(model, params)  # block asks rank 2, model says 3
+
+
+def test_adapter_checkpoint_roundtrip_and_size(tmp_path):
+    """Adapter-only checkpoints commit through the atomic protocol with
+    a manifest, self-describe their geometry, resume exactly, and load
+    into a serving pool through the verified path."""
+    _cfg, model, params = _small_model()
+    engine = _finetune_engine(model, params)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(0, VOCAB, (8, 16)), jnp.int32
+    )
+    for _ in range(2):
+        engine.train_batch([(ids, ids)])
+    tuned = jax.tree_util.tree_map(np.asarray, engine.params)
+    ckpt = str(tmp_path / "adapter_ckpt")
+    assert engine.save_checkpoint(ckpt, tag="t1")
+    assert os.path.exists(os.path.join(ckpt, "t1", "MANIFEST.json"))
+    # resume: a fresh adapter engine loads the exact tuned tree
+    _cfg2, model2, params2 = _small_model()
+    engine2 = _finetune_engine(model2, params2)
+    path, client_state = engine2.load_checkpoint(ckpt, tag="t1")
+    assert path is not None
+    assert client_state["adapters"]["rank"] == 2
+    for (kp, a), (_kq, b) in zip(
+        jax.tree_util.tree_flatten_with_path(
+            jax.tree_util.tree_map(np.asarray, engine2.params)
+        )[0],
+        jax.tree_util.tree_flatten_with_path(tuned)[0],
+    ):
+        assert np.array_equal(a, b), kp
+    # serving: the checkpoint loads into a pool row and changes outputs
+    eng = _lora_engine(model, params)
+    eng.load_adapter("tenant", load_dir=ckpt)
+    prompt = _prompt(9, seed=5)
+    out_t = eng.generate([prompt], max_new_tokens=10, adapter="tenant")[0]
+    out_0 = eng.generate([prompt], max_new_tokens=10)[0]
+    assert out_t != out_0, "fine-tuned adapter did not change decode"
+    # geometry mismatch (rank-3 pool vs rank-2 checkpoint) fails loudly
+    eng3 = _lora_engine(model, params, adapters={"rank": 3})
+    with pytest.raises(DeepSpeedConfigError, match="rank"):
+        eng3.load_adapter("tenant", load_dir=ckpt)
+    eng.close()
+    eng3.close()
+
+
+# ---------------------------------------------------------------------------
+# batched multi-LoRA decode
+# ---------------------------------------------------------------------------
+def test_mixed_adapter_batch_bitwise_matches_single_slot_runs():
+    """One fixed-shape decode program, three slots on three different
+    adapters (including the base id 0): every slot's tokens bitwise-match
+    a run where its adapter is alone in the batch."""
+    _cfg, model, params = _small_model()
+    prompt = _prompt(9, seed=5)
+    eng = _lora_engine(model, params)
+    eng.load_adapter("a", adapter_state=_synth_adapter(params, 1))
+    eng.load_adapter("b", adapter_state=_synth_adapter(params, 2))
+    solo_a = eng.generate([prompt], max_new_tokens=8, adapter="a")[0]
+    solo_b = eng.generate([prompt], max_new_tokens=8, adapter="b")[0]
+    solo_0 = eng.generate([prompt], max_new_tokens=8)[0]
+    r_a = eng.submit(prompt, max_new_tokens=8, adapter="a")
+    r_b = eng.submit(prompt, max_new_tokens=8, adapter="b")
+    r_0 = eng.submit(prompt, max_new_tokens=8)
+    eng.scheduler.run_until_idle()
+    assert r_a.tokens == solo_a
+    assert r_b.tokens == solo_b
+    assert r_0.tokens == solo_0
+    assert solo_a != solo_b and solo_a != solo_0
+    eng.close()
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_new_adapter_join_never_recompiles(paged):
+    """The zero-recompile pin across adapter mix changes: after warmup,
+    loading a NEVER-SEEN adapter and joining a request under it compiles
+    nothing (ids are arrays; the pool row write is a traced index-put)."""
+    _cfg, model, params = _small_model()
+    inference = {"kv_block_size": 8} if paged else {}
+    eng = _lora_engine(model, params, inference=inference)
+    eng.load_adapter("warm", adapter_state=_synth_adapter(params, 1))
+    prompt = _prompt(9, seed=5)
+    eng.generate([prompt], max_new_tokens=6, adapter="warm")
+    eng.generate([prompt], max_new_tokens=6)
+    recompiles = eng.metrics.counter("jax/recompiles")
+    warm = recompiles.value
+    eng.load_adapter("cold", adapter_state=_synth_adapter(params, 3))
+    r1 = eng.submit(prompt, max_new_tokens=6, adapter="cold")
+    r2 = eng.submit(_prompt(7, seed=8), max_new_tokens=6, adapter="warm")
+    eng.scheduler.run_until_idle()
+    assert r1.tokens and r2.tokens
+    assert recompiles.value == warm, (
+        f"{recompiles.value - warm} recompiles after a new adapter joined"
+    )
+    eng.close()
+
+
+def test_paged_decode_with_adapters_matches_contiguous():
+    """Greedy multi-adapter decode is bitwise-identical across the two
+    cache layouts (the paged path shares the decode core)."""
+    _cfg, model, params = _small_model()
+    prompt = _prompt(9, seed=5)
+    outs = []
+    for inference in ({}, {"kv_block_size": 8}):
+        eng = _lora_engine(model, params, inference=inference)
+        eng.load_adapter("a", adapter_state=_synth_adapter(params, 1))
+        r1 = eng.submit(prompt, max_new_tokens=8, adapter="a")
+        r2 = eng.submit(_prompt(6, seed=7), max_new_tokens=8)
+        eng.scheduler.run_until_idle()
+        outs.append((r1.tokens, r2.tokens))
+        eng.close()
+    assert outs[0] == outs[1]
+
+
+def test_prefix_cache_salted_by_adapter():
+    """Prefix pages never share across adapters (or base<->adapter):
+    cached k/v are a function of the weights that wrote them."""
+    _cfg, model, params = _small_model()
+    eng = _lora_engine(model, params, inference={"kv_block_size": 8})
+    eng.load_adapter("a", adapter_state=_synth_adapter(params, 1))
+    eng.load_adapter("b", adapter_state=_synth_adapter(params, 2))
+    hits = eng.metrics.counter("infer/prefix_hits")
+    misses = eng.metrics.counter("infer/prefix_misses")
+    template = _prompt(8, seed=11)  # exactly one page
+    p1 = template + _prompt(3, seed=12)
+    p2 = template + _prompt(4, seed=13)
+    eng.generate([p1], max_new_tokens=4, adapter="a")
+    assert (hits.value, misses.value) == (0, 1)
+    warm = eng.generate([p2], max_new_tokens=4, adapter="a")[0]
+    assert (hits.value, misses.value) == (1, 1)  # same adapter: HIT
+    eng.generate([p2], max_new_tokens=4, adapter="b")
+    assert misses.value == 2  # other adapter: MISS despite same tokens
+    eng.generate([p2], max_new_tokens=4)
+    assert misses.value == 3  # base model: MISS too
+    # the warm hit served the adapter's own pages: bitwise vs fresh cold
+    eng2 = _lora_engine(model, params, inference={"kv_block_size": 8})
+    eng2.load_adapter("a", adapter_state=_synth_adapter(params, 1))
+    assert eng2.generate([p2], max_new_tokens=4, adapter="a")[0] == warm
+    eng.close()
+    eng2.close()
+
+
+def test_adapter_reload_invalidates_its_old_prefix_pages():
+    """Hot-reloading an adapter bumps its generation: pages its OLD
+    weights wrote never match again (a stale-weight hit would silently
+    serve the old model's k/v)."""
+    _cfg, model, params = _small_model()
+    eng = _lora_engine(model, params, inference={"kv_block_size": 8})
+    eng.load_adapter("a", adapter_state=_synth_adapter(params, 1))
+    misses = eng.metrics.counter("infer/prefix_misses")
+    template = _prompt(8, seed=11)
+    eng.generate([template + _prompt(3, seed=12)], max_new_tokens=4,
+                 adapter="a")
+    eng.load_adapter("a", adapter_state=_synth_adapter(params, 4))
+    eng.generate([template + _prompt(4, seed=13)], max_new_tokens=4,
+                 adapter="a")
+    assert misses.value == 2  # reload => no stale hit
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# pool management / scheduler integration
+# ---------------------------------------------------------------------------
+def test_unknown_adapter_rejected_at_submit():
+    _cfg, model, params = _small_model()
+    eng = _lora_engine(model, params)
+    with pytest.raises(ValueError, match="not loaded"):
+        eng.submit(_prompt(), adapter="ghost")
+    plain = deepspeed_tpu.init_inference(
+        model=model, model_parameters=params,
+        config={"inference": {"max_batch_slots": 2, "max_seq_len": 48,
+                              "prefill_len": 16}},
+    )
+    with pytest.raises(DeepSpeedConfigError, match="adapter"):
+        plain.submit(_prompt(), adapter="any")
+    plain.close()
+    eng.close()
+
+
+def test_pool_eviction_lru_and_snapshot_counters():
+    _cfg, model, params = _small_model()
+    eng = _lora_engine(model, params, adapters={"pool_slots": 2})
+    eng.load_adapter("a", adapter_state=_synth_adapter(params, 1))
+    eng.load_adapter("b", adapter_state=_synth_adapter(params, 2))
+    prompt = _prompt(9, seed=5)
+    eng.generate([prompt], max_new_tokens=4, adapter="a")  # a now MRU
+    eng.load_adapter("c", adapter_state=_synth_adapter(params, 3))
+    snap = eng.load_snapshot()
+    assert snap["adapters_loaded"] == ["a", "c"]  # b was LRU: evicted
+    assert snap["adapter_pool_used"] == 2
+    assert snap["adapter_evictions"] == 1
+    assert snap["adapter_requests"]["a"] == 1
+    with pytest.raises(ValueError, match="not loaded"):
+        eng.submit(prompt, adapter="b")
+    eng.close()
+
+
+def test_adapter_pool_refcounts_block_eviction_and_unload():
+    pool = AdapterPool(2)
+    pool.assign("a")
+    pool.assign("b")
+    pool.acquire("a")
+    pool.acquire("b")
+    with pytest.raises(AdapterPoolFull):
+        pool.assign("c")  # both busy: nothing evictable
+    with pytest.raises(RuntimeError, match="live"):
+        pool.remove("a")
+    pool.release("b")
+    idx, evicted = pool.assign("c")  # b idle: evicted
+    assert evicted == "b" and idx == pool.index_of("c")
+    with pytest.raises(ValueError, match="no live"):
+        pool.release("b")
+    pool.release("a")
+    assert pool.remove("a") in (1, 2)
+    # reload bumps the generation (the prefix-salt input)
+    g1 = pool.generation_of("c")
+    pool.assign("c")
+    assert pool.generation_of("c") > g1
+
+
+def test_evicted_adapter_between_submit_and_join_fail_finishes():
+    """An adapter evicted after submit but before slot join must fail
+    that request loudly — never decode it against other weights."""
+    _cfg, model, params = _small_model()
+    eng = _lora_engine(model, params, adapters={"pool_slots": 2})
+    eng.load_adapter("a", adapter_state=_synth_adapter(params, 1))
+    req = eng.submit(_prompt(9, seed=5), max_new_tokens=4, adapter="a")
+    eng.unload_adapter("a")
+    eng.scheduler.run_until_idle()
+    assert req.done and req.finish_reason == "error"
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# partition specs
+# ---------------------------------------------------------------------------
+def test_lora_partition_specs_ride_the_base_matrices_model_axis():
+    from jax.sharding import PartitionSpec as P
+
+    _cfg, _model, params = _small_model(lora_rank=2)
+    specs = partition_specs(params)["transformer"]["h"]
+    # column-parallel bases shard output dim -> B carries it, A replicates
+    assert specs["attn_qkvw_lora_b"] == P(None, None, "model")
+    assert specs["attn_qkvw_lora_a"] == P(None, None, None)
+    assert specs["inter_w_lora_b"] == P(None, None, "model")
+    # row-parallel bases shard input dim -> A carries it, B replicates
+    assert specs["attn_ow_lora_a"] == P(None, "model", None)
+    assert specs["attn_ow_lora_b"] == P(None, None, None)
+    assert specs["output_w_lora_a"] == P(None, "model", None)
+    pool_specs = adapter_pool_partition_specs()
+    assert pool_specs["attn_qkvw"][1] == P(None, None, None, "model")
+    assert pool_specs["attn_ow"][0] == P(None, None, "model", None)
+
+
+def test_serving_rejects_lora_leaves_in_params():
+    """Pool mode + *_lora_* leaves in the param tree would double-apply
+    adapters; a mutated model CONFIG over a clean base tree is fine (the
+    fine-tune engine arms the shared config in place)."""
+    cfg, model, params = _small_model(lora_rank=2)
+    with pytest.raises(DeepSpeedConfigError, match="BASE param tree"):
+        _lora_engine(model, params)
+    base, _ada = split_lora_params(params)
+    eng = _lora_engine(model, base)  # config says rank 2; tree is clean
+    assert eng.generate([_prompt(6)], max_new_tokens=2)[0]
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet plumbing
+# ---------------------------------------------------------------------------
+def test_fleet_adapter_registry_and_affinity():
+    _cfg, model, params = _small_model()
+
+    def factory():
+        return _lora_engine(model, params)
+
+    router = deepspeed_tpu.init_fleet(
+        engine_factory=factory,
+        config={"serving": {
+            "replicas": 2, "placement": "adapter_affinity",
+        }},
+    )
+    try:
+        res = router.load_adapter(
+            "a", replica_ids=["1"],
+            adapter_state=_synth_adapter(params, 1),
+        )
+        assert res == {"1": 1}
+        prompt = _prompt(9, seed=5)
+        reqs = [
+            router.submit(prompt, adapter="a", max_new_tokens=4)
+            for _ in range(3)
+        ]
+        outs = [r.result(60.0) for r in reqs]
+        # every a-request landed on the holder replica
+        assert all(r.replica_id == "1" for r in reqs)
+        assert outs[0] == outs[1] == outs[2]
+        base = router.submit(prompt, max_new_tokens=4).result(60.0)
+        assert base != outs[0]
+        router.refresh_telemetry()
+        snap = router.metrics.snapshot()
+        assert snap["fleet/adapters_loaded"] == 1
+        assert snap["fleet/adapter_loads"] == 1
+        assert snap["fleet/affinity_hits"] == 3
+        assert snap["fleet/replica1/adapters_loaded"] == 1
+        # fleet-wide load + unload round-trips on both replicas
+        assert set(router.load_adapter(
+            "b", adapter_state=_synth_adapter(params, 2)
+        )) == {"0", "1"}
+        assert set(router.unload_adapter("b")) == {"0", "1"}
+    finally:
+        router.shutdown()
+
+
+def test_deferred_admission_releases_adapter_pin():
+    """A slot join that DEFERS on KV page pressure (PoolExhausted) must
+    drop the adapter pin it took — a leaked pin would make the adapter
+    permanently un-evictable and leave a stale prefix-cache salt on the
+    slot."""
+    _cfg, model, params = _small_model()
+    # pool fits ONE request (9 + 16 = 25 tokens -> 4 of 4 pages); both
+    # submissions pass the submit-time gate on the empty pool, then the
+    # second defers at its slot join
+    eng = _lora_engine(
+        model, params,
+        inference={"max_batch_slots": 2, "kv_block_size": 8,
+                   "kv_pool_blocks": 4},
+    )
+    eng.load_adapter("a", adapter_state=_synth_adapter(params, 1))
+    r1 = eng.submit(_prompt(9, seed=5), max_new_tokens=16, adapter="a")
+    r2 = eng.submit(_prompt(9, seed=6), max_new_tokens=16, adapter="a")
+    eng.scheduler.step()  # r1 takes the pages; r2 pins, defers, unpins
+    assert eng.adapter_registry.active_count("a") == 1  # r1 only
+    eng.scheduler.run_until_idle()
+    assert r1.tokens and r2.tokens
+    assert eng.adapter_registry.active_count("a") == 0
+    eng.unload_adapter("a")  # a leaked pin would refuse here
+    eng.close()
+
+
+def test_fleet_falls_through_replicas_missing_the_adapter():
+    """A replica without the adapter raises the TYPED AdapterUnavailable:
+    the router drops it from the candidate set and places on a holder
+    instead of failing the submission."""
+    _cfg, model, params = _small_model()
+
+    def factory():
+        return _lora_engine(model, params)
+
+    # least_loaded placement would pick replica 0 (registration order);
+    # the adapter lives only on replica 1
+    router = deepspeed_tpu.init_fleet(
+        engine_factory=factory, config={"serving": {"replicas": 2}},
+    )
+    try:
+        router.load_adapter(
+            "a", replica_ids=["1"],
+            adapter_state=_synth_adapter(params, 1),
+        )
+        req = router.submit(_prompt(9, seed=5), adapter="a",
+                            max_new_tokens=4)
+        assert req.result(60.0)
+        assert req.replica_id == "1"
+    finally:
+        router.shutdown()
+
+
+def test_fleet_restart_replays_registered_adapters():
+    """A replica rebuilt by restart_replica starts with an empty pool;
+    the router's fleet-wide adapter registry replays onto it, so a
+    rolling restart never sheds tenants' weights."""
+    _cfg, model, params = _small_model()
+
+    def factory():
+        return _lora_engine(model, params)
+
+    router = deepspeed_tpu.init_fleet(
+        engine_factory=factory, config={"serving": {"replicas": 2}},
+    )
+    try:
+        router.load_adapter("a", adapter_state=_synth_adapter(params, 1))
+        prompt = _prompt(9, seed=5)
+        before = router.submit(
+            prompt, adapter="a", max_new_tokens=4
+        ).result(60.0)
+        for rid in router.replica_ids:
+            router.restart_replica(rid, wait_timeout=60.0)
+        after = router.submit(
+            prompt, adapter="a", max_new_tokens=4
+        ).result(60.0)
+        assert after == before
+    finally:
+        router.shutdown()
+
+
+def test_worker_protocol_adapter_ops_roundtrip(tmp_path):
+    """The WorkerServer load/unload ops over in-process channel IO, with
+    a stub engine — the subprocess replica's RPC surface without paying
+    a process spawn."""
+    import io
+    import json as _json
+
+    from deepspeed_tpu.serving.worker import WorkerServer
+
+    class StubEngine:
+        def __init__(self):
+            self.loaded = {}
+
+        def serve_forever(self):
+            pass
+
+        def load_adapter(self, name, load_dir=None, tag=None):
+            if load_dir == "bad":
+                raise RuntimeError("corrupt adapter checkpoint")
+            self.loaded[name] = load_dir
+            return len(self.loaded)
+
+        def unload_adapter(self, name):
+            del self.loaded[name]
+            return 1
+
+        def load_snapshot(self):
+            return {"adapters_loaded": sorted(self.loaded)}
+
+        def close(self):
+            pass
+
+    ops = [
+        {"op": "init", "spec": {}},
+        {"op": "load_adapter", "id": 1, "name": "a", "load_dir": "/d"},
+        {"op": "load_adapter", "id": 2, "name": "x", "load_dir": "bad"},
+        {"op": "unload_adapter", "id": 3, "name": "a"},
+        {"op": "shutdown"},
+    ]
+    stdin = io.StringIO("".join(_json.dumps(m) + "\n" for m in ops))
+    stdout = io.StringIO()
+    server = WorkerServer(stdin, stdout, lambda spec: StubEngine())
+    assert server.run() == 0
+    events = [_json.loads(l) for l in stdout.getvalue().splitlines()]
+    by_id = {e.get("id"): e for e in events if "id" in e}
+    assert by_id[1]["index"] == 1
+    assert "corrupt" in by_id[2]["error"]
+    assert by_id[3]["index"] == 1
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+def test_bert_lora_fresh_adapter_matches_base():
+    """The LoRA path rides DeepSpeedTransformerLayer, so BERT adapts the
+    same way GPT-2 does: fresh adapters (B = 0) merged over a rank-0
+    base leave the forward unchanged. Near-exact rather than bitwise
+    here: the scanned block compiles as one XLA computation, and the
+    traced-but-zero delta lets XLA re-associate the post-LN fusion by
+    ~1 ulp — the adapter-DISABLED path (rank 0, no lora ops traced)
+    stays structurally bitwise, and the GPT-2 stacks pin exact equality
+    in test_fresh_adapter_forward_bitwise_matches_base."""
+    from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+    kw = dict(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, use_flash=False,
+    )
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 96, (2, 16)), jnp.int32
+    )
+    base_model = BertModel(BertConfig(**kw))
+    params = base_model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        ids,
+    )["params"]
+    out_base = base_model.apply({"params": params}, ids, train=False)
+    merged = merge_lora_params(
+        params, init_lora_params(params, 2, rng=jax.random.PRNGKey(3))
+    )
+    out_lora = BertModel(BertConfig(**kw, lora_rank=2)).apply(
+        {"params": merged}, ids, train=False
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out_base),
+        jax.tree_util.tree_leaves(out_lora),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        )
+
+
+def test_adapter_num_params_is_small_fraction():
+    _cfg, _model, params = _small_model()
+    ada = init_lora_params(params, 2)
+    total = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+    )
+    assert adapter_num_params(ada) / total < 0.1

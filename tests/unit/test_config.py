@@ -442,6 +442,61 @@ def test_paged_kv_rejects(block):
 
 
 # ---------------------------------------------------------------------------
+# adapters block: multi-tenant LoRA geometry (docs/adapters.md)
+# ---------------------------------------------------------------------------
+def _ada(block):
+    return make({"train_batch_size": 8, "adapters": block})
+
+
+def test_adapters_defaults():
+    cfg = make({"train_batch_size": 8})
+    assert cfg.adapters_enabled is False
+    assert cfg.adapters_rank == 8
+    assert cfg.adapters_alpha == 0.0
+    assert cfg.adapters_targets is None
+    assert cfg.adapters_pool_slots == 8
+
+
+def test_adapters_valid_block_parses():
+    cfg = _ada({
+        "enabled": True,
+        "rank": 4,
+        "alpha": 16.0,
+        "targets": ["attn_qkvw", "attn_ow"],
+        "pool_slots": 32,
+    })
+    assert cfg.adapters_enabled is True
+    assert cfg.adapters_rank == 4
+    assert cfg.adapters_alpha == 16.0
+    assert cfg.adapters_targets == ["attn_qkvw", "attn_ow"]
+    assert cfg.adapters_pool_slots == 32
+
+
+@pytest.mark.parametrize("block", [
+    {"enabled": "yes"},
+    {"rank": 0},
+    {"rank": -2},
+    {"rank": 2.5},
+    {"rank": True},
+    {"alpha": -1.0},
+    {"alpha": "big"},
+    {"targets": []},                       # empty = adapts nothing
+    {"targets": "attn_qkvw"},              # bare string would iterate chars
+    {"targets": ["attn_qkvw", "wte"]},     # not an adaptable matrix
+    {"targets": ["attn_qkvw", "attn_qkvw"]},
+    {"targets": [1]},
+    {"pool_slots": 0},
+    {"pool_slots": -1},
+    {"pool_slots": True},
+])
+def test_adapters_rejects(block):
+    from deepspeed_tpu.config.config import DeepSpeedConfigError
+
+    with pytest.raises(DeepSpeedConfigError):
+        _ada(block)
+
+
+# ---------------------------------------------------------------------------
 # serving block: fleet size, placement, admission limits (docs/serving.md)
 # ---------------------------------------------------------------------------
 def _srv(block):
