@@ -954,6 +954,8 @@ def bench_infer():
     metrics — the serving stack's first recorded perf numbers. Asserts
     the repeated-prefix TTFT drops >= 2x vs cold (the prefix cache's
     headline claim); every other number is recorded, not gated."""
+    import tempfile
+
     import jax
     import jax.numpy as jnp
 
@@ -961,6 +963,7 @@ def bench_infer():
     from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
     from deepspeed_tpu.telemetry.registry import histogram_quantile
 
+    trace_tmp = tempfile.mkdtemp(prefix="ds_infer_trace_")
     cfg = GPT2Config(
         vocab_size=8192, n_positions=512,
         # big enough that prefill COMPUTE dominates TTFT (the quantity
@@ -995,12 +998,46 @@ def bench_infer():
             block["prefix_cache"] = {"suffix_buckets": [16, 32, 64, 128]}
         return deepspeed_tpu.init_inference(
             model=model, model_parameters=params,
-            config={"inference": block},
+            config={
+                "inference": block,
+                # tracing (ring only, no sinks): the per-phase
+                # queue/prefill/decode breakdown below reads the span
+                # ring, so BENCH rounds can attribute TTFT movement to
+                # the phase that moved (docs/observability.md)
+                "telemetry": {
+                    "enabled": True,
+                    "output_path": trace_tmp,
+                    "job_name": f"infer_{'paged' if paged else 'contig'}",
+                    "exporters": [],
+                    "watchdog": {"enabled": False},
+                    "tracing": {"enabled": True, "ring_events": 8192,
+                                "export": "none"},
+                },
+            },
         )
 
     def prompt(n, seed):
         return [int(t) for t in
                 np.random.default_rng(seed).integers(0, cfg.vocab_size, n)]
+
+    def phase_breakdown(engine):
+        """Per-phase means from the tracer's span ring: where a
+        request's wall time actually went (queue vs prefill vs decode
+        steps) — the attribution the aggregate TTFT histogram can't
+        give."""
+        agg = {}
+        for span in engine.tracer.flight_snapshot():
+            if span["name"] in (
+                "sched.queue", "sched.prefill", "sched.decode_step"
+            ):
+                agg.setdefault(span["name"], []).append(span["dur_ms"])
+        return {
+            name.split(".", 1)[1]: {
+                "mean_ms": round(sum(v) / len(v), 3),
+                "spans": len(v),
+            }
+            for name, v in sorted(agg.items())
+        }
 
     def measure(engine):
         reg = engine.metrics
@@ -1037,6 +1074,7 @@ def bench_infer():
             "kv_cache_bytes": int(
                 reg.gauge("infer/kv_cache_bytes").value
             ),
+            "phase_breakdown_ms": phase_breakdown(engine),
         }
 
     contiguous = build(paged=False)
@@ -1641,6 +1679,177 @@ def smoke_lora():
     }))
 
 
+def smoke_trace():
+    """CI fast path (``python bench.py --smoke-trace``): the distributed
+    request-tracing acceptance slice (docs/observability.md "Request
+    tracing & flight recorder") — ONE fleet request served through a
+    SubprocessReplica with a prefix-cache HIT and a LoRA adapter must
+    yield ONE connected trace in ONE file, router door to finish-reason.
+
+    The worker runs a paged+prefix-cache multi-LoRA engine in its own
+    process with tracing armed; its per-request spans ship back over the
+    newline-JSON RPC and the router's tracer stitches them under the
+    fleet.request root. Asserts: every phase span present, one trace_id
+    end to end, parent links reconstruct the chain across TWO pids, the
+    second templated request's prefill span says prefix_hit with the
+    adapter name, and the trace file is Perfetto-loadable JSON. Prints
+    one JSON line and exits non-zero on any failed check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.telemetry.tracing import load_chrome_trace
+
+    tmp = tempfile.mkdtemp(prefix="ds_smoke_trace_")
+    world = jax.device_count()
+    model_kw = dict(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False,
+    )
+    cfg = GPT2Config(**model_kw)
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    ids0 = jnp.asarray(rng.integers(0, 128, (1, 8)), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids0, ids0,
+    )["params"]
+
+    # ---- 1. a tenant adapter checkpoint (the only adapter form that
+    # crosses the worker's process boundary is load_dir) ---------------
+    adapter_ckpt = os.path.join(tmp, "tenant_ckpt")
+    eng_t, _o, _d, _s = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=jax.tree_util.tree_map(np.asarray, params),
+        config_params={
+            "train_batch_size": 4 * world,
+            "optimizer": {"type": "adam", "params": {"lr": 0.1}},
+            "adapters": {"enabled": True, "rank": 1},
+        },
+    )
+    tb = jnp.full((4 * world, 16), 7, jnp.int32)
+    eng_t.train_batch([(tb, tb)])
+    assert eng_t.save_checkpoint(adapter_ckpt, tag="tuned")
+
+    # ---- 2. a 1-replica SUBPROCESS fleet, tracing armed on BOTH sides -
+    worker_spec = {
+        "model": model_kw,
+        "init_seed": 0,
+        "config": {
+            "inference": {
+                "max_batch_slots": 2, "max_seq_len": 64,
+                "prefill_len": 48, "sampling": {"greedy": True},
+                "kv_block_size": 16,
+            },
+            "adapters": {"enabled": True, "rank": 1, "pool_slots": 2},
+            "telemetry": {
+                "enabled": True,
+                "output_path": os.path.join(tmp, "worker_telemetry"),
+                "job_name": "smoke_trace_worker",
+                "watchdog": {"enabled": False},
+                # the worker keeps no file of its own ("none"): its
+                # sampled spans ship home over the RPC instead
+                "tracing": {"enabled": True, "export": "none"},
+            },
+        },
+    }
+    router = deepspeed_tpu.init_fleet(
+        worker_spec=worker_spec,
+        config={
+            "serving": {"replicas": 1, "backend": "subprocess"},
+            "telemetry": {
+                "enabled": True,
+                "output_path": os.path.join(tmp, "telemetry"),
+                "job_name": "smoke_trace",
+                "watchdog": {"enabled": False},
+                "tracing": {"enabled": True, "sample_rate": 1.0},
+            },
+        },
+    )
+    router.load_adapter("tenant-a", load_dir=adapter_ckpt)
+
+    # ---- 3. two templated tenant requests: cold, then a prefix HIT ----
+    template = [int(t) for t in rng.integers(0, 128, 32)]  # 2 full pages
+    r1 = router.submit(template + [5, 6, 7, 8], adapter="tenant-a",
+                       max_new_tokens=4)
+    assert len(r1.result(120.0)) == 4
+    r2 = router.submit(template + [9, 10, 11, 12], adapter="tenant-a",
+                       max_new_tokens=4)
+    assert len(r2.result(120.0)) == 4
+    deadline = time.time() + 10.0
+    while router.outstanding_count and time.time() < deadline:
+        time.sleep(0.01)
+    assert router.outstanding_count == 0, "sweep never completed"
+    router.shutdown()
+
+    # ---- 4. ONE file reconstructs both requests end to end ------------
+    trace_path = os.path.join(tmp, "telemetry", "smoke_trace", "trace.json")
+    events = load_chrome_trace(trace_path)
+    by_trace = {}
+    for e in events:
+        tid = e["args"].get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    roots = [e for e in events if e["name"] == "fleet.request"]
+    assert len(roots) == 2, f"expected 2 fleet roots, got {len(roots)}"
+    hit_traces = 0
+    for root in roots:
+        chain = by_trace[root["args"]["trace_id"]]
+        names = {e["name"] for e in chain}
+        required = {"fleet.request", "router.admission", "router.place",
+                    "sched.request", "sched.queue", "sched.prefill"}
+        assert required <= names, sorted(names)
+        spans = {e["name"]: e for e in chain}
+        # the chain crosses the process boundary: router spans carry the
+        # parent pid, scheduler spans the worker's
+        assert spans["fleet.request"]["pid"] != spans["sched.request"]["pid"]
+        # parent links reconstruct door -> placement -> replica -> phases
+        root_id = spans["fleet.request"]["args"]["span_id"]
+        assert spans["fleet.request"]["args"]["parent_id"] is None
+        assert spans["router.place"]["args"]["parent_id"] == root_id
+        assert spans["sched.request"]["args"]["parent_id"] == root_id
+        req_id = spans["sched.request"]["args"]["span_id"]
+        assert spans["sched.queue"]["args"]["parent_id"] == req_id
+        assert spans["sched.prefill"]["args"]["parent_id"] == req_id
+        assert spans["fleet.request"]["args"]["finish_reason"] == (
+            "max_new_tokens"
+        )
+        # replica-prefixed globally-unique request id as the root attr
+        assert str(
+            spans["sched.request"]["args"]["request_id"]
+        ).startswith("r0-")
+        prefill = spans["sched.prefill"]["args"]
+        assert prefill.get("adapter") == "tenant-a", prefill
+        if prefill.get("prefix_hit"):
+            hit_traces += 1
+    assert hit_traces == 1, (
+        f"expected exactly the second templated request to hit the "
+        f"prefix cache, saw {hit_traces} hit trace(s)"
+    )
+    span_count = len(events)
+    pids = {e["pid"] for e in events}
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "smoke_request_tracing",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": {
+            "fleet_requests_traced": 2,
+            "spans_in_file": span_count,
+            "processes_in_trace": len(pids),
+            "prefix_hit_traced": True,
+            "adapter_traced": "tenant-a",
+        },
+    }))
+
+
 def main():
     if "--smoke" in sys.argv:
         smoke()
@@ -1656,6 +1865,9 @@ def main():
         return
     if "--infer" in sys.argv:
         bench_infer()
+        return
+    if "--smoke-trace" in sys.argv:
+        smoke_trace()
         return
     if "--smoke-chaos" in sys.argv:
         smoke_chaos()

@@ -267,6 +267,33 @@ class DeepSpeedConfig:
             C.TELEMETRY_WATCHDOG_POLL_INTERVAL,
             C.TELEMETRY_WATCHDOG_POLL_INTERVAL_DEFAULT,
         )
+        # tracing sub-block (telemetry/tracing.py): request tracing +
+        # flight recorder. Like the watchdog it rides the telemetry
+        # master switch — tracing with no telemetry block is inert.
+        tracing_dict = get_dict_param(tel_dict, C.TELEMETRY_TRACING)
+        self._telemetry_tracing_keys = list(tracing_dict)
+        self.telemetry_tracing_enabled = self.telemetry_enabled and (
+            get_scalar_param(
+                tracing_dict,
+                C.TELEMETRY_TRACING_ENABLED,
+                C.TELEMETRY_TRACING_ENABLED_DEFAULT,
+            )
+        )
+        self.telemetry_tracing_sample_rate = get_scalar_param(
+            tracing_dict,
+            C.TELEMETRY_TRACING_SAMPLE_RATE,
+            C.TELEMETRY_TRACING_SAMPLE_RATE_DEFAULT,
+        )
+        self.telemetry_tracing_ring_events = get_scalar_param(
+            tracing_dict,
+            C.TELEMETRY_TRACING_RING_EVENTS,
+            C.TELEMETRY_TRACING_RING_EVENTS_DEFAULT,
+        )
+        self.telemetry_tracing_export = get_scalar_param(
+            tracing_dict,
+            C.TELEMETRY_TRACING_EXPORT,
+            C.TELEMETRY_TRACING_EXPORT_DEFAULT,
+        )
 
         # resilience block (deepspeed_tpu/resilience/, docs/resilience.md)
         res_dict = get_dict_param(pd, C.RESILIENCE)
@@ -767,6 +794,54 @@ class DeepSpeedConfig:
                 f"{C.TELEMETRY_WATCHDOG_POLL_INTERVAL} must be > 0 seconds "
                 f"(or null for timeout/4), got "
                 f"{self.telemetry_watchdog_poll_interval!r}"
+            )
+        self._check_tracing()
+
+    def _check_tracing(self):
+        """Validate the telemetry.tracing sub-block (telemetry/tracing.py):
+        a typo'd sample_rate must fail at init, not silently mean
+        'sample everything'."""
+        prefix = f"{C.TELEMETRY}.{C.TELEMETRY_TRACING}"
+        known = (
+            C.TELEMETRY_TRACING_ENABLED,
+            C.TELEMETRY_TRACING_SAMPLE_RATE,
+            C.TELEMETRY_TRACING_RING_EVENTS,
+            C.TELEMETRY_TRACING_EXPORT,
+        )
+        unknown = [
+            k for k in self._telemetry_tracing_keys if k not in known
+        ]
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"unknown {prefix} key(s) {unknown}; valid: {list(known)}"
+            )
+        rate = self.telemetry_tracing_sample_rate
+        if (
+            not isinstance(rate, (int, float))
+            or isinstance(rate, bool)
+            or not 0.0 <= float(rate) <= 1.0
+        ):
+            raise DeepSpeedConfigError(
+                f"{prefix}.{C.TELEMETRY_TRACING_SAMPLE_RATE} must be a "
+                f"number within [0, 1], got {rate!r}"
+            )
+        ring = self.telemetry_tracing_ring_events
+        if (
+            not isinstance(ring, int)
+            or isinstance(ring, bool)
+            or ring < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{prefix}.{C.TELEMETRY_TRACING_RING_EVENTS} must be an "
+                f"integer >= 1, got {ring!r}"
+            )
+        if self.telemetry_tracing_export not in (
+            C.TELEMETRY_TRACING_VALID_EXPORTS
+        ):
+            raise DeepSpeedConfigError(
+                f"unknown {prefix}.{C.TELEMETRY_TRACING_EXPORT} "
+                f"{self.telemetry_tracing_export!r}; valid: "
+                f"{list(C.TELEMETRY_TRACING_VALID_EXPORTS)}"
             )
 
     def _check_resilience(self):

@@ -306,6 +306,22 @@ TELEMETRY_PROFILE_OUTPUT_PATH_DEFAULT = ""
 TELEMETRY_WATCHDOG = "watchdog"
 TELEMETRY_WATCHDOG_ENABLED = "enabled"
 TELEMETRY_WATCHDOG_ENABLED_DEFAULT = True
+
+#############################################
+# Telemetry: request tracing + flight recorder
+# (telemetry/tracing.py, docs/observability.md
+# "Request tracing & flight recorder")
+#############################################
+TELEMETRY_TRACING = "tracing"
+TELEMETRY_TRACING_ENABLED = "enabled"
+TELEMETRY_TRACING_ENABLED_DEFAULT = False
+TELEMETRY_TRACING_SAMPLE_RATE = "sample_rate"
+TELEMETRY_TRACING_SAMPLE_RATE_DEFAULT = 1.0
+TELEMETRY_TRACING_RING_EVENTS = "ring_events"
+TELEMETRY_TRACING_RING_EVENTS_DEFAULT = 512
+TELEMETRY_TRACING_EXPORT = "export"
+TELEMETRY_TRACING_EXPORT_DEFAULT = "chrome"
+TELEMETRY_TRACING_VALID_EXPORTS = ("chrome", "none")
 TELEMETRY_WATCHDOG_TIMEOUT = "timeout"
 TELEMETRY_WATCHDOG_TIMEOUT_DEFAULT = 600.0
 TELEMETRY_WATCHDOG_POLL_INTERVAL = "poll_interval"

@@ -239,6 +239,14 @@ class InferenceEngine:
             if self.telemetry.enabled else MetricsRegistry()
         )
         self.metrics = register_inference_metrics(registry)
+        # request tracer (telemetry/tracing.py): rides the telemetry
+        # block's tracing config; the NOOP zero-overhead passthrough
+        # otherwise. A fleet tier may swap in ITS tracer (use_tracer) so
+        # in-process replica spans land in the router's trace file.
+        self.tracer = self.telemetry.tracer
+        # per-slot span attrs captured at prefill time (prefix-hit vs
+        # cold, suffix bucket, adapter) for the scheduler's prefill span
+        self._slot_trace_attrs = {}
 
         # ---- params: verified load, cast, pin -------------------------
         import types
@@ -506,6 +514,7 @@ class InferenceEngine:
             deadline_secs=cfg.inference_deadline_secs,
             driver_restart_budget=cfg.inference_driver_restart_budget,
             degraded_queue_ratio=cfg.inference_degraded_queue_ratio,
+            tracer=self.tracer,
         )
         log_dist(
             f"init_inference: {self.num_slots} decode slots x "
@@ -953,10 +962,38 @@ class InferenceEngine:
                 prompt_tokens, self._slot_blocks[slot],
                 hashes=self._slot_hashes.get(slot),
             )
+        if self.tracer.enabled:
+            attrs = {
+                "prompt_tokens": plen,
+                "prefix_hit": prefix_len > 0,
+                "prefix_len": int(prefix_len),
+            }
+            if prefix_len > 0:
+                attrs["suffix_bucket"] = self._suffix_bucket(
+                    plen - prefix_len, prefix_len
+                )
+            adapter = self._slot_adapter_names.get(slot)
+            if adapter is not None:
+                attrs["adapter"] = adapter
+            self._slot_trace_attrs[slot] = attrs
         self._lengths[slot] = plen
         self._last_tokens[slot] = first
         self._temps[slot] = temperature
         return first
+
+    def prefill_trace_attrs(self, slot):
+        """Scheduler hook: the span attrs captured by the slot's latest
+        prefill (prefix-hit vs cold, suffix bucket, adapter name) — the
+        per-phase facts only the engine knows."""
+        return self._slot_trace_attrs.pop(slot, {})
+
+    def use_tracer(self, tracer):
+        """Adopt a caller-owned tracer (the fleet router injects its own
+        into in-process replicas so scheduler spans land in the SAME
+        trace file as the router's root spans). The tracer's lifecycle
+        stays with its owner — engine.close() never closes it."""
+        self.tracer = tracer
+        self.scheduler._tracer = tracer
 
     def _suffix_bucket(self, suffix_len, prefix_len):
         """Smallest compiled suffix width that (a) holds the suffix and

@@ -36,11 +36,14 @@ crash.
 
 import collections
 import itertools
+import os
 import queue
 import threading
 import time
+import uuid
 
 from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS
+from ..telemetry.tracing import NOOP_TRACER, TraceContext
 from ..utils.logging import logger
 from .paging import PoolExhausted
 
@@ -91,14 +94,24 @@ HEALTH_DRAINING = 2
 
 class InferenceRequest:
     """One generation request. ``result()`` blocks until the scheduler
-    finishes it and returns the generated token ids (prompt excluded)."""
+    finishes it and returns the generated token ids (prompt excluded).
 
-    _ids = itertools.count()
+    ``request_id`` is a replica-prefixed GLOBALLY unique string minted by
+    the scheduler (``{replica}-{instance token}-{seq}``): a process-local
+    integer counter collides across replicas (and across one replica's
+    driver restarts) the moment ids reach fleet telemetry, so the id
+    carries the replica AND a per-scheduler random token. It rides the
+    request's trace as the root attr (docs/observability.md)."""
+
+    _ids = itertools.count()  # fallback for direct construction only
 
     def __init__(self, prompt_tokens, max_new_tokens, temperature,
                  eos_token_id, deadline_secs=None, priority=0,
-                 adapter=None):
-        self.request_id = next(self._ids)
+                 adapter=None, request_id=None):
+        self.request_id = (
+            request_id if request_id is not None
+            else f"req-{os.getpid():x}-{next(self._ids)}"
+        )
         self.prompt_tokens = [int(t) for t in prompt_tokens]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -120,6 +133,15 @@ class InferenceRequest:
         self.first_token_at = None
         self._done = threading.Event()
         self._cancelled = False
+        # distributed-tracing state (telemetry/tracing.py): trace_ctx is
+        # the request's own span context (phases parent to it), set by
+        # the scheduler when tracing is armed; trace_spans collects the
+        # request's sampled spans so remote callers (the worker RPC) can
+        # ship them back to the router's trace file
+        self.trace_ctx = None
+        self.trace_spans = []
+        self._trace_parent = None
+        self._tracer = None
 
     @property
     def done(self):
@@ -140,7 +162,32 @@ class InferenceRequest:
         return self.tokens
 
     def _finish(self, reason):
+        already = self._done.is_set()
         self.finish_reason = reason
+        if not already and self._tracer is not None and (
+            self.trace_ctx is not None
+        ):
+            # the request's container span (queue/prefill spans are its
+            # children), closed retroactively with the pre-allocated
+            # span id — every finish path (EOS, deadline, crash, cancel)
+            # lands here. Recorded BEFORE _done is set: the worker's
+            # done-poller ships trace_spans the moment done reads True,
+            # and a finished event without the container span would
+            # orphan the phase spans in the router's trace.
+            attrs = {
+                "request_id": self.request_id,
+                "finish_reason": reason,
+                "tokens": len(self.tokens),
+            }
+            if self.adapter is not None:
+                attrs["adapter"] = self.adapter
+            span = self._tracer.record(
+                "sched.request", self.submitted_at, time.monotonic(),
+                ctx=self._trace_parent, span_id=self.trace_ctx.span_id,
+                attrs=attrs,
+            )
+            if span is not None and span["sampled"]:
+                self.trace_spans.append(span)
         self._done.set()
 
 
@@ -153,8 +200,22 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine, *, num_slots, max_seq_len, queue_depth,
                  queue_timeout, eos_token_id, temperature, registry,
                  telemetry=None, export_interval=16, deadline_secs=None,
-                 driver_restart_budget=0, degraded_queue_ratio=0.75):
+                 driver_restart_budget=0, degraded_queue_ratio=0.75,
+                 tracer=None):
         self._engine = engine
+        # request tracer (telemetry/tracing.py): the NOOP passthrough
+        # unless the engine's telemetry.tracing block armed one — every
+        # hot-path hook below is gated on one attribute check
+        self._tracer = tracer if tracer is not None else NOOP_TRACER
+        # per-driver trace the batch-level decode-step spans parent to
+        # (they belong to no single request)
+        self._driver_ctx = None
+        # globally-unique request ids: replica prefix (set_id_prefix)
+        # + a per-instance random token (driver restarts rebuild the
+        # scheduler — the token keeps post-restart ids distinct) + seq
+        self._id_token = uuid.uuid4().hex[:8]
+        self._id_prefix = f"p{os.getpid():x}-{self._id_token}"
+        self._id_seq = itertools.count()
         self.num_slots = int(num_slots)
         self.max_seq_len = int(max_seq_len)
         self._queue = queue.Queue(maxsize=int(queue_depth))
@@ -213,6 +274,37 @@ class ContinuousBatchingScheduler:
         self._health_gauge = reg.gauge("infer/health_state")
         self._driver_restarts = reg.counter("infer/driver_restarts")
         self._shed = reg.counter("infer/requests_shed")
+
+    # -- tracing helpers -------------------------------------------------
+    def set_id_prefix(self, replica_id):
+        """Adopt the serving tier's replica id as the request-id prefix
+        (the per-instance token stays, so a restarted driver on the same
+        replica still mints globally unique ids)."""
+        self._id_prefix = f"r{replica_id}-{self._id_token}"
+
+    def _trace_id(self, req):
+        """The request's trace id for histogram exemplars (None when the
+        trace is unsampled or tracing is off)."""
+        ctx = req.trace_ctx
+        return ctx.trace_id if ctx is not None and ctx.sampled else None
+
+    def _trace_phase(self, req, name, t0, t1, attrs=None):
+        """Record one request-phase span under the request's container
+        span; sampled spans also collect on the request for RPC
+        shipping. Call sites gate on ``self._tracer.enabled``."""
+        if req.trace_ctx is None:
+            return None
+        span = self._tracer.record(
+            name, t0, t1, ctx=req.trace_ctx, attrs=attrs
+        )
+        if span is not None and span["sampled"]:
+            req.trace_spans.append(span)
+        return span
+
+    def _reject_event(self, reason):
+        """Admission-verdict breadcrumb for the flight recorder."""
+        if self._tracer.enabled:
+            self._tracer.event("sched.reject", attrs={"reason": reason})
 
     # -- health-state machine -------------------------------------------
     @property
@@ -298,7 +390,7 @@ class ContinuousBatchingScheduler:
     # -- front door -----------------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens=32, temperature=None,
                eos_token_id=None, timeout=None, deadline_secs=None,
-               priority=0, adapter=None):
+               priority=0, adapter=None, trace_ctx=None):
         """Enqueue a request; returns the :class:`InferenceRequest`
         handle. Raises :class:`RequestRejected` when the bounded queue
         stays full past ``timeout`` (default: the config's
@@ -329,6 +421,7 @@ class ContinuousBatchingScheduler:
         health = self._update_health()
         if health == HEALTH_DRAINING:
             self._rejected.inc()
+            self._reject_event(REJECT_DRAINING)
             raise RequestRejected(
                 "scheduler is draining; not admitting new requests",
                 reason=REJECT_DRAINING,
@@ -336,6 +429,7 @@ class ContinuousBatchingScheduler:
         if health == HEALTH_DEGRADED and int(priority) > 0:
             self._shed.inc()
             self._rejected.inc()
+            self._reject_event(REJECT_OVERLOAD)
             raise RequestRejected(
                 f"degraded (queue {self._queue.qsize()}/"
                 f"{self._queue.maxsize}): shedding priority-{priority} "
@@ -390,6 +484,7 @@ class ContinuousBatchingScheduler:
             available = self._engine.kv_blocks_available()
             if needed > available:
                 self._rejected.inc()
+                self._reject_event(REJECT_CAPACITY)
                 raise RequestRejected(
                     f"KV page pool exhausted: request needs {needed} "
                     f"pages, {available} free or evictable (of {total})",
@@ -408,7 +503,19 @@ class ContinuousBatchingScheduler:
             deadline_secs=deadline_secs,
             priority=priority,
             adapter=adapter,
+            request_id=f"{self._id_prefix}-{next(self._id_seq)}",
         )
+        if self._tracer.enabled:
+            # join the caller's trace (router root over the RPC) or start
+            # a fresh one; the request's own span id is pre-allocated so
+            # phase spans parent to it before it closes at finish time
+            parent = TraceContext.from_wire(trace_ctx)
+            ctx = self._tracer.child_of(parent)
+            req.trace_ctx = ctx
+            req._trace_parent = parent or TraceContext(
+                ctx.trace_id, None, ctx.sampled
+            )
+            req._tracer = self._tracer
         wait = self._queue_timeout if timeout is None else float(timeout)
         try:
             if wait > 0:
@@ -417,6 +524,7 @@ class ContinuousBatchingScheduler:
                 self._queue.put_nowait(req)
         except queue.Full:
             self._rejected.inc()
+            self._reject_event(REJECT_OVERLOAD)
             raise RequestRejected(
                 f"request queue full ({self._queue.maxsize} waiting); "
                 f"rejected after {wait:.3f}s",
@@ -428,6 +536,7 @@ class ContinuousBatchingScheduler:
             req.cancel()
             req._finish(_FINISH_CANCELLED)
             self._rejected.inc()
+            self._reject_event(REJECT_DRAINING)
             raise RequestRejected(
                 "scheduler is shut down", reason=REJECT_DRAINING
             )
@@ -572,15 +681,40 @@ class ContinuousBatchingScheduler:
                     # leave a stale prefix-cache salt on the slot)
                     self._free_slot(slot)
                     self._deferred.appendleft(req)
+                    if self._tracer.enabled:
+                        self._tracer.event(
+                            "sched.defer", ctx=req.trace_ctx,
+                            attrs={"request_id": req.request_id},
+                        )
                     break
-            self._queue_wait_ms.observe((t0 - req.submitted_at) * 1e3)
+            if self._tracer.enabled:
+                self._trace_phase(req, "sched.queue", req.submitted_at, t0)
+            self._queue_wait_ms.observe(
+                (t0 - req.submitted_at) * 1e3, trace_id=self._trace_id(req)
+            )
             first = self._engine.prefill_request(
                 slot, req.prompt_tokens, req.temperature
             )
             now = time.monotonic()
-            self._prefill_ms.observe((now - t0) * 1e3)
+            if self._tracer.enabled:
+                # prefix-hit/cold, suffix bucket, adapter name — the
+                # engine owns those facts; the hook keeps this module
+                # jax-free (and stub-engine friendly)
+                attrs_fn = getattr(
+                    self._engine, "prefill_trace_attrs", None
+                )
+                self._trace_phase(
+                    req, "sched.prefill", t0, now,
+                    attrs=attrs_fn(slot) if attrs_fn is not None else None,
+                )
+            self._prefill_ms.observe(
+                (now - t0) * 1e3, trace_id=self._trace_id(req)
+            )
             req.first_token_at = now
-            self._ttft_ms.observe((now - req.submitted_at) * 1e3)
+            self._ttft_ms.observe(
+                (now - req.submitted_at) * 1e3,
+                trace_id=self._trace_id(req),
+            )
             # a 1-token request (or instant EOS) frees the slot right here
             self._count_token(req, first)
         self._occupancy.set(len(self.active_slots))
@@ -624,7 +758,17 @@ class ContinuousBatchingScheduler:
             return 0
         t0 = time.monotonic()
         next_tokens = self._engine.decode_tokens(active)
-        self._token_latency_ms.observe((time.monotonic() - t0) * 1e3)
+        t1 = time.monotonic()
+        if self._tracer.enabled:
+            # batch-level span: one decode step serves EVERY active slot,
+            # so it parents to the driver's trace, not any one request
+            if self._driver_ctx is None:
+                self._driver_ctx = self._tracer.child_of(None)
+            self._tracer.record(
+                "sched.decode_step", t0, t1, ctx=self._driver_ctx,
+                attrs={"active_slots": len(active), "step": self._steps},
+            )
+        self._token_latency_ms.observe((t1 - t0) * 1e3)
         for slot, token in zip(active, next_tokens):
             req = self._slots[slot]
             if req is not None:
@@ -674,6 +818,10 @@ class ContinuousBatchingScheduler:
         try:
             return self.step()
         except Exception:
+            # decode-driver crash: dump the flight recorder's last-N
+            # spans/events BEFORE recovery scrambles the scene (no-op
+            # when tracing is off)
+            self._tracer.dump_flight("decode_driver_crash")
             if self._stop.is_set() or self.restarts_used >= self._restart_budget:
                 raise
             self.restarts_used += 1

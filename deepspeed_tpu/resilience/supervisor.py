@@ -46,9 +46,14 @@ runs where self-healing beats peak async throughput.
 
 import math
 import threading
+import time
 from collections import deque
 
-from ..telemetry.registry import MetricsRegistry
+from ..telemetry.registry import (
+    MetricsRegistry,
+    suppressed_errors_snapshot,
+)
+from ..telemetry.tracing import NOOP_TRACER
 from ..utils.logging import log_dist, warn_once
 
 
@@ -108,11 +113,18 @@ class TrainingSupervisor:
 
     def __init__(self, max_rollbacks=2, nonfinite_window=3,
                  spike_factor=0.0, spike_window=32, min_history=8,
-                 registry=None):
+                 registry=None, tracer=None, trace_ctx_fn=None):
         self.max_rollbacks = int(max_rollbacks)
         self.nonfinite_window = int(nonfinite_window)
         self.spike_factor = float(spike_factor)
         self.min_history = int(min_history)
+        # request/step tracer (telemetry/tracing.py): rollbacks record
+        # spans and terminal escalations dump the flight recorder; the
+        # NOOP passthrough when tracing is off. trace_ctx_fn (the
+        # telemetry facade's train_trace_ctx) parents rollback spans
+        # under the run's train trace.
+        self._tracer = tracer if tracer is not None else NOOP_TRACER
+        self._trace_ctx_fn = trace_ctx_fn
         self.rollbacks = 0
         self._consecutive_bad = 0
         self._history = deque(maxlen=int(spike_window))
@@ -206,6 +218,21 @@ class TrainingSupervisor:
         return True
 
     # -- the rollback itself --------------------------------------------
+    def _escalate(self, message, reason):
+        """Terminal escalation: dump the flight recorder (the last-N
+        spans/events around the anomaly) and attach the suppressed-error
+        diagnostics — the deliberately swallowed exceptions surface at
+        exactly the moment someone starts debugging — then raise."""
+        suppressed = suppressed_errors_snapshot()
+        dump = self._tracer.dump_flight("supervisor_escalation")
+        if suppressed:
+            message += f"; suppressed errors: {suppressed}"
+        if dump:
+            message += f"; flight recorder: {dump}"
+        raise SupervisorEscalation(
+            message, reason=reason, rollbacks=self.rollbacks
+        )
+
     def rollback(self, engine, reason):
         """Bounded in-process rollback to the last committed checkpoint;
         raises :class:`SupervisorEscalation` when out of budget or
@@ -214,19 +241,20 @@ class TrainingSupervisor:
             engine, "_last_checkpoint_dir", None
         )
         if not resume:
-            raise SupervisorEscalation(
+            self._escalate(
                 f"run anomaly ({reason}) but no committed checkpoint "
                 "exists to roll back to — save one before the supervised "
                 "loop, or disable the supervisor",
-                reason=reason, rollbacks=self.rollbacks,
+                reason,
             )
         if self.rollbacks >= self.max_rollbacks:
-            raise SupervisorEscalation(
+            self._escalate(
                 f"rollback budget exhausted ({self.rollbacks}/"
                 f"{self.max_rollbacks}) and the run is still anomalous: "
                 f"{reason}",
-                reason=reason, rollbacks=self.rollbacks,
+                reason,
             )
+        t0 = time.monotonic()
         log_dist(
             f"SUPERVISOR ROLLBACK ({self.rollbacks + 1}/"
             f"{self.max_rollbacks}): {reason}; restoring from {resume}",
@@ -236,10 +264,10 @@ class TrainingSupervisor:
         engine.close_data_pipeline()
         path, _ = engine.load_checkpoint(resume)
         if path is None:
-            raise SupervisorEscalation(
+            self._escalate(
                 f"rollback failed: no loadable checkpoint under "
                 f"{resume!r} (see resilience/corruption_fallbacks)",
-                reason=reason, rollbacks=self.rollbacks,
+                reason,
             )
         if self._source is not None:
             self._source.rewind(engine.micro_steps)
@@ -263,9 +291,16 @@ class TrainingSupervisor:
         self._stalled.clear()
         self.rollbacks += 1
         self._rollbacks_c.inc()
+        self._tracer.record(
+            "train.supervisor_rollback", t0, time.monotonic(),
+            ctx=self._trace_ctx_fn() if self._trace_ctx_fn else None,
+            attrs={"reason": reason, "rollback": self.rollbacks,
+                   "resume_dir": str(resume)},
+        )
 
 
-def build_supervisor(config, registry=None):
+def build_supervisor(config, registry=None, tracer=None,
+                     trace_ctx_fn=None):
     """Construct the engine's supervisor from a validated
     DeepSpeedConfig; None unless the config block enables it."""
     if not getattr(config, "resilience_supervisor_enabled", False):
@@ -277,4 +312,6 @@ def build_supervisor(config, registry=None):
         spike_window=config.resilience_supervisor_spike_window,
         min_history=config.resilience_supervisor_min_history,
         registry=registry,
+        tracer=tracer,
+        trace_ctx_fn=trace_ctx_fn,
     )

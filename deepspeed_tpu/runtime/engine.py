@@ -572,6 +572,11 @@ class DeepSpeedEngine:
                 if self.telemetry.enabled
                 else self.resilience.registry
             ),
+            # rollback spans + escalation flight dumps ride the
+            # telemetry tracer (NOOP unless telemetry.tracing armed it);
+            # ctx fn parents them under the run's train trace
+            tracer=self.telemetry.tracer,
+            trace_ctx_fn=self.telemetry.train_trace_ctx,
         )
         # rolled-back flag for the supervised train_batch retry loop: set
         # by _finish_step when the supervisor discarded this window's
@@ -2292,7 +2297,15 @@ class DeepSpeedEngine:
         # a large-model save can outlast the watchdog timeout; suspend
         # stall detection for its whole duration, not just a beat around it
         with self.telemetry.liveness_exempt():
-            result = _save(self, save_dir, tag=tag, client_state=client_state or {})
+            # checkpoint-commit span (telemetry/tracing.py): atomic
+            # commits are the training timeline's landmarks — a trace
+            # shows what the run was doing around each one
+            with self.telemetry.tracer.span(
+                "train.checkpoint_commit",
+                ctx=self.telemetry.train_trace_ctx(),
+                attrs={"save_dir": str(save_dir), "tag": tag},
+            ):
+                result = _save(self, save_dir, tag=tag, client_state=client_state or {})
         # remember the save target: the preemption drain's default sink
         self._last_checkpoint_dir = save_dir
         if self.supervisor is not None:

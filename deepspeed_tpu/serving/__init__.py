@@ -106,17 +106,6 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
             "passed; provide engine_factory instead"
         )
 
-    if engine_factory is not None:
-        replicas = [
-            InProcessReplica(str(i), engine_factory)
-            for i in range(cfg.serving_replicas)
-        ]
-    else:
-        replicas = [
-            SubprocessReplica(str(i), worker_spec)
-            for i in range(cfg.serving_replicas)
-        ]
-
     telemetry = None
     if registry is None:
         import jax
@@ -128,6 +117,32 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
             registry = telemetry.registry
         else:
             telemetry = None
+
+    # fleet request tracer (telemetry/tracing.py): telemetry's when one
+    # was built, a standalone from the config otherwise (callers passing
+    # their own registry still get tracing when the block arms it)
+    if telemetry is not None:
+        tracer = telemetry.tracer
+    else:
+        from ..telemetry.tracing import build_tracer
+
+        tracer = build_tracer(cfg)
+
+    if engine_factory is not None:
+        replicas = [
+            InProcessReplica(
+                str(i), engine_factory,
+                # in-process engines share the fleet tracer so their
+                # scheduler spans land in the router's trace file
+                tracer=tracer if tracer.enabled else None,
+            )
+            for i in range(cfg.serving_replicas)
+        ]
+    else:
+        replicas = [
+            SubprocessReplica(str(i), worker_spec)
+            for i in range(cfg.serving_replicas)
+        ]
 
     router = FleetRouter(
         replicas,
@@ -142,6 +157,7 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
         per_tenant_limits=cfg.serving_rate_limit_per_tenant,
         registry=registry,
         telemetry=telemetry,
+        tracer=tracer,
     )
     if start:
         router.start()

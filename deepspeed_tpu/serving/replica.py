@@ -70,9 +70,14 @@ class InProcessReplica(ReplicaBase):
     fresh driver thread over freshly-pinned params, exactly what a
     process restart would give, minus the process."""
 
-    def __init__(self, replica_id, engine_factory):
+    def __init__(self, replica_id, engine_factory, tracer=None):
         super().__init__(replica_id)
         self._factory = engine_factory
+        # fleet-owned tracer injected into every engine this replica
+        # builds, so in-process scheduler spans land in the router's
+        # trace file (telemetry/tracing.py); None leaves the engine's
+        # own (usually NOOP) tracer alone
+        self._tracer = tracer
         self.engine = None
         self._shutdown_requested = False
 
@@ -81,6 +86,16 @@ class InProcessReplica(ReplicaBase):
             return self
         self._shutdown_requested = False
         self.engine = self._factory()
+        if self._tracer is not None:
+            use = getattr(self.engine, "use_tracer", None)
+            if use is not None:
+                use(self._tracer)
+        # replica-prefixed globally-unique request ids (fleet telemetry
+        # must never see two replicas minting the same id)
+        sched = getattr(self.engine, "scheduler", None)
+        set_prefix = getattr(sched, "set_id_prefix", None)
+        if set_prefix is not None:
+            set_prefix(self.replica_id)
         self.engine.serve_forever()
         return self
 
@@ -175,6 +190,10 @@ class RemoteRequest:
         self.tokens = []
         self.finish_reason = None
         self.first_token_at = None
+        # worker-side trace spans shipped back with the finished event
+        # (telemetry/tracing.py): the router ingests them so the fleet
+        # request's trace is whole in one file
+        self.trace_spans = []
         self._done = threading.Event()
 
     @property
@@ -244,7 +263,13 @@ class SubprocessReplica(ReplicaBase):
             name=f"ds-replica-{self.replica_id}-reader", daemon=True,
         )
         self._reader.start()
-        self._send({"op": "init", "spec": self.worker_spec})
+        # the spec carries this replica's id so the worker's scheduler
+        # mints replica-prefixed request ids (and its spans say which
+        # replica served them)
+        self._send({
+            "op": "init",
+            "spec": dict(self.worker_spec, replica_id=self.replica_id),
+        })
         if not self._ready.wait(self._start_timeout):
             self.shutdown()
             raise RuntimeError(
@@ -319,6 +344,7 @@ class SubprocessReplica(ReplicaBase):
             if req is not None:
                 if req.first_token_at is None and msg.get("tokens"):
                     req.first_token_at = time.monotonic()
+                req.trace_spans = msg.get("spans") or []
                 req._finish(msg.get("tokens", []), msg.get("reason"))
         else:
             logger.warning(

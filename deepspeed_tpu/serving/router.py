@@ -46,6 +46,7 @@ from ..inference.scheduler import (
     RequestRejected,
 )
 from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS, histogram_quantile
+from ..telemetry.tracing import NOOP_TRACER, TraceContext
 from ..utils.logging import logger
 from .admission import AdmissionController, FleetOverloaded, RateLimited  # noqa: F401  (re-exported)
 
@@ -225,6 +226,10 @@ class FleetRequest:
         self.prompt_tokens = [int(t) for t in prompt_tokens]
         self.tenant = tenant
         self.kwargs = dict(kwargs)
+        # the fleet request's ROOT trace context (telemetry/tracing.py):
+        # set by the router when tracing is armed; every replica-side
+        # span for this request descends from its span_id
+        self.trace_ctx = None
         self.tokens = []
         self.finish_reason = None
         self.replica_id = None
@@ -280,7 +285,8 @@ class FleetRouter:
                  shed_queue_ratio=0.75, max_reroutes=2,
                  rate_limit=(None, 1), per_tenant_limits=None,
                  registry=None, telemetry=None, clock=time.monotonic,
-                 monitor_interval=0.002, telemetry_refresh_secs=0.25):
+                 monitor_interval=0.002, telemetry_refresh_secs=0.25,
+                 tracer=None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         from ..telemetry.manager import register_serving_metrics
@@ -328,6 +334,12 @@ class FleetRouter:
         self._monitor = None
         self._monitor_interval = float(monitor_interval)
         self._telemetry = telemetry
+        # fleet-level request tracer (telemetry/tracing.py): the router
+        # opens each fleet request's root span, records admission /
+        # placement / re-route children, and INGESTS the replica-side
+        # spans shipped back over the worker RPC so one trace file holds
+        # the whole request. NOOP passthrough unless armed.
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
         self._telemetry_refresh_secs = float(telemetry_refresh_secs)
         self._last_refresh = 0.0
         self._refreshes = 0
@@ -385,6 +397,7 @@ class FleetRouter:
             orphans = [fr for fr, _inner, _rid in self._outstanding.values()]
             self._outstanding.clear()
         for fr in orphans:
+            self._trace_finish_root(fr, _FINISH_CANCELLED)
             fr._finish(fr.tokens, _FINISH_CANCELLED)
         if self._preemption is not None:
             self._preemption.uninstall()
@@ -393,6 +406,9 @@ class FleetRouter:
         if self._telemetry is not None and self._telemetry.enabled:
             self._telemetry.export(step=self._refreshes)
             self._telemetry.close()
+        # idempotent: the telemetry close above already closed a tracer
+        # it owns; a standalone-built tracer closes here
+        self.tracer.close()
 
     def install_preemption_drain(self, signals=("SIGTERM", "SIGINT")):
         """Reuse the resilience PreemptionHandler (resilience/preemption.py)
@@ -550,6 +566,7 @@ class FleetRouter:
         temperature, deadline_secs, ...)."""
         if self._stop.is_set() or self._draining:
             self._rejected.inc()
+            self._trace_reject(REJECT_DRAINING, tenant)
             raise RequestRejected(
                 "fleet is draining; not admitting new requests",
                 reason=REJECT_DRAINING,
@@ -559,12 +576,19 @@ class FleetRouter:
         except RateLimited:
             self._rate_limited.inc()
             self._rejected.inc()
+            self._trace_reject("rate_limit", tenant)
             raise
         fleet_req = FleetRequest(prompt_tokens, tenant, kwargs)
         fleet_req.kwargs.setdefault("priority", priority)
+        if self.tracer.enabled:
+            # root trace: the span id pre-allocated here is what every
+            # admission/placement child — and, over the RPC, the serving
+            # replica's scheduler spans — parent to
+            fleet_req.trace_ctx = self.tracer.child_of(None)
         candidates = self._candidates()
         if not candidates:
             self._rejected.inc()
+            self._trace_reject("overload", tenant)
             raise FleetOverloaded(
                 "no routable replica (all draining, restarting, or "
                 "evicted)"
@@ -574,6 +598,7 @@ class FleetRouter:
             fastest = min(s["mean_prefill_ms"] for _rid, s in candidates)
             if fastest > 0 and float(deadline) * 1e3 <= fastest:
                 self._rejected.inc()
+                self._trace_reject(REJECT_DEADLINE, tenant)
                 raise RequestRejected(
                     f"deadline {float(deadline) * 1e3:.0f}ms is below the "
                     f"fastest candidate's observed prefill "
@@ -585,14 +610,26 @@ class FleetRouter:
             cap = sum(s["queue_capacity"] for _rid, s in candidates)
             if cap > 0 and fill >= self.shed_queue_ratio * cap:
                 self._rejected.inc()
+                self._trace_reject("overload", tenant)
                 raise FleetOverloaded(
                     f"fleet queue fill {fill}/{cap} past the shed ratio "
                     f"{self.shed_queue_ratio}: shedding priority-"
                     f"{priority} submission"
                 )
+        if self.tracer.enabled and fleet_req.trace_ctx is not None:
+            # admission verdict span: rate-limit + pressure + deadline
+            # gates all passed (rejections record flight-recorder events
+            # instead — they have no replica-side continuation)
+            self.tracer.record(
+                "router.admission", fleet_req.submitted_at,
+                time.monotonic(), ctx=fleet_req.trace_ctx,
+                attrs={"tenant": tenant, "priority": int(priority),
+                       "verdict": "admitted"},
+            )
         inner, rid = self._place(fleet_req, candidates)
         if inner is None:
             self._rejected.inc()
+            self._trace_reject("overload", tenant)
             raise FleetOverloaded(
                 "every routable replica rejected the request at its own "
                 "door (queues full)"
@@ -614,6 +651,43 @@ class FleetRouter:
             )
         self._routed.inc()
         return fleet_req
+
+    def _trace_reject(self, reason, tenant):
+        """Router-door rejection breadcrumb for the flight recorder."""
+        if self.tracer.enabled:
+            self.tracer.event(
+                "router.reject", attrs={"reason": reason, "tenant": tenant}
+            )
+
+    def _trace_finish_root(self, fleet_req, reason, inner=None, rid=None):
+        """Close the fleet request's root span with its terminal
+        ``reason`` — on EVERY finish path, including error/deadline
+        finishes out of the re-route loop and shutdown cancellation:
+        the failing requests are exactly the traces worth having whole.
+        Adopts the replica-side spans first (``inner``) so the file
+        carries the serving half too; idempotent via the ctx reset."""
+        ctx = fleet_req.trace_ctx
+        if not self.tracer.enabled or ctx is None:
+            return
+        fleet_req.trace_ctx = None
+        if inner is not None:
+            self.tracer.ingest(getattr(inner, "trace_spans", None) or ())
+        self.tracer.record(
+            "fleet.request", fleet_req.submitted_at, time.monotonic(),
+            ctx=TraceContext(ctx.trace_id, None, ctx.sampled),
+            span_id=ctx.span_id,
+            attrs={
+                "fleet_request_id": fleet_req.request_id,
+                "request_id": getattr(inner, "request_id", None),
+                "tenant": fleet_req.tenant,
+                "finish_reason": reason,
+                "replica": rid,
+                "reroutes": fleet_req.reroutes,
+                "tokens": len(
+                    inner.tokens if inner is not None else fleet_req.tokens
+                ),
+            },
+        )
 
     def _candidates(self):
         """(replica_id, snapshot) pairs for the currently routable,
@@ -643,15 +717,29 @@ class FleetRouter:
             "adapter": fleet_req.kwargs.get("adapter"),
             "tenant": fleet_req.tenant,
         }
+        t_place = time.monotonic()
+        attempts = 0
+        submit_kwargs = fleet_req.kwargs
+        if self.tracer.enabled and fleet_req.trace_ctx is not None:
+            # context propagation to the replica: a wire dict riding the
+            # ordinary kwargs channel, so it crosses the subprocess
+            # worker's JSON RPC untouched and the replica's scheduler
+            # spans join THIS trace. Not stored on fleet_req.kwargs — a
+            # re-route re-derives it.
+            submit_kwargs = dict(
+                fleet_req.kwargs,
+                trace_ctx=fleet_req.trace_ctx.to_wire(),
+            )
         while candidates:
             with self._placement_lock:
                 rid = self.placement.choose(
                     candidates, fleet_req.prompt_tokens, context=context
                 )
                 was_hit = getattr(self.placement, "last_hit", False)
+            attempts += 1
             try:
                 inner = self._replicas[rid].submit(
-                    fleet_req.prompt_tokens, **fleet_req.kwargs
+                    fleet_req.prompt_tokens, **submit_kwargs
                 )
             except (RequestRejected, AdapterUnavailable):
                 # AdapterUnavailable is per-REPLICA, not per-request: a
@@ -665,6 +753,21 @@ class FleetRouter:
                 # rejected at its door and fell through to another one
                 # must not inflate the affinity-effectiveness metric
                 self._affinity_hits.inc()
+            if self.tracer.enabled and fleet_req.trace_ctx is not None:
+                self.tracer.record(
+                    "router.place", t_place, time.monotonic(),
+                    ctx=fleet_req.trace_ctx,
+                    attrs={
+                        "replica": rid,
+                        "policy": getattr(
+                            self.placement, "name",
+                            type(self.placement).__name__,
+                        ),
+                        "affinity_hit": bool(was_hit),
+                        "attempts": attempts,
+                        "reroute": fleet_req.reroutes,
+                    },
+                )
             fleet_req.replica_id = rid
             with self._lock:
                 self.routed_counts[rid] = self.routed_counts.get(rid, 0) + 1
@@ -706,6 +809,9 @@ class FleetRouter:
                     "fleet: evicting replica %s (decode driver dead past "
                     "its restart budget); re-routing its requests", rid,
                 )
+                # eviction is a debugging moment: dump the flight
+                # recorder's last-N spans/events (no-op when tracing off)
+                self.tracer.dump_flight(f"replica_eviction_{rid}")
                 with self._lock:
                     self._routable.discard(rid)
                     self._evicted.add(rid)
@@ -726,26 +832,40 @@ class FleetRouter:
             if inner.finish_reason in _TERMINAL_REASONS:
                 with self._lock:
                     self._outstanding.pop(req_id, None)
+                ctx = fleet_req.trace_ctx
+                traced = self.tracer.enabled and ctx is not None
                 first = getattr(inner, "first_token_at", None)
                 if first is not None:
                     # no first token (e.g. a deadline finish with zero
                     # tokens) = no TTFT sample; a sweep-time anchor would
                     # poison the fleet p50/p99 with fake latencies
                     self._ttft.observe(
-                        max(first - fleet_req.submitted_at, 0.0) * 1e3
+                        max(first - fleet_req.submitted_at, 0.0) * 1e3,
+                        trace_id=(
+                            ctx.trace_id if traced and ctx.sampled
+                            else None
+                        ),
                     )
                 self._completed.inc()
+                # adopt the replica-side spans (the worker shipped them
+                # back with the finished event; in-process replicas
+                # share this tracer, so ingest dedupes by pid) and close
+                # the root span
+                self._trace_finish_root(
+                    fleet_req, inner.finish_reason, inner=inner, rid=rid
+                )
                 fleet_req._finish(inner.tokens, inner.finish_reason)
             else:
                 # "error"/"cancelled": the replica died under it (crash
                 # past restart budget, eviction, worker exit) — re-place
                 # on a live replica, or fail the fleet request loudly
-                self._reroute(req_id, fleet_req)
+                self._reroute(req_id, fleet_req, inner)
 
-    def _reroute(self, req_id, fleet_req):
+    def _reroute(self, req_id, fleet_req, inner=None):
         if fleet_req.reroutes >= self.max_reroutes:
             with self._lock:
                 self._outstanding.pop(req_id, None)
+            self._trace_finish_root(fleet_req, _FINISH_ERROR, inner=inner)
             fleet_req._finish(fleet_req.tokens, _FINISH_ERROR)
             return
         if fleet_req.deadline_at is not None:
@@ -756,6 +876,9 @@ class FleetRouter:
                 # a fresh full-budget generation somewhere else
                 with self._lock:
                     self._outstanding.pop(req_id, None)
+                self._trace_finish_root(
+                    fleet_req, "deadline", inner=inner
+                )
                 fleet_req._finish(fleet_req.tokens, "deadline")
                 return
             fleet_req.kwargs["deadline_secs"] = remaining
@@ -766,9 +889,13 @@ class FleetRouter:
             if self._stop.is_set() or self._draining or fleet_dead:
                 with self._lock:
                     self._outstanding.pop(req_id, None)
+                self._trace_finish_root(
+                    fleet_req, _FINISH_ERROR, inner=inner
+                )
                 fleet_req._finish(fleet_req.tokens, _FINISH_ERROR)
             return  # nothing routable right now; retry next tick
         fleet_req.reroutes += 1
+        t0 = time.monotonic()
         inner, rid = self._place(fleet_req, candidates)
         if inner is None:
             return  # burned one attempt; retry next tick
@@ -777,6 +904,14 @@ class FleetRouter:
             fleet_req.request_id, rid, fleet_req.reroutes,
             self.max_reroutes,
         )
+        if self.tracer.enabled and fleet_req.trace_ctx is not None:
+            # re-routes ride the root span as children, so the trace
+            # shows exactly which replica death cost the request time
+            self.tracer.record(
+                "router.reroute", t0, time.monotonic(),
+                ctx=fleet_req.trace_ctx,
+                attrs={"replica": rid, "attempt": fleet_req.reroutes},
+            )
         self._rerouted.inc()
         with self._lock:
             self._outstanding[req_id] = (fleet_req, inner, rid)

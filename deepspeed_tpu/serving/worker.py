@@ -28,7 +28,18 @@ Protocol (one JSON object per line):
                                              when the op was rejected
     {"event": "first_token", "id": N}        request N produced its TTFT
     {"event": "finished", "id": N,
-     "tokens": [...], "reason": "..."}       request N's terminal answer
+     "tokens": [...], "reason": "...",
+     "spans": [...]}                         request N's terminal answer;
+                                             "spans" (present only when
+                                             tracing sampled the request)
+                                             carries the worker-side trace
+                                             spans for the router's file
+
+Trace-context propagation (docs/observability.md "Request tracing"):
+the submit op's ``kwargs`` may carry ``trace_ctx`` — a JSON-safe
+TraceContext wire dict — which the engine's scheduler adopts, so the
+worker's spans parent to the router's fleet.request root. The init
+spec's ``replica_id`` prefixes the scheduler's request ids.
 
 The init ``spec``: ``{"model": {GPT2Config kwargs}, "init_seed": int,
 "rng_seed": int, "config": {deepspeed config dict}}``. Params initialize
@@ -85,16 +96,32 @@ class WorkerServer:
                 if req.done:
                     with self._state_lock:
                         self._tracked.pop(rpc_id, None)
-                    self._emit({
+                    msg = {
                         "event": "finished", "id": rpc_id,
                         "tokens": [int(t) for t in req.tokens],
                         "reason": req.finish_reason,
-                    })
+                    }
+                    # ship the request's sampled trace spans home with
+                    # the answer: the parent replica hands them to the
+                    # router's tracer, joining this worker's spans to
+                    # the fleet request's trace in ONE file
+                    spans = getattr(req, "trace_spans", None)
+                    if spans:
+                        msg["spans"] = spans
+                    self._emit(msg)
             self._stop.wait(self._poll)
 
     # -- ops -----------------------------------------------------------
     def _op_init(self, msg):
         self._engine = self._build(msg["spec"])
+        # replica-prefixed request ids (inference/scheduler.py): two
+        # workers (or one worker across a restart) must never emit
+        # colliding ids into fleet telemetry
+        replica_id = msg["spec"].get("replica_id")
+        sched = getattr(self._engine, "scheduler", None)
+        set_prefix = getattr(sched, "set_id_prefix", None)
+        if replica_id is not None and set_prefix is not None:
+            set_prefix(replica_id)
         self._engine.serve_forever()
         threading.Thread(
             target=self._watch_loop, name="ds-worker-watch", daemon=True

@@ -12,10 +12,16 @@ Three layers behind one config block:
   ``StepTraceAnnotation`` so the engine's ``named_scope`` phase labels are
   navigable per step.
 - **Liveness** (watchdog.py): a step-heartbeat watchdog thread that logs a
-  rank-tagged stall report (timers, device memory, last metric values)
-  when no window completes within the configured timeout.
+  rank-tagged stall report (timers, device memory, last metric values,
+  suppressed-error counts, flight-recorder dump) when no window completes
+  within the configured timeout.
+- **Request tracing** (tracing.py): a Dapper-style span tracer with
+  context propagation across the serving fleet (router -> replica ->
+  scheduler, including the subprocess worker RPC) and the training
+  engine, Chrome-trace/Perfetto export, histogram exemplars, and an
+  always-on bounded flight recorder dumped on stalls/escalations/crashes.
 
-``manager.build_telemetry`` wires all three from the engine's config.
+``manager.build_telemetry`` wires all of it from the engine's config.
 """
 
 from .exporters import (
@@ -34,6 +40,14 @@ from .registry import (
     MetricsRegistry,
     install_recompile_hook,
 )
+from .tracing import (
+    NOOP_TRACER,
+    NoopTracer,
+    SpanTracer,
+    TraceContext,
+    build_tracer,
+    load_chrome_trace,
+)
 from .watchdog import StepHeartbeatWatchdog
 
 __all__ = [
@@ -44,12 +58,18 @@ __all__ = [
     "JsonlExporter",
     "MetricExporter",
     "MetricsRegistry",
+    "NOOP_TRACER",
+    "NoopTracer",
     "PrometheusTextfileExporter",
     "ProfilerWindow",
+    "SpanTracer",
     "StepHeartbeatWatchdog",
     "SummaryWriterExporter",
     "Telemetry",
+    "TraceContext",
     "build_telemetry",
+    "build_tracer",
     "install_recompile_hook",
+    "load_chrome_trace",
     "prometheus_name",
 ]

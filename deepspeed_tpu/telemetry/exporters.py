@@ -45,18 +45,24 @@ class JsonlExporter(MetricExporter):
         now = time.time()
         for m in metrics:
             if m.kind == "histogram":
-                self.writer.add_record(
-                    {
-                        "tag": m.name,
-                        "kind": "histogram",
-                        "count": m.count,
-                        "sum": m.sum,
-                        "thresholds": list(m.thresholds),
-                        "bucket_counts": list(m.bucket_counts),
-                        "step": step,
-                        "wall_time": now,
+                record = {
+                    "tag": m.name,
+                    "kind": "histogram",
+                    "count": m.count,
+                    "sum": m.sum,
+                    "thresholds": list(m.thresholds),
+                    "bucket_counts": list(m.bucket_counts),
+                    "step": step,
+                    "wall_time": now,
+                }
+                exemplars = getattr(m, "exemplars", None)
+                if exemplars:
+                    # bucket index -> [value, trace_id, unix ts]: the
+                    # metric->trace link (docs/observability.md)
+                    record["exemplars"] = {
+                        str(i): list(e) for i, e in exemplars.items()
                     }
-                )
+                self.writer.add_record(record)
             else:
                 self.writer.add_scalar(m.name, m.value, global_step=step)
         self.writer.flush()
@@ -114,6 +120,25 @@ def _format_value(v):
     return repr(float(v))
 
 
+def _exemplar_line(name, le, exemplar):
+    """Exemplar as a standalone COMMENT line following its bucket
+    sample (OpenMetrics-style payload, classic-format-safe carrier):
+    the 0.0.4 text format the node-exporter textfile collector parses
+    rejects trailing tokens on a sample line, so an inline OpenMetrics
+    ``# {...}`` tail would invalidate the whole .prom file the moment
+    tracing armed. A full-line ``#`` comment is ignored by every
+    classic parser and still carries the trace link for humans and
+    OpenMetrics-aware tooling. None when the bucket never saw a traced
+    observation."""
+    if not exemplar:
+        return None
+    value, trace_id, ts = exemplar
+    return (
+        f'# EXEMPLAR {name}_bucket{{le="{le}"}} '
+        f'{{trace_id="{trace_id}"}} {_format_value(value)} {ts:.3f}'
+    )
+
+
 class PrometheusTextfileExporter(MetricExporter):
     """Registry -> Prometheus text exposition format, rewritten atomically
     (write-temp-then-rename) so a scraper never reads a torn file. Point
@@ -133,14 +158,27 @@ class PrometheusTextfileExporter(MetricExporter):
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")
             if m.kind == "histogram":
+                # exemplars (bucket index -> (value, trace_id, ts)):
+                # the histogram->trace link, carried as comment lines
+                # beside the bucket samples (see _exemplar_line for why
+                # not an inline OpenMetrics tail)
+                exemplars = getattr(m, "exemplars", None) or {}
                 cumulative = 0
-                for threshold, count in zip(m.thresholds, m.bucket_counts):
+                for i, (threshold, count) in enumerate(
+                    zip(m.thresholds, m.bucket_counts)
+                ):
                     cumulative += count
-                    lines.append(
-                        f'{name}_bucket{{le="{_format_value(threshold)}"}} '
-                        f"{cumulative}"
-                    )
+                    le = _format_value(threshold)
+                    lines.append(f'{name}_bucket{{le="{le}"}} {cumulative}')
+                    ex = _exemplar_line(name, le, exemplars.get(i))
+                    if ex:
+                        lines.append(ex)
                 lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
+                ex = _exemplar_line(
+                    name, "+Inf", exemplars.get(len(m.thresholds))
+                )
+                if ex:
+                    lines.append(ex)
                 lines.append(f"{name}_sum {_format_value(m.sum)}")
                 lines.append(f"{name}_count {m.count}")
             else:

@@ -29,7 +29,9 @@ from .registry import (
     MetricsRegistry,
     install_compile_cache_hook,
     install_recompile_hook,
+    suppressed_errors_snapshot,
 )
+from .tracing import NOOP_TRACER, build_tracer
 from .watchdog import StepHeartbeatWatchdog
 
 # The engine's metric catalog (docs/observability.md documents each).
@@ -198,6 +200,7 @@ class Telemetry:
         profiler=None,
         watchdog=None,
         registry=None,
+        tracer=None,
     ):
         self.enabled = enabled
         self.registry = registry or MetricsRegistry()
@@ -206,6 +209,13 @@ class Telemetry:
         self.n_params = int(n_params)
         self.profiler = profiler
         self.watchdog = watchdog
+        # request/step tracer (tracing.py): the zero-overhead NOOP
+        # passthrough unless the telemetry.tracing block armed one
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
+        # lazy per-run trace the training spans parent under (one
+        # trace_id for the run's window/staging/checkpoint spans)
+        self._train_ctx = None
+        self._window_start_mono = None
         self._windows_ended = 0
         self._windows_since_export = 0
         self._pending_values = None
@@ -257,6 +267,8 @@ class Telemetry:
         if self.profiler is not None:
             self.profiler.on_window_start()
         self._window_start = time.time()
+        if self.tracer.enabled:
+            self._window_start_mono = time.monotonic()
 
     def count_batch(self, tokens, samples):
         if not self.enabled:
@@ -286,9 +298,25 @@ class Telemetry:
         # the end-to-end gap: the gap also counts dataloader wait and eval
         # phases between windows, which would poison the histogram
         if self._window_start is not None:
-            self.registry.histogram(
+            hist = self.registry.histogram(
                 "train/window_time_ms", buckets=DEFAULT_TIME_BUCKETS_MS
-            ).observe((now - self._window_start) * 1000.0)
+            )
+            span = None
+            if self.tracer.enabled and self._window_start_mono is not None:
+                span = self._record_train_span(
+                    "train.window", self._window_start_mono,
+                    time.monotonic(),
+                    attrs={
+                        "window": self._windows_ended + 1,
+                        "global_steps": int(global_steps),
+                        "micro_steps": int(micro_steps),
+                    },
+                )
+                self._window_start_mono = None
+            hist.observe(
+                (now - self._window_start) * 1000.0,
+                trace_id=span["trace_id"] if span else None,
+            )
             self._window_start = None
         self._windows_ended += 1
         if self.watchdog is not None:
@@ -355,9 +383,30 @@ class Telemetry:
     def observe_staging_time(self, ms):
         if not self.enabled:
             return
+        if self.tracer.enabled:
+            # the staging worker just finished assembling one window:
+            # reconstruct its span from the measured duration (called
+            # from the worker thread; the tracer is thread-safe)
+            now = time.monotonic()
+            self._record_train_span(
+                "train.stage_window", now - ms / 1e3, now
+            )
         self.registry.histogram(
             "dataloader/staging_time_ms", buckets=DEFAULT_TIME_BUCKETS_MS
         ).observe(ms)
+
+    def train_trace_ctx(self):
+        """The run's lazily-started train trace context: window, staging,
+        checkpoint, and rollback spans all parent here, so Perfetto shows
+        the run as ONE connected track (None while tracing is off)."""
+        if self._train_ctx is None:
+            self._train_ctx = self.tracer.child_of(None)
+        return self._train_ctx
+
+    def _record_train_span(self, name, t0, t1, attrs=None):
+        return self.tracer.record(
+            name, t0, t1, ctx=self.train_trace_ctx(), attrs=attrs
+        )
 
     def count_h2d_bytes(self, nbytes):
         if not self.enabled:
@@ -449,6 +498,7 @@ class Telemetry:
                 exporter.flush()
             except Exception:
                 pass
+        self.tracer.flush()
 
     def close(self):
         if self.watchdog is not None:
@@ -461,6 +511,7 @@ class Telemetry:
                 exporter.close()
             except Exception:
                 pass
+        self.tracer.close()
         self.enabled = False
         cb = getattr(self, "_atexit_cb", None)
         if cb is not None:
@@ -536,6 +587,10 @@ def build_telemetry(config, rank=0, n_params=0, timers=None, fence_fn=None):
         )
 
     registry = MetricsRegistry()
+    # request tracing + flight recorder (tracing.py): NOOP unless the
+    # telemetry.tracing block arms it; the trace file and flight dumps
+    # land in the same output directory as the metric sinks
+    tracer = build_tracer(config, out_dir=out_dir)
     watchdog = None
     if config.telemetry_watchdog_enabled:
         from ..utils.timers import SynchronizedWallClockTimer
@@ -549,6 +604,18 @@ def build_telemetry(config, rank=0, n_params=0, timers=None, fence_fn=None):
                 context["timers_s"] = {
                     k: round(v, 3) for k, v in timers.snapshot().items()
                 }
+            # the suppressed-errors diagnostics registry rides every
+            # stall report: deliberately swallowed exceptions surface at
+            # exactly the moment someone is debugging a stall
+            context["suppressed_errors"] = (
+                suppressed_errors_snapshot() or "none"
+            )
+            if tracer.enabled:
+                # dump the flight recorder's last-N spans/events next to
+                # the sinks; the report carries the path
+                context["flight_recorder"] = tracer.dump_flight(
+                    "watchdog_stall"
+                )
             return context
 
         watchdog = StepHeartbeatWatchdog(
@@ -565,4 +632,5 @@ def build_telemetry(config, rank=0, n_params=0, timers=None, fence_fn=None):
         profiler=profiler,
         watchdog=watchdog,
         registry=registry,
+        tracer=tracer,
     )

@@ -14,6 +14,7 @@ exporters that need a flat charset (Prometheus) sanitize on their side.
 """
 
 import threading
+import time
 import weakref
 
 from ..utils.logging import logger
@@ -75,6 +76,13 @@ class Histogram:
     bucket catches everything above the last threshold. ``bucket_counts``
     are NON-cumulative per-bucket counts; exporters compute the cumulative
     form Prometheus wants.
+
+    ``observe(value, trace_id=...)`` additionally records an OpenMetrics
+    EXEMPLAR for the value's bucket — the link from a latency histogram
+    to the distributed trace that produced the observation
+    (docs/observability.md "Request tracing & flight recorder"): the
+    request tracer passes the active trace_id, and "what request landed
+    in the p99 bucket" becomes a trace lookup instead of a guess.
     """
 
     kind = "histogram"
@@ -92,16 +100,30 @@ class Histogram:
         self._counts = [0] * (len(thresholds) + 1)  # last = +Inf
         self._sum = 0.0
         self._count = 0
+        self._exemplars = {}  # bucket index -> (value, trace_id, unix ts)
 
-    def observe(self, value):
+    def observe(self, value, trace_id=None):
         v = float(value)
         self._sum += v
         self._count += 1
         for i, t in enumerate(self.thresholds):
             if v <= t:
                 self._counts[i] += 1
+                if trace_id is not None:
+                    self._exemplars[i] = (v, str(trace_id), time.time())
                 return
         self._counts[-1] += 1
+        if trace_id is not None:
+            self._exemplars[len(self.thresholds)] = (
+                v, str(trace_id), time.time()
+            )
+
+    @property
+    def exemplars(self):
+        """``{bucket index: (value, trace_id, unix_ts)}`` — the last
+        traced observation per bucket (the +Inf bucket is index
+        ``len(thresholds)``)."""
+        return dict(self._exemplars)
 
     @property
     def count(self):
@@ -207,6 +229,13 @@ def diagnostics_registry():
     """The process-global internal-health registry (suppressed-error
     counters); readable by tests and stall reports without any engine."""
     return _DIAGNOSTICS
+
+
+def suppressed_errors_snapshot():
+    """Nonzero suppressed-error counters as ``{name: count}`` — what
+    stall reports, supervisor escalations, and flight-recorder dumps
+    attach (empty dict = no swallows so far)."""
+    return {k: v for k, v in _DIAGNOSTICS.snapshot().items() if v}
 
 
 def count_suppressed(site, exc=None):

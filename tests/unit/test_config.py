@@ -578,3 +578,57 @@ def test_serving_rejects(block):
 
     with pytest.raises(DeepSpeedConfigError):
         _srv(block)
+
+
+# ---------------------------------------------------------------------------
+# telemetry.tracing keys (docs/observability.md "Request tracing &
+# flight recorder")
+# ---------------------------------------------------------------------------
+def _trc(block):
+    return make({
+        "train_batch_size": 8,
+        "telemetry": {"enabled": True, "tracing": block},
+    })
+
+
+def test_tracing_defaults_are_off():
+    cfg = make({"train_batch_size": 8})
+    assert cfg.telemetry_tracing_enabled is False
+    assert cfg.telemetry_tracing_sample_rate == 1.0
+    assert cfg.telemetry_tracing_ring_events == 512
+    assert cfg.telemetry_tracing_export == "chrome"
+
+
+def test_tracing_valid_block_parses():
+    cfg = _trc({"enabled": True, "sample_rate": 0.25,
+                "ring_events": 2048, "export": "none"})
+    assert cfg.telemetry_tracing_enabled is True
+    assert cfg.telemetry_tracing_sample_rate == 0.25
+    assert cfg.telemetry_tracing_ring_events == 2048
+    assert cfg.telemetry_tracing_export == "none"
+
+
+def test_tracing_rides_the_telemetry_master_switch():
+    # tracing under a disabled telemetry block is inert, like the watchdog
+    cfg = make({
+        "train_batch_size": 8,
+        "telemetry": {"enabled": False, "tracing": {"enabled": True}},
+    })
+    assert cfg.telemetry_tracing_enabled is False
+
+
+@pytest.mark.parametrize("block", [
+    {"sample_rate": -0.1},
+    {"sample_rate": 1.5},
+    {"sample_rate": "half"},
+    {"sample_rate": True},
+    {"ring_events": 0},
+    {"ring_events": -5},
+    {"ring_events": 1.5},
+    {"ring_events": True},
+    {"export": "jaeger"},
+    {"sample_rat": 0.5},   # a typo'd key must not mean "sample everything"
+])
+def test_tracing_rejects(block):
+    with pytest.raises(DeepSpeedConfigError):
+        _trc(block)

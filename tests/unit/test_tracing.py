@@ -1,0 +1,597 @@
+"""Distributed request tracing + flight recorder (telemetry/tracing.py).
+
+Covers the ISSUE-9 acceptance surface: span/context mechanics, ring-buffer
+overwrite order, sampling at 0.0/1.0, the zero-overhead-when-disabled pin,
+RPC trace propagation through the worker protocol, subprocess replica
+span adoption, scheduler phase spans with globally-unique request ids,
+flight dumps on decode-driver crashes, histogram exemplars, and a real
+in-process fleet request reconstructing end-to-end from one trace file.
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.inference.scheduler import (  # noqa: E402
+    ContinuousBatchingScheduler,
+    RequestRejected,
+)
+from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel  # noqa: E402
+from deepspeed_tpu.serving.replica import SubprocessReplica  # noqa: E402
+from deepspeed_tpu.serving.worker import WorkerServer  # noqa: E402
+from deepspeed_tpu.telemetry.exporters import (  # noqa: E402
+    PrometheusTextfileExporter,
+)
+from deepspeed_tpu.telemetry.manager import Telemetry  # noqa: E402
+from deepspeed_tpu.telemetry.registry import (  # noqa: E402
+    Histogram,
+    MetricsRegistry,
+)
+from deepspeed_tpu.telemetry.tracing import (  # noqa: E402
+    NOOP_TRACER,
+    NoopTracer,
+    SpanTracer,
+    TraceContext,
+    build_tracer,
+    load_chrome_trace,
+)
+
+
+# ---------------------------------------------------------------------------
+# core span mechanics
+# ---------------------------------------------------------------------------
+def test_record_parents_under_context():
+    t = SpanTracer(ring_events=16)
+    root = t.child_of(None)
+    child = t.record("child", 1.0, 2.0, ctx=root)
+    assert child["trace_id"] == root.trace_id
+    assert child["parent_id"] == root.span_id
+    assert child["dur_ms"] == pytest.approx(1000.0)
+    # explicit span_id override: how a pre-allocated container span
+    # closes retroactively
+    closed = t.record(
+        "root", 0.5, 3.0,
+        ctx=TraceContext(root.trace_id, None, root.sampled),
+        span_id=root.span_id,
+    )
+    assert closed["span_id"] == root.span_id
+    assert closed["parent_id"] is None
+    assert closed["trace_id"] == child["trace_id"]
+
+
+def test_span_context_manager_records_block():
+    t = SpanTracer(ring_events=16)
+    with t.span("blk", attrs={"a": 1}) as h:
+        h.set_attr("b", 2)
+    (span,) = t.flight_snapshot()
+    assert span["name"] == "blk"
+    assert span["attrs"] == {"a": 1, "b": 2}
+
+
+def test_wire_roundtrip():
+    ctx = TraceContext("t" * 16, "s" * 16, sampled=False)
+    wire = ctx.to_wire()
+    json.dumps(wire)  # must be RPC-safe
+    back = TraceContext.from_wire(wire)
+    assert (back.trace_id, back.span_id, back.sampled) == (
+        ctx.trace_id, ctx.span_id, False,
+    )
+    assert TraceContext.from_wire(None) is None
+    assert TraceContext.from_wire(ctx) is ctx
+    assert TraceContext.from_wire({"junk": 1}) is None
+
+
+def test_ring_overwrite_order():
+    t = SpanTracer(ring_events=4, sample_rate=0.0)
+    for i in range(10):
+        t.record(f"s{i}", 0.0, 1.0)
+    names = [s["name"] for s in t.flight_snapshot()]
+    assert names == ["s6", "s7", "s8", "s9"]  # oldest evicted, order kept
+
+
+def test_sampling_zero_keeps_ring_but_exports_nothing(tmp_path):
+    path = str(tmp_path / "trace.json")
+    t = SpanTracer(sample_rate=0.0, ring_events=32, export_path=path)
+    for i in range(5):
+        t.record(f"s{i}", 0.0, 1.0)
+    t.close()
+    # the always-on flight recorder saw everything...
+    assert len(t.flight_snapshot()) == 5
+    # ...but nothing was sampled for export: no trace file at all
+    assert not os.path.exists(path)
+
+
+def test_sampling_one_exports_everything(tmp_path):
+    path = str(tmp_path / "trace.json")
+    t = SpanTracer(sample_rate=1.0, ring_events=32, export_path=path)
+    for i in range(5):
+        t.record(f"s{i}", float(i), float(i) + 1.0)
+    t.close()
+    events = load_chrome_trace(path)
+    assert [e["name"] for e in events] == [f"s{i}" for i in range(5)]
+    # Perfetto-loadable complete events with the ids in args
+    assert all(e["ph"] == "X" and e["args"]["trace_id"] for e in events)
+
+
+def test_flight_dump_writes_complete_chrome_trace(tmp_path):
+    t = SpanTracer(ring_events=8, dump_dir=str(tmp_path))
+    ctx = t.child_of(None)
+    t.record("a", 0.0, 1.0, ctx=ctx)
+    t.event("boom", attrs={"reason": "test"}, ctx=ctx)
+    path = t.dump_flight("unit_test", extra={"k": "v"})
+    payload = json.load(open(path))
+    names = [e["name"] for e in payload["traceEvents"]]
+    assert names == ["a", "boom"]
+    assert payload["metadata"]["reason"] == "unit_test"
+    assert payload["metadata"]["k"] == "v"
+    assert "suppressed_errors" in payload["metadata"]
+    # a second dump gets its own file
+    assert t.dump_flight("unit_test") != path
+
+
+def test_ingest_adopts_foreign_pids_only():
+    t = SpanTracer(ring_events=8)
+    mine = t.record("local", 0.0, 1.0)
+    foreign = dict(mine, pid=mine["pid"] + 1, name="remote")
+    assert t.ingest([mine, foreign, "junk", None]) == 1
+    names = [s["name"] for s in t.flight_snapshot()]
+    assert names == ["local", "remote"]
+
+
+# ---------------------------------------------------------------------------
+# the zero-overhead-when-disabled pin
+# ---------------------------------------------------------------------------
+def test_noop_tracer_is_zero_overhead_passthrough():
+    assert NOOP_TRACER.enabled is False
+    # one shared allocation-free context manager, pinned by identity
+    cm = NOOP_TRACER.span("anything")
+    assert cm is NOOP_TRACER.span("something else")
+    with cm as h:
+        h.set_attr("ignored", 1)
+    assert NOOP_TRACER.record("x", 0.0, 1.0) is None
+    assert NOOP_TRACER.child_of(None) is None
+    assert NOOP_TRACER.dump_flight("nope") is None
+    assert NOOP_TRACER.flight_snapshot() == []
+
+
+def test_disabled_config_builds_the_noop_singleton(tmp_path):
+    cfg = deepspeed_tpu.DeepSpeedConfig(
+        None, param_dict={"train_batch_size": 1}, world_size=1
+    )
+    assert build_tracer(cfg) is NOOP_TRACER
+    # a disabled Telemetry facade carries the same singleton
+    assert Telemetry(enabled=False).tracer is NOOP_TRACER
+
+
+def test_build_tracer_from_armed_config(tmp_path):
+    cfg = deepspeed_tpu.DeepSpeedConfig(
+        None,
+        param_dict={
+            "train_batch_size": 1,
+            "telemetry": {
+                "enabled": True,
+                "output_path": str(tmp_path),
+                "tracing": {"enabled": True, "sample_rate": 0.5,
+                            "ring_events": 99},
+            },
+        },
+        world_size=1,
+    )
+    t = build_tracer(cfg)
+    assert isinstance(t, SpanTracer)
+    assert t.sample_rate == 0.5 and t.ring_events == 99
+    assert t.export_path.endswith("trace.json")
+    t.close()
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars: the metric -> trace link
+# ---------------------------------------------------------------------------
+def test_histogram_exemplars_record_per_bucket():
+    h = Histogram("x/lat", buckets=(10.0, 100.0))
+    h.observe(5.0)  # untraced: no exemplar
+    h.observe(50.0, trace_id="abc")
+    h.observe(500.0, trace_id="inf-bucket")
+    assert 0 not in h.exemplars
+    assert h.exemplars[1][:2] == (50.0, "abc")
+    assert h.exemplars[2][:2] == (500.0, "inf-bucket")
+
+
+def test_prometheus_exporter_emits_exemplar_comment_lines(tmp_path):
+    reg = MetricsRegistry()
+    h = reg.histogram("infer/ttft_ms", buckets=(10.0, 100.0))
+    h.observe(50.0, trace_id="deadbeef")
+    path = str(tmp_path / "m.prom")
+    PrometheusTextfileExporter(path).export(reg.collect(), step=1)
+    text = open(path).read()
+    assert (
+        '# EXEMPLAR infer_ttft_ms_bucket{le="100.0"} '
+        '{trace_id="deadbeef"} 50.0'
+    ) in text
+    # every SAMPLE line stays valid classic 0.0.4 text format: the
+    # trace link rides full-line comments only (a trailing-token tail
+    # would make the node-exporter textfile collector reject the file)
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert len(line.rsplit(" ", 1)) == 2, line
+    assert 'infer_ttft_ms_bucket{le="10.0"} 0\n' in text
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: phase spans, unique request ids, crash dump
+# ---------------------------------------------------------------------------
+class _StubEngine:
+    """The minimal engine surface the scheduler drives."""
+
+    prefill_len = 16
+
+    def __init__(self, crash_on_decode=False):
+        self.crash_on_decode = crash_on_decode
+
+    def prefill_request(self, slot, prompt_tokens, temperature):
+        return 100 + slot
+
+    def prefill_trace_attrs(self, slot):
+        return {"prefix_hit": False, "prompt_tokens": 3}
+
+    def decode_tokens(self, active):
+        if self.crash_on_decode:
+            raise RuntimeError("injected decode crash")
+        return [7 for _ in active]
+
+
+def _scheduler(engine, tracer=None, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("queue_depth", 8)
+    kw.setdefault("queue_timeout", 0.1)
+    kw.setdefault("eos_token_id", None)
+    kw.setdefault("temperature", 0.0)
+    return ContinuousBatchingScheduler(
+        engine, registry=MetricsRegistry(), tracer=tracer, **kw
+    )
+
+
+def test_scheduler_phase_spans_and_exemplars():
+    tracer = SpanTracer(ring_events=64)
+    sched = _scheduler(_StubEngine(), tracer=tracer)
+    sched.set_id_prefix("r7")
+    req = sched.submit([1, 2, 3], max_new_tokens=2)
+    assert req.request_id.startswith("rr7-")
+    sched.run_until_idle()
+    assert req.result(1.0)
+    names = {s["name"] for s in req.trace_spans}
+    assert {"sched.queue", "sched.prefill", "sched.request"} <= names
+    by_name = {s["name"]: s for s in req.trace_spans}
+    # one connected trace: phases parent to the request's container span
+    assert by_name["sched.queue"]["parent_id"] == req.trace_ctx.span_id
+    assert by_name["sched.prefill"]["parent_id"] == req.trace_ctx.span_id
+    assert by_name["sched.request"]["span_id"] == req.trace_ctx.span_id
+    assert len({s["trace_id"] for s in req.trace_spans}) == 1
+    assert by_name["sched.prefill"]["attrs"]["prefix_hit"] is False
+    assert by_name["sched.request"]["attrs"]["request_id"] == req.request_id
+    assert by_name["sched.request"]["attrs"]["finish_reason"] == (
+        "max_new_tokens"
+    )
+    # decode-step batch spans landed in the ring under the driver trace
+    ring_names = [s["name"] for s in tracer.flight_snapshot()]
+    assert "sched.decode_step" in ring_names
+    # TTFT exemplar links the histogram bucket to this trace
+    ttft = sched._registry.histogram("infer/ttft_ms")
+    assert any(
+        e[1] == req.trace_ctx.trace_id for e in ttft.exemplars.values()
+    )
+
+
+def test_scheduler_joins_caller_trace_context():
+    tracer = SpanTracer(ring_events=64)
+    sched = _scheduler(_StubEngine(), tracer=tracer)
+    parent = tracer.child_of(None)
+    req = sched.submit(
+        [1, 2, 3], max_new_tokens=1, trace_ctx=parent.to_wire()
+    )
+    sched.run_until_idle()
+    req.result(1.0)
+    assert req.trace_ctx.trace_id == parent.trace_id
+    by_name = {s["name"]: s for s in req.trace_spans}
+    # the request's container span parents to the caller's span
+    assert by_name["sched.request"]["parent_id"] == parent.span_id
+
+
+def test_scheduler_disabled_tracing_is_inert():
+    sched = _scheduler(_StubEngine())  # no tracer -> NOOP passthrough
+    assert isinstance(sched._tracer, NoopTracer)
+    req = sched.submit([1, 2, 3], max_new_tokens=1)
+    sched.run_until_idle()
+    req.result(1.0)
+    assert req.trace_ctx is None
+    assert req.trace_spans == []
+
+
+def test_request_ids_globally_unique_across_instances():
+    a = _scheduler(_StubEngine())
+    b = _scheduler(_StubEngine())  # same replica id, e.g. post-restart
+    a.set_id_prefix("0")
+    b.set_id_prefix("0")
+    ids = set()
+    for sched in (a, b):
+        for _ in range(3):
+            ids.add(sched.submit([1], max_new_tokens=1).request_id)
+        sched.run_until_idle()
+    assert len(ids) == 6  # the per-instance token keeps restarts distinct
+    assert all(i.startswith("r0-") for i in ids)
+
+
+def test_decode_crash_dumps_flight_recorder(tmp_path):
+    tracer = SpanTracer(ring_events=64, dump_dir=str(tmp_path))
+    sched = _scheduler(
+        _StubEngine(crash_on_decode=True), tracer=tracer,
+        driver_restart_budget=0,
+    )
+    sched.submit([1, 2, 3], max_new_tokens=4)
+    with pytest.raises(RuntimeError):
+        sched.run_until_idle()
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("flight-")]
+    assert len(dumps) == 1
+    payload = json.load(open(tmp_path / dumps[0]))
+    assert payload["metadata"]["reason"] == "decode_driver_crash"
+    # the ring carried the request's phase spans into the dump
+    assert any(
+        e["name"] == "sched.prefill" for e in payload["traceEvents"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# worker RPC propagation (in-process protocol, no spawn)
+# ---------------------------------------------------------------------------
+class _ChanIn:
+    def __init__(self):
+        self._q = queue.Queue()
+
+    def send(self, line):
+        self._q.put(line + "\n")
+
+    def __iter__(self):
+        while True:
+            line = self._q.get()
+            if line is None:
+                return
+            yield line
+
+
+class _ChanOut:
+    def __init__(self):
+        self.lines = []
+        self._cond = threading.Condition()
+
+    def write(self, text):
+        with self._cond:
+            self.lines.append(text.strip())
+            self._cond.notify_all()
+
+    def flush(self):
+        pass
+
+    def wait_for(self, predicate, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                for raw in self.lines:
+                    msg = json.loads(raw)
+                    if predicate(msg):
+                        return msg
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"no matching line in {self.lines}")
+                self._cond.wait(remaining)
+
+
+class _TracedHandle:
+    def __init__(self, spans):
+        self.tokens = [1, 2]
+        self.finish_reason = "max_new_tokens"
+        self.first_token_at = time.monotonic()
+        self.done = True
+        self.trace_spans = spans
+
+
+class _TracedWorkerEngine:
+    """Records the kwargs the worker hands to submit (the trace_ctx wire
+    dict must survive the RPC) and hands back pre-traced requests."""
+
+    def __init__(self):
+        self.scheduler = self
+        self.submit_kwargs = None
+        self.replica_prefix = None
+
+    def serve_forever(self):
+        pass
+
+    def set_id_prefix(self, replica_id):
+        self.replica_prefix = replica_id
+
+    def drain(self):
+        pass
+
+    def close(self):
+        pass
+
+    def submit(self, prompt, max_new_tokens=32, **kwargs):
+        self.submit_kwargs = dict(kwargs)
+        ctx = kwargs.get("trace_ctx") or {}
+        spans = [{
+            "name": "sched.request", "trace_id": ctx.get("trace_id"),
+            "span_id": "w" * 16, "parent_id": ctx.get("span_id"),
+            "ts": time.time(), "dur_ms": 1.0, "pid": os.getpid() + 1,
+            "tid": 0, "attrs": {}, "sampled": True,
+        }]
+        return _TracedHandle(spans)
+
+
+def test_worker_rpc_carries_trace_context_and_returns_spans():
+    stdin, stdout = _ChanIn(), _ChanOut()
+    engine = _TracedWorkerEngine()
+    server = WorkerServer(stdin, stdout, lambda spec: engine,
+                          poll_interval=0.001)
+    thread = threading.Thread(target=server.run, daemon=True)
+    thread.start()
+    stdin.send(json.dumps({
+        "op": "init", "spec": {"replica_id": "3"},
+    }))
+    stdout.wait_for(lambda m: m.get("event") == "ready")
+    # the init spec's replica id reached the scheduler's id prefix
+    assert engine.replica_prefix == "3"
+    wire = {"trace_id": "t" * 16, "span_id": "p" * 16, "sampled": True}
+    stdin.send(json.dumps({
+        "op": "submit", "id": 1, "prompt": [5, 6],
+        "max_new_tokens": 2, "kwargs": {"trace_ctx": wire},
+    }))
+    stdout.wait_for(
+        lambda m: m.get("event") == "reply" and m.get("id") == 1
+    )
+    # the wire dict crossed the protocol untouched
+    assert engine.submit_kwargs["trace_ctx"] == wire
+    fin = stdout.wait_for(
+        lambda m: m.get("event") == "finished" and m.get("id") == 1
+    )
+    # ...and the worker shipped its spans home with the answer,
+    # parented to the router's wire context
+    assert fin["spans"][0]["trace_id"] == wire["trace_id"]
+    assert fin["spans"][0]["parent_id"] == wire["span_id"]
+    stdin.send(json.dumps({"op": "shutdown"}))
+    thread.join(5.0)
+    assert not thread.is_alive()
+
+
+def test_subprocess_replica_adopts_finished_spans():
+    replica = SubprocessReplica("0", {})
+    from deepspeed_tpu.serving.replica import RemoteRequest
+
+    req = RemoteRequest(1, [1, 2], 4)
+    replica._outstanding[1] = req
+    spans = [{"name": "sched.request", "pid": os.getpid() + 1,
+              "sampled": True}]
+    replica._dispatch({
+        "event": "finished", "id": 1, "tokens": [9],
+        "reason": "max_new_tokens", "spans": spans,
+    })
+    assert req.done and req.trace_spans == spans
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: one fleet request -> one connected trace in one file
+# ---------------------------------------------------------------------------
+VOCAB = 96
+
+
+def _small_engine_factory():
+    cfg = GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False,
+    )
+    model = GPT2LMHeadModel(cfg)
+    ids0 = jnp.asarray(
+        np.random.default_rng(0).integers(0, VOCAB, (1, 8)), jnp.int32
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        ids0, ids0,
+    )["params"]
+
+    def build():
+        return deepspeed_tpu.init_inference(
+            model=model, model_parameters=params,
+            config={"inference": {
+                "max_batch_slots": 2, "max_seq_len": 48,
+                "prefill_len": 16, "sampling": {"greedy": True},
+            }},
+        )
+
+    return build
+
+
+def test_fleet_request_trace_connects_end_to_end(tmp_path):
+    router = deepspeed_tpu.init_fleet(
+        engine_factory=_small_engine_factory(),
+        config={
+            "serving": {"replicas": 1, "placement": "least_loaded"},
+            "telemetry": {
+                "enabled": True,
+                "output_path": str(tmp_path),
+                "job_name": "trace_e2e",
+                "watchdog": {"enabled": False},
+                "tracing": {"enabled": True, "sample_rate": 1.0},
+            },
+        },
+    )
+    try:
+        fr = router.submit([3, 1, 4, 1, 5], max_new_tokens=4)
+        assert len(fr.result(30.0)) == 4
+        deadline = time.monotonic() + 5.0
+        while router.outstanding_count and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        router.shutdown()
+    events = load_chrome_trace(
+        str(tmp_path / "trace_e2e" / "trace.json")
+    )
+    spans = {e["name"]: e["args"] for e in events}
+    required = {"fleet.request", "router.admission", "router.place",
+                "sched.request", "sched.queue", "sched.prefill"}
+    assert required <= set(spans), sorted(spans)
+    # ONE trace id end to end, router door to finish-reason
+    tids = {e["args"]["trace_id"] for e in events
+            if e["name"] in required}
+    assert len(tids) == 1
+    root = spans["fleet.request"]
+    assert root["parent_id"] is None
+    assert root["finish_reason"] == "max_new_tokens"
+    # parent links reconstruct the chain: admission/place under the
+    # root, scheduler phases under the replica's request span
+    assert spans["router.admission"]["parent_id"] == root["span_id"]
+    assert spans["router.place"]["parent_id"] == root["span_id"]
+    assert spans["sched.request"]["parent_id"] == root["span_id"]
+    assert spans["sched.queue"]["parent_id"] == (
+        spans["sched.request"]["span_id"]
+    )
+    assert spans["sched.prefill"]["parent_id"] == (
+        spans["sched.request"]["span_id"]
+    )
+    # replica-prefixed request id rides the trace as the root attr
+    assert str(spans["sched.request"]["request_id"]).startswith("r0-")
+
+
+def test_fleet_tracing_disabled_writes_no_trace_files(tmp_path):
+    router = deepspeed_tpu.init_fleet(
+        engine_factory=_small_engine_factory(),
+        config={
+            "serving": {"replicas": 1},
+            "telemetry": {
+                "enabled": True,
+                "output_path": str(tmp_path),
+                "job_name": "untraced",
+                "watchdog": {"enabled": False},
+            },
+        },
+    )
+    try:
+        assert router.tracer is NOOP_TRACER
+        fr = router.submit([3, 1, 4], max_new_tokens=2)
+        assert len(fr.result(30.0)) == 2
+    finally:
+        router.shutdown()
+    leftovers = [
+        f for f in os.listdir(tmp_path / "untraced")
+        if "trace" in f or f.startswith("flight-")
+    ]
+    assert leftovers == []
