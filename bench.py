@@ -1519,6 +1519,211 @@ def smoke_chaos():
     }))
 
 
+def smoke_chaos_fleet():
+    """CI fast path (``python bench.py --smoke-chaos-fleet``): the
+    serving-tier chaos harness end to end (docs/serving.md) — a fleet
+    survives a seeded fault schedule with zero lost or duplicated
+    requests, bitwise greedy parity for the survivors, and bounded
+    recovery time. Three windows:
+
+      A. RPC corruption absorbed by the circuit breaker: a 2-replica
+         SUBPROCESS fleet of real GPT-2 workers with one corrupted
+         submit line on replica 0's pipe — the submit falls through to
+         replica 1, the breaker opens, every answer matches a clean
+         single engine bitwise.
+      B. Zombie detection: a worker whose engine wedges (accepts work,
+         never finishes) is detected from frozen completion counters,
+         drained-then-restarted, and its request re-routed.
+      C. Brownout degradation: with the fleet queue in the brownout
+         band, a sheddable request completes with max_new_tokens
+         clamped to the floor (bitwise equal to a clean engine run at
+         the clamped budget) instead of FleetOverloaded.
+
+    Prints one JSON line and exits non-zero on any failed check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+    from deepspeed_tpu.serving import FleetRouter, InProcessReplica, SubprocessReplica
+    from deepspeed_tpu.serving.worker import build_engine_from_spec
+
+    extras = {}
+
+    # ---- window A: RPC corruption vs the circuit breaker --------------
+    model_kw = {
+        "vocab_size": 64, "n_positions": 32, "n_embd": 16, "n_layer": 1,
+        "n_head": 2, "use_flash": False,
+    }
+    engine_block = {
+        "max_batch_slots": 2, "max_seq_len": 24, "prefill_len": 8,
+        "sampling": {"greedy": True},
+    }
+    spec = {"model": model_kw, "init_seed": 0,
+            "config": {"inference": engine_block}}
+    rng = np.random.default_rng(7)
+    prompts = [[int(t) for t in rng.integers(0, 64, 6)] for _ in range(4)]
+
+    single = build_engine_from_spec(spec)
+    reference = single.generate(prompts, max_new_tokens=5)
+    single.close()
+
+    # parent-side injector on replica 0 only: sends are init (1), the
+    # start() refresh snapshot (2), then per submit a candidates
+    # snapshot + the submit op — traversal 4 is the FIRST submit line
+    faults = FaultInjector(
+        [FaultSpec("rpc.send", after=3, times=1,
+                   args={"mode": "corrupt"}, seed=0)],
+        seed=0,
+    )
+    replicas = [
+        SubprocessReplica("0", spec, start_timeout=240.0, rpc_timeout=2.0,
+                          fault_injector=faults),
+        SubprocessReplica("1", spec, start_timeout=240.0, rpc_timeout=2.0),
+    ]
+    router = FleetRouter(
+        replicas, monitor_interval=0.01, telemetry_refresh_secs=3600.0,
+        breaker_failure_threshold=1, breaker_backoff_secs=0.5,
+    ).start()
+    try:
+        t0 = time.monotonic()
+        reqs = [router.submit(p, max_new_tokens=5) for p in prompts]
+        outs = [r.result(120.0) for r in reqs]
+        recovery_a = time.monotonic() - t0
+        assert outs == reference, "divergence under RPC corruption"
+        assert all(r.finish_reason == "max_new_tokens" for r in reqs)
+        assert faults.injected.get("rpc.send") == 1, faults.injected
+        snap = router.metrics.snapshot()
+        assert snap["fleet/breaker_opens"] >= 1, snap
+        assert snap["fleet/requests_completed"] == 4, snap
+        assert recovery_a < 60.0, f"recovery took {recovery_a:.1f}s"
+        extras["rpc_corruptions_absorbed"] = 1
+        extras["breaker_opens"] = int(snap["fleet/breaker_opens"])
+        extras["window_a_secs"] = round(recovery_a, 2)
+    finally:
+        router.shutdown()
+
+    # ---- window B: zombie detection + restart -------------------------
+    stub_spec = {"stub": {"hang": True}}
+    ok_spec = {"stub": {}}
+    replicas = [
+        SubprocessReplica("0", stub_spec, start_timeout=240.0,
+                          rpc_timeout=2.0),
+        SubprocessReplica("1", ok_spec, start_timeout=240.0,
+                          rpc_timeout=2.0),
+    ]
+    router = FleetRouter(
+        replicas, monitor_interval=0.02, zombie_secs=0.5,
+        zombie_restart_budget=2, placement="round_robin",
+    ).start()
+    try:
+        t0 = time.monotonic()
+        req = router.submit([9], max_new_tokens=3)
+        assert req.replica_id == "0"  # round-robin: the wedged replica
+        out = req.result(120.0)
+        recovery_b = time.monotonic() - t0
+        assert out == [10, 11, 12], out  # the stub's deterministic answer
+        assert req.reroutes == 1
+        snap = router.metrics.snapshot()
+        assert snap["fleet/zombie_restarts"] == 1, snap
+        assert router.evicted_ids == set()  # restart sufficed
+        assert recovery_b < 60.0, f"zombie recovery took {recovery_b:.1f}s"
+        extras["zombie_restarts"] = 1
+        extras["window_b_secs"] = round(recovery_b, 2)
+    finally:
+        router.shutdown()
+
+    # ---- window C: brownout degradation -------------------------------
+    cfg = GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False,
+    )
+    model = GPT2LMHeadModel(cfg)
+    ids0 = jnp.asarray(rng.integers(0, 128, (1, 8)), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids0, ids0,
+    )["params"]
+
+    def engine_factory():
+        # queue_depth 8 keeps the 3-filler burst under the REPLICA's own
+        # degraded gate (0.75) while sitting inside the FLEET's brownout
+        # band (0.2): the degradation asserted is the router's, not the
+        # engine's priority shedding
+        return deepspeed_tpu.init_inference(
+            model=model, model_parameters=params,
+            config={"inference": {
+                "max_batch_slots": 1, "max_seq_len": 64, "prefill_len": 16,
+                "queue_depth": 8, "sampling": {"greedy": True},
+            }},
+        )
+
+    probe_prompt = [int(t) for t in rng.integers(0, 128, 7)]
+    single = engine_factory()
+    clamped_reference = single.generate([probe_prompt], max_new_tokens=4)[0]
+    single.close()
+
+    router = FleetRouter(
+        [InProcessReplica("0", engine_factory)], monitor_interval=0.01,
+        shed_queue_ratio=0.9, brownout_queue_ratio=0.2,
+        brownout_max_new_tokens=4,
+    ).start()
+    try:
+        from deepspeed_tpu.inference import RequestRejected
+
+        browned = router.metrics.counter("fleet/requests_browned_out")
+        probe = None
+        for _attempt in range(5):
+            # fill the single slot + queue so the fill ratio sits in the
+            # brownout band when the sheddable probe arrives
+            fillers = [
+                router.submit([int(t) for t in rng.integers(0, 128, 5)],
+                              max_new_tokens=40)
+                for _ in range(3)
+            ]
+            try:
+                probe = router.submit(probe_prompt, priority=1,
+                                      max_new_tokens=40)
+            except RequestRejected:
+                probe = None  # raced a full/degraded replica: retry
+            for f in fillers:
+                assert f.result(120.0), "filler request lost"
+            if probe is not None and browned.value > 0:
+                break
+            if probe is not None:
+                probe.result(120.0)  # raced an empty queue: drain, retry
+                probe = None
+        assert probe is not None and browned.value >= 1, (
+            "brownout window never engaged"
+        )
+        out = probe.result(120.0)
+        assert out == clamped_reference, "clamped probe diverged"
+        assert len(out) == 4, out  # the floor, not the requested 40
+        deadline = time.monotonic() + 30.0
+        while router.brownout and time.monotonic() < deadline:
+            router.refresh_telemetry()  # queue drained: the window exits
+            time.sleep(0.05)
+        assert not router.brownout, "brownout failed to exit"
+        snap = router.metrics.snapshot()
+        assert snap["fleet/brownout"] == 0.0, snap
+        extras["brownout_windows"] = 1
+        extras["browned_out_requests"] = int(browned.value)
+    finally:
+        router.shutdown()
+
+    print(json.dumps({
+        "metric": "smoke_chaos_fleet",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": extras,
+    }))
+
+
 def smoke_lora():
     """CI fast path (``python bench.py --smoke-lora``): the multi-tenant
     LoRA vertical slice end to end on CPU (docs/adapters.md) — a tiny
@@ -1868,6 +2073,9 @@ def main():
         return
     if "--smoke-trace" in sys.argv:
         smoke_trace()
+        return
+    if "--smoke-chaos-fleet" in sys.argv:
+        smoke_chaos_fleet()
         return
     if "--smoke-chaos" in sys.argv:
         smoke_chaos()

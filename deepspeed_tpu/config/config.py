@@ -598,6 +598,46 @@ class DeepSpeedConfig:
             dict(per_tenant) if isinstance(per_tenant, dict)
             else {} if per_tenant is None else per_tenant
         )
+        self.serving_rpc_timeout_secs = get_scalar_param(
+            srv_dict, C.SERVING_RPC_TIMEOUT_SECS,
+            C.SERVING_RPC_TIMEOUT_SECS_DEFAULT,
+        )
+        self.serving_rpc_retries = get_scalar_param(
+            srv_dict, C.SERVING_RPC_RETRIES, C.SERVING_RPC_RETRIES_DEFAULT
+        )
+        self.serving_rpc_backoff_secs = get_scalar_param(
+            srv_dict, C.SERVING_RPC_BACKOFF_SECS,
+            C.SERVING_RPC_BACKOFF_SECS_DEFAULT,
+        )
+        self.serving_zombie_secs = get_scalar_param(
+            srv_dict, C.SERVING_ZOMBIE_SECS, C.SERVING_ZOMBIE_SECS_DEFAULT
+        )
+        self.serving_zombie_restart_budget = get_scalar_param(
+            srv_dict, C.SERVING_ZOMBIE_RESTART_BUDGET,
+            C.SERVING_ZOMBIE_RESTART_BUDGET_DEFAULT,
+        )
+        cb_dict = get_dict_param(srv_dict, C.SERVING_CIRCUIT_BREAKER)
+        self.serving_cb_failure_threshold = get_scalar_param(
+            cb_dict, C.SERVING_CB_FAILURE_THRESHOLD,
+            C.SERVING_CB_FAILURE_THRESHOLD_DEFAULT,
+        )
+        self.serving_cb_backoff_secs = get_scalar_param(
+            cb_dict, C.SERVING_CB_BACKOFF_SECS,
+            C.SERVING_CB_BACKOFF_SECS_DEFAULT,
+        )
+        self.serving_cb_backoff_max_secs = get_scalar_param(
+            cb_dict, C.SERVING_CB_BACKOFF_MAX_SECS,
+            C.SERVING_CB_BACKOFF_MAX_SECS_DEFAULT,
+        )
+        bo_dict = get_dict_param(srv_dict, C.SERVING_BROWNOUT)
+        self.serving_brownout_queue_ratio = get_scalar_param(
+            bo_dict, C.SERVING_BROWNOUT_QUEUE_RATIO,
+            C.SERVING_BROWNOUT_QUEUE_RATIO_DEFAULT,
+        )
+        self.serving_brownout_max_new_tokens = get_scalar_param(
+            bo_dict, C.SERVING_BROWNOUT_MAX_NEW_TOKENS,
+            C.SERVING_BROWNOUT_MAX_NEW_TOKENS_DEFAULT,
+        )
 
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
@@ -991,6 +1031,16 @@ class DeepSpeedConfig:
                 raise DeepSpeedConfigError(
                     f"{where}.args must be an object, got {args!r}"
                 )
+            if site in ("rpc.send", "rpc.recv"):
+                from ..resilience.faults import RPC_FAULT_MODES
+
+                mode = args.get("mode", "drop")
+                if mode not in RPC_FAULT_MODES:
+                    # a typo'd mode must not silently mean "drop"
+                    raise DeepSpeedConfigError(
+                        f"{where}.args.mode must be one of "
+                        f"{list(RPC_FAULT_MODES)}, got {mode!r}"
+                    )
 
     def _check_supervisor(self):
         """Validate the supervisor sub-block: a negative retry budget or a
@@ -1481,6 +1531,135 @@ class DeepSpeedConfig:
                     f"{where}.{C.SERVING_RATE_LIMIT_BURST} must be an "
                     f"integer >= 1, got {burst!r}"
                 )
+        for key, value in (
+            (C.SERVING_RPC_TIMEOUT_SECS, self.serving_rpc_timeout_secs),
+            (C.SERVING_RPC_BACKOFF_SECS, self.serving_rpc_backoff_secs),
+        ):
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or value <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{C.SERVING}.{key} must be a number > 0, got {value!r}"
+                )
+        retries = self.serving_rpc_retries
+        if not isinstance(retries, int) or isinstance(retries, bool) or (
+            retries < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_RPC_RETRIES} must be an integer "
+                f">= 0 (0 = no retries), got {retries!r}"
+            )
+        zombie = self.serving_zombie_secs
+        if (
+            not isinstance(zombie, (int, float))
+            or isinstance(zombie, bool)
+            or zombie < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_ZOMBIE_SECS} must be a number "
+                f">= 0 (0 disables zombie detection), got {zombie!r}"
+            )
+        zbudget = self.serving_zombie_restart_budget
+        if not isinstance(zbudget, int) or isinstance(zbudget, bool) or (
+            zbudget < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{C.SERVING}.{C.SERVING_ZOMBIE_RESTART_BUDGET} must be "
+                f"an integer >= 0 (0 = evict on first zombie detection), "
+                f"got {zbudget!r}"
+            )
+        cb = f"{C.SERVING}.{C.SERVING_CIRCUIT_BREAKER}"
+        cb_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING),
+            C.SERVING_CIRCUIT_BREAKER,
+        )
+        unknown = set(cb_dict) - {
+            C.SERVING_CB_FAILURE_THRESHOLD, C.SERVING_CB_BACKOFF_SECS,
+            C.SERVING_CB_BACKOFF_MAX_SECS,
+        }
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"{cb}: unknown keys {sorted(unknown)}; valid: "
+                f"['{C.SERVING_CB_BACKOFF_MAX_SECS}', "
+                f"'{C.SERVING_CB_BACKOFF_SECS}', "
+                f"'{C.SERVING_CB_FAILURE_THRESHOLD}']"
+            )
+        threshold = self.serving_cb_failure_threshold
+        if not isinstance(threshold, int) or isinstance(threshold, bool) or (
+            threshold < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{cb}.{C.SERVING_CB_FAILURE_THRESHOLD} must be an "
+                f"integer >= 1, got {threshold!r}"
+            )
+        for key, value in (
+            (C.SERVING_CB_BACKOFF_SECS, self.serving_cb_backoff_secs),
+            (C.SERVING_CB_BACKOFF_MAX_SECS,
+             self.serving_cb_backoff_max_secs),
+        ):
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or value <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{cb}.{key} must be a number > 0, got {value!r}"
+                )
+        if self.serving_cb_backoff_max_secs < self.serving_cb_backoff_secs:
+            raise DeepSpeedConfigError(
+                f"{cb}.{C.SERVING_CB_BACKOFF_MAX_SECS} "
+                f"({self.serving_cb_backoff_max_secs!r}) must be >= "
+                f"{C.SERVING_CB_BACKOFF_SECS} "
+                f"({self.serving_cb_backoff_secs!r})"
+            )
+        bo = f"{C.SERVING}.{C.SERVING_BROWNOUT}"
+        bo_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING), C.SERVING_BROWNOUT
+        )
+        unknown = set(bo_dict) - {
+            C.SERVING_BROWNOUT_QUEUE_RATIO,
+            C.SERVING_BROWNOUT_MAX_NEW_TOKENS,
+        }
+        if unknown:
+            # a typo'd queue_ratio would silently mean "brownout off"
+            raise DeepSpeedConfigError(
+                f"{bo}: unknown keys {sorted(unknown)}; valid: "
+                f"['{C.SERVING_BROWNOUT_MAX_NEW_TOKENS}', "
+                f"'{C.SERVING_BROWNOUT_QUEUE_RATIO}']"
+            )
+        ratio = self.serving_brownout_queue_ratio
+        if ratio is not None:
+            if (
+                not isinstance(ratio, (int, float))
+                or isinstance(ratio, bool)
+                or not 0 < ratio < 1
+            ):
+                raise DeepSpeedConfigError(
+                    f"{bo}.{C.SERVING_BROWNOUT_QUEUE_RATIO} must be a "
+                    f"number in (0, 1) or null (null = brownout off), "
+                    f"got {ratio!r}"
+                )
+            if ratio >= self.serving_shed_queue_ratio:
+                # the brownout band sits BELOW the shed cliff; an
+                # inverted pair would be a brownout that can never engage
+                # before rejection does
+                raise DeepSpeedConfigError(
+                    f"{bo}.{C.SERVING_BROWNOUT_QUEUE_RATIO} ({ratio!r}) "
+                    f"must be below {C.SERVING}."
+                    f"{C.SERVING_SHED_QUEUE_RATIO} "
+                    f"({self.serving_shed_queue_ratio!r}) — degradation "
+                    f"engages before the rejection cliff"
+                )
+        floor = self.serving_brownout_max_new_tokens
+        if not isinstance(floor, int) or isinstance(floor, bool) or (
+            floor < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{bo}.{C.SERVING_BROWNOUT_MAX_NEW_TOKENS} must be an "
+                f"integer >= 1, got {floor!r}"
+            )
 
     def _do_warning_check(self):
         if self.zero_enabled and not (self.fp16_enabled or self.bf16_enabled):

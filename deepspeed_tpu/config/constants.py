@@ -648,6 +648,43 @@ SERVING_RATE_LIMIT_BURST = "burst"
 SERVING_RATE_LIMIT_BURST_DEFAULT = 1
 SERVING_RATE_LIMIT_PER_TENANT = "per_tenant"
 SERVING_RATE_LIMIT_PER_TENANT_DEFAULT = None  # None => {} (no overrides)
+# Subprocess-replica RPC transport: per-op timeout, and retry-with-
+# backoff for IDEMPOTENT control ops (snapshot/drain/adapter management
+# — generate submissions never retry; docs/serving.md "RPC retries").
+SERVING_RPC_TIMEOUT_SECS = "rpc_timeout_secs"
+SERVING_RPC_TIMEOUT_SECS_DEFAULT = 10.0
+SERVING_RPC_RETRIES = "rpc_retries"
+SERVING_RPC_RETRIES_DEFAULT = 2
+SERVING_RPC_BACKOFF_SECS = "rpc_backoff_secs"
+SERVING_RPC_BACKOFF_SECS_DEFAULT = 0.05
+# Zombie detection (docs/serving.md): a replica with work in flight but
+# frozen completion counters (or a live-but-unresponsive worker) for
+# zombie_secs is drained-then-restarted, zombie_restart_budget times;
+# 0 disables the sweep.
+SERVING_ZOMBIE_SECS = "zombie_secs"
+SERVING_ZOMBIE_SECS_DEFAULT = 0.0
+SERVING_ZOMBIE_RESTART_BUDGET = "zombie_restart_budget"
+SERVING_ZOMBIE_RESTART_BUDGET_DEFAULT = 2
+# Per-replica circuit breakers (serving/breaker.py): N consecutive RPC
+# failures open the circuit for an exponentially-backed-off window with
+# a single half-open probe.
+SERVING_CIRCUIT_BREAKER = "circuit_breaker"
+SERVING_CB_FAILURE_THRESHOLD = "failure_threshold"
+SERVING_CB_FAILURE_THRESHOLD_DEFAULT = 3
+SERVING_CB_BACKOFF_SECS = "backoff_secs"
+SERVING_CB_BACKOFF_SECS_DEFAULT = 0.5
+SERVING_CB_BACKOFF_MAX_SECS = "backoff_max_secs"
+SERVING_CB_BACKOFF_MAX_SECS_DEFAULT = 30.0
+# Brownout degradation (docs/serving.md): between queue_ratio and the
+# shed ratio the fleet clamps sheddable requests' max_new_tokens to the
+# configured floor (and replicas skip prefix-miss registration work)
+# instead of letting fill climb to the rejection cliff. queue_ratio
+# null = feature off.
+SERVING_BROWNOUT = "brownout"
+SERVING_BROWNOUT_QUEUE_RATIO = "queue_ratio"
+SERVING_BROWNOUT_QUEUE_RATIO_DEFAULT = None
+SERVING_BROWNOUT_MAX_NEW_TOKENS = "max_new_tokens"
+SERVING_BROWNOUT_MAX_NEW_TOKENS_DEFAULT = 16
 
 #############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,

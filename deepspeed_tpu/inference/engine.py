@@ -247,6 +247,9 @@ class InferenceEngine:
         # per-slot span attrs captured at prefill time (prefix-hit vs
         # cold, suffix bucket, adapter) for the scheduler's prefill span
         self._slot_trace_attrs = {}
+        # fleet brownout mode (set_brownout): degraded windows skip the
+        # prefix-miss registration work (docs/serving.md "Brownout")
+        self._brownout = False
 
         # ---- params: verified load, cast, pin -------------------------
         import types
@@ -954,10 +957,14 @@ class InferenceEngine:
                 jnp.full((1,), temperature, jnp.float32),
             )
             first = int(np.asarray(first)[0])
-        if self.paged and self.prefix_cache_enabled:
+        if self.paged and self.prefix_cache_enabled and not self._brownout:
             # publish this prompt's full pages so later requests share
             # them (no-op for pages already in the registry; the hash
-            # chain was computed once at reserve time)
+            # chain was computed once at reserve time). Skipped under
+            # fleet brownout (set_brownout): a prefix MISS's speculative
+            # registration work — hashing, registry churn, pages parked
+            # un-freeable in the LRU — is load the degraded window can't
+            # afford; cache HITS still serve suffix-only.
             self.block_pool.register_prefix(
                 prompt_tokens, self._slot_blocks[slot],
                 hashes=self._slot_hashes.get(slot),
@@ -986,6 +993,13 @@ class InferenceEngine:
         prefill (prefix-hit vs cold, suffix bucket, adapter name) — the
         per-phase facts only the engine knows."""
         return self._slot_trace_attrs.pop(slot, {})
+
+    def set_brownout(self, on):
+        """Fleet brownout toggle (docs/serving.md "Brownout"): while on,
+        cold prefills skip cross-request prefix REGISTRATION (the
+        prefix-miss speculative work) — hits keep serving suffix-only.
+        A pure mode flag: no recompiles, instantly reversible."""
+        self._brownout = bool(on)
 
     def use_tracer(self, tracer):
         """Adopt a caller-owned tracer (the fleet router injects its own

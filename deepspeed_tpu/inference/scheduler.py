@@ -370,6 +370,12 @@ class ContinuousBatchingScheduler:
             ),
             "requests_shed": self._shed.value,
             "restarts_used": self.restarts_used,
+            # completion-progress markers (JSON-safe ints): what the
+            # router's zombie detection watches — active slots whose
+            # completions/tokens stop moving mean a wedged decode path
+            # even when the snapshot RPC itself still answers
+            "requests_completed": int(self._completed.value),
+            "tokens_generated": int(self._tokens_generated.value),
             "driving": self.driving,
             "stopped": self._stop.is_set(),
             "driver_failed": self.driver_failed,

@@ -30,9 +30,11 @@ from .atomic_io import RetryPolicy, with_retries
 from .faults import (
     KNOWN_FAULT_SITES,
     NULL_INJECTOR,
+    RPC_FAULT_MODES,
     FaultInjector,
     FaultSpec,
     build_fault_injector,
+    build_fault_injector_from_dict,
 )
 from .manager import ResilienceManager, build_resilience
 from .manifest import (
@@ -57,12 +59,14 @@ __all__ = [
     "MANIFEST_FILE",
     "NULL_INJECTOR",
     "PreemptionHandler",
+    "RPC_FAULT_MODES",
     "ReplayableDataSource",
     "ResilienceManager",
     "RetryPolicy",
     "SupervisorEscalation",
     "TrainingSupervisor",
     "build_fault_injector",
+    "build_fault_injector_from_dict",
     "build_resilience",
     "build_supervisor",
     "prune_checkpoints",

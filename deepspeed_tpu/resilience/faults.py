@@ -75,6 +75,32 @@ KNOWN_FAULT_SITES = {
         "artificial stall (sleep) at the training step boundary "
         "(args.duration_ms, default 250) — watchdog food"
     ),
+    # -- serving seams (deepspeed_tpu/serving/, docs/serving.md) --------
+    "rpc.send": (
+        "mangles one parent->worker line on the replica's newline-JSON "
+        "pipe (args.mode: drop | corrupt | delay; delay takes "
+        "args.delay_ms) — the submit/snapshot op never arrives intact"
+    ),
+    "rpc.recv": (
+        "mangles one worker->parent line (same args.mode family) — the "
+        "ack/finished event is lost, garbled, or late"
+    ),
+    "replica.hang": (
+        "stalls the worker's op loop (args.duration_ms, default 250) — "
+        "snapshots and submits time out while the process stays alive"
+    ),
+    "replica.flap": (
+        "RuntimeError at replica (re)start — a replica that crashes "
+        "every time the router tries to bring it back (restart loop)"
+    ),
+    "router.place": (
+        "RuntimeError inside the router's placement policy — choose() "
+        "raises with a live candidate set"
+    ),
+    "snapshot.stale": (
+        "load_snapshot returns the previous call's frozen values — the "
+        "router scores placements (and zombie detection) on stale load"
+    ),
 }
 
 _RAISES = {
@@ -83,9 +109,17 @@ _RAISES = {
     "staging.worker": RuntimeError,
     "staging.device_put": RuntimeError,
     "decode.step": RuntimeError,
+    "replica.flap": RuntimeError,
+    "router.place": RuntimeError,
 }
 
 STALL_DURATION_MS_DEFAULT = 250.0
+
+# args.mode values the rpc.send / rpc.recv sites accept (docs/resilience.md)
+RPC_FAULT_MODES = ("drop", "corrupt", "delay")
+RPC_DELAY_MS_DEFAULT = 200.0
+# appended to a corrupted line: undecodable as JSON, greppable in logs
+_CORRUPT_MARKER = '#CHAOS-CORRUPT#{"'
 
 
 class FaultSpec:
@@ -176,6 +210,37 @@ class FaultInjector:
                 "(resilience.fault_injection)"
             )
 
+    def mangle_line(self, site, line):
+        """RPC-pipe fault application for the ``rpc.send`` / ``rpc.recv``
+        sites: returns the line to actually transmit — unchanged when no
+        fault fires, ``None`` for a dropped line, an undecodable mutation
+        for ``corrupt``; ``delay`` sleeps ``args.delay_ms`` first and
+        returns the line intact (late, the timeout food). The mode rides
+        the spec's ``args`` (default ``drop``)."""
+        spec = self.fire(site)
+        if spec is None:
+            return line
+        mode = spec.args.get("mode", "drop")
+        if mode == "drop":
+            return None
+        if mode == "delay":
+            duration = float(
+                spec.args.get("delay_ms", RPC_DELAY_MS_DEFAULT)
+            )
+            logger.warning(
+                "injected RPC delay at site %r: %.0f ms", site, duration
+            )
+            time.sleep(duration / 1e3)
+            return line
+        if mode == "corrupt":
+            # keep a prefix so logs show WHICH message was garbled, then
+            # break the JSON beyond repair
+            return line[: max(len(line) // 2, 1)] + _CORRUPT_MARKER
+        raise ValueError(
+            f"unknown rpc fault mode {mode!r} for site {site!r}; valid "
+            f"modes: {RPC_FAULT_MODES}"
+        )
+
     def maybe_stall(self, site="step.stall"):
         """Sleep ``args.duration_ms`` when a stall fault fires; returns
         True when it stalled."""
@@ -195,14 +260,16 @@ class FaultInjector:
 NULL_INJECTOR = FaultInjector()
 
 
-def build_fault_injector(config, registry=None):
-    """Construct the injector from a validated DeepSpeedConfig; returns
-    :data:`NULL_INJECTOR` unless the config block arms at least one
-    fault."""
-    if not getattr(config, "resilience_fault_injection_enabled", False):
+def build_fault_injector_from_dict(block, registry=None):
+    """Construct an injector from a raw ``fault_injection`` dict (the
+    config block's shape, pre-validation) — the path for hosts without a
+    DeepSpeedConfig at hand (the serving worker's stub engine builds its
+    chaos from the init spec's config dict). Returns
+    :data:`NULL_INJECTOR` when disabled or empty."""
+    block = dict(block or {})
+    if not block.get("enabled", False):
         return NULL_INJECTOR
-    seed = getattr(config, "resilience_fault_injection_seed", 0)
-    raw = getattr(config, "resilience_fault_injection_faults", []) or []
+    seed = block.get("seed", 0)
     specs = [
         FaultSpec(
             f["site"],
@@ -212,8 +279,26 @@ def build_fault_injector(config, registry=None):
             args=f.get("args"),
             seed=seed,
         )
-        for f in raw
+        for f in (block.get("faults") or [])
     ]
     if not specs:
         return NULL_INJECTOR
     return FaultInjector(specs, seed=seed, registry=registry)
+
+
+def build_fault_injector(config, registry=None):
+    """Construct the injector from a validated DeepSpeedConfig; returns
+    :data:`NULL_INJECTOR` unless the config block arms at least one
+    fault."""
+    return build_fault_injector_from_dict(
+        {
+            "enabled": getattr(
+                config, "resilience_fault_injection_enabled", False
+            ),
+            "seed": getattr(config, "resilience_fault_injection_seed", 0),
+            "faults": getattr(
+                config, "resilience_fault_injection_faults", []
+            ),
+        },
+        registry=registry,
+    )

@@ -30,7 +30,18 @@ from .admission import (
     RateLimited,
     TokenBucket,
 )
-from .replica import InProcessReplica, RemoteRequest, SubprocessReplica
+from .breaker import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+)
+from .replica import (
+    InProcessReplica,
+    RemoteRequest,
+    ReplicaRPCError,
+    SubprocessReplica,
+)
 from .router import (
     PLACEMENT_POLICIES,
     AdapterAffinity,
@@ -128,6 +139,16 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
 
         tracer = build_tracer(cfg)
 
+    # serving-tier chaos (docs/resilience.md "Fault injection"): ONE
+    # injector shared by the router and every replica transport, so
+    # traversal counting spans the whole parent-side serving tier the
+    # way the training injector spans the engine. (Worker processes arm
+    # their own injector from the spec's config — the worker-side sites
+    # live there.)
+    from ..resilience.faults import build_fault_injector
+
+    faults = build_fault_injector(cfg, registry=registry)
+
     if engine_factory is not None:
         replicas = [
             InProcessReplica(
@@ -135,12 +156,19 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
                 # in-process engines share the fleet tracer so their
                 # scheduler spans land in the router's trace file
                 tracer=tracer if tracer.enabled else None,
+                fault_injector=faults,
             )
             for i in range(cfg.serving_replicas)
         ]
     else:
         replicas = [
-            SubprocessReplica(str(i), worker_spec)
+            SubprocessReplica(
+                str(i), worker_spec,
+                rpc_timeout=cfg.serving_rpc_timeout_secs,
+                rpc_retries=cfg.serving_rpc_retries,
+                rpc_backoff_secs=cfg.serving_rpc_backoff_secs,
+                fault_injector=faults,
+            )
             for i in range(cfg.serving_replicas)
         ]
 
@@ -158,6 +186,14 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
         registry=registry,
         telemetry=telemetry,
         tracer=tracer,
+        breaker_failure_threshold=cfg.serving_cb_failure_threshold,
+        breaker_backoff_secs=cfg.serving_cb_backoff_secs,
+        breaker_backoff_max_secs=cfg.serving_cb_backoff_max_secs,
+        zombie_secs=cfg.serving_zombie_secs,
+        zombie_restart_budget=cfg.serving_zombie_restart_budget,
+        brownout_queue_ratio=cfg.serving_brownout_queue_ratio,
+        brownout_max_new_tokens=cfg.serving_brownout_max_new_tokens,
+        fault_injector=faults,
     )
     if start:
         router.start()
@@ -171,6 +207,10 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
 __all__ = [
     "AdapterAffinity",
     "AdmissionController",
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "CircuitBreaker",
     "FleetOverloaded",
     "FleetRequest",
     "FleetRouter",
@@ -180,6 +220,7 @@ __all__ = [
     "PrefixAffinity",
     "RateLimited",
     "RemoteRequest",
+    "ReplicaRPCError",
     "RoundRobin",
     "SubprocessReplica",
     "TokenBucket",

@@ -36,16 +36,58 @@ from ..inference.scheduler import (
     REJECT_REASONS,
     RequestRejected,
 )
+from ..resilience.faults import NULL_INJECTOR
+from ..telemetry.registry import count_suppressed
 from ..utils.logging import logger
 
 _FINISH_ERROR = "error"
 
 
-class ReplicaBase:
-    """Shared lifecycle helpers; subclasses implement the transport."""
+class ReplicaRPCError(RequestRejected):
+    """The replica's TRANSPORT failed — a dead/closed pipe, a corrupted
+    or missing ack, an RPC timeout — as opposed to the engine answering
+    with a real rejection. Subclasses RequestRejected (reason
+    ``"draining"``) so every existing fall-through keeps working, while
+    the router's circuit breakers can count exactly these as replica
+    failures (docs/serving.md "Circuit breakers")."""
 
-    def __init__(self, replica_id):
+    def __init__(self, message, reason=REJECT_DRAINING):
+        super().__init__(message, reason=reason)
+
+
+class ReplicaBase:
+    """Shared lifecycle helpers; subclasses implement the transport.
+
+    ``fault_injector`` (resilience/faults.py) arms the serving-tier
+    chaos sites on this replica: ``snapshot.stale`` here in the shared
+    :meth:`load_snapshot`, ``replica.flap`` at the subclasses' start(),
+    and the ``rpc.*`` pipe sites in the subprocess transport."""
+
+    def __init__(self, replica_id, fault_injector=None):
         self.replica_id = str(replica_id)
+        self.faults = (
+            fault_injector if fault_injector is not None else NULL_INJECTOR
+        )
+        self._stale_snapshot = None
+
+    def load_snapshot(self):
+        """The router-facing load/health view. Fault site
+        ``snapshot.stale``: an armed traversal returns the PREVIOUS
+        call's frozen values — the router must survive scoring (and
+        zombie-sweeping) on stale load data."""
+        if (
+            self.faults.enabled
+            and self._stale_snapshot is not None
+            and self.faults.fire("snapshot.stale") is not None
+        ):
+            return dict(self._stale_snapshot)
+        snap = self._snapshot_now()
+        if self.faults.enabled:
+            self._stale_snapshot = dict(snap)
+        return snap
+
+    def _snapshot_now(self):  # pragma: no cover - interface
+        raise NotImplementedError
 
     def wait_idle(self, timeout=30.0, poll=0.005):
         """Block until the replica has nothing queued and nothing in a
@@ -56,6 +98,9 @@ class ReplicaBase:
             snap = self.load_snapshot()
             if snap.get("failed"):
                 return False
+            if snap.get("unresponsive"):
+                time.sleep(poll)
+                continue  # alive but not answering: neither idle nor dead
             if not snap.get("alive"):
                 return True  # already stopped: nothing can be in flight
             if snap["queue_depth"] == 0 and snap["active_slots"] == 0:
@@ -70,8 +115,9 @@ class InProcessReplica(ReplicaBase):
     fresh driver thread over freshly-pinned params, exactly what a
     process restart would give, minus the process."""
 
-    def __init__(self, replica_id, engine_factory, tracer=None):
-        super().__init__(replica_id)
+    def __init__(self, replica_id, engine_factory, tracer=None,
+                 fault_injector=None):
+        super().__init__(replica_id, fault_injector=fault_injector)
         self._factory = engine_factory
         # fleet-owned tracer injected into every engine this replica
         # builds, so in-process scheduler spans land in the router's
@@ -84,6 +130,9 @@ class InProcessReplica(ReplicaBase):
     def start(self):
         if self.engine is not None:
             return self
+        # fault site: a replica that crashes every time it is brought
+        # (back) up — the router's restart path must absorb the flap
+        self.faults.maybe_raise("replica.flap")
         self._shutdown_requested = False
         self.engine = self._factory()
         if self._tracer is not None:
@@ -113,7 +162,7 @@ class InProcessReplica(ReplicaBase):
             )
         return engine.submit(prompt_tokens, **kwargs)
 
-    def load_snapshot(self):
+    def _snapshot_now(self):
         engine = self.engine
         if engine is None:
             return _dead_snapshot(failed=False)
@@ -121,6 +170,15 @@ class InProcessReplica(ReplicaBase):
         snap["alive"] = not snap["stopped"]
         snap["failed"] = bool(snap["driver_failed"])
         return snap
+
+    def set_brownout(self, on):
+        """Brownout propagation (docs/serving.md): the engine skips
+        prefix-miss registration work while the fleet is browned out.
+        Best-effort — engines without the hook are left alone."""
+        engine = self.engine
+        hook = getattr(engine, "set_brownout", None)
+        if hook is not None:
+            hook(bool(on))
 
     def load_adapter(self, name, **kwargs):
         """Install a LoRA adapter into this replica's in-HBM pool
@@ -187,6 +245,7 @@ class RemoteRequest:
         self.rpc_id = rpc_id
         self.prompt_tokens = list(prompt_tokens)
         self.max_new_tokens = int(max_new_tokens)
+        self.created_at = time.monotonic()
         self.tokens = []
         self.finish_reason = None
         self.first_token_at = None
@@ -220,12 +279,25 @@ class SubprocessReplica(ReplicaBase):
     engine from — see worker.py's module docstring for the schema."""
 
     def __init__(self, replica_id, worker_spec, *, python=None,
-                 start_timeout=120.0, rpc_timeout=10.0):
-        super().__init__(replica_id)
+                 start_timeout=120.0, rpc_timeout=10.0, rpc_retries=2,
+                 rpc_backoff_secs=0.05, fault_injector=None):
+        super().__init__(replica_id, fault_injector=fault_injector)
         self.worker_spec = dict(worker_spec)
         self._python = python or sys.executable
         self._start_timeout = float(start_timeout)
         self._rpc_timeout = float(rpc_timeout)
+        # idempotent control ops (snapshot / drain / adapter management)
+        # retry transient transport failures with exponential backoff;
+        # generate submissions NEVER retry — a duplicate submit is a
+        # duplicate generation (docs/serving.md "RPC retries")
+        self._rpc_retries = int(rpc_retries)
+        self._rpc_backoff_secs = float(rpc_backoff_secs)
+        self.rpc_retries_used = 0
+        # after an unresponsive verdict, snapshot calls inside this
+        # window answer from the verdict instead of burning another
+        # (retries+1) x timeout — one hung worker must not stall every
+        # placement pass for the full retry budget
+        self._unresponsive_until = 0.0
         self._proc = None
         self._reader = None
         self._write_lock = threading.Lock()
@@ -241,6 +313,8 @@ class SubprocessReplica(ReplicaBase):
     def start(self):
         if self._proc is not None and self._proc.poll() is None:
             return self
+        # fault site: crash-on-(re)start (see InProcessReplica.start)
+        self.faults.maybe_raise("replica.flap")
         self._shutdown_requested = False
         self._ready.clear()
         # stale RPC state from a previous incarnation must not leak into
@@ -250,6 +324,7 @@ class SubprocessReplica(ReplicaBase):
             self._expected.clear()
         with self._state_lock:
             self._outstanding.clear()
+        self._unresponsive_until = 0.0
         # the worker inherits the parent's environment verbatim: forcing
         # a platform here would silently downgrade accelerator fleets
         # (tests/bench export JAX_PLATFORMS=cpu themselves)
@@ -282,19 +357,23 @@ class SubprocessReplica(ReplicaBase):
     def _send(self, msg):
         proc = self._proc
         if proc is None or proc.poll() is not None:
-            raise RequestRejected(
-                f"replica {self.replica_id} worker process is not running",
-                reason=REJECT_DRAINING,
+            raise ReplicaRPCError(
+                f"replica {self.replica_id} worker process is not running"
             )
         line = json.dumps(msg)
+        # fault site rpc.send: drop / corrupt / delay this line before it
+        # reaches the worker (a dropped op simply never gets its reply —
+        # exactly what a torn pipe write looks like from here)
+        line = self.faults.mangle_line("rpc.send", line)
+        if line is None:
+            return
         with self._write_lock:
             try:
                 proc.stdin.write(line + "\n")
                 proc.stdin.flush()
             except (BrokenPipeError, OSError, ValueError):
-                raise RequestRejected(
-                    f"replica {self.replica_id} worker pipe is closed",
-                    reason=REJECT_DRAINING,
+                raise ReplicaRPCError(
+                    f"replica {self.replica_id} worker pipe is closed"
                 ) from None
 
     def _read_loop(self, proc):
@@ -302,13 +381,19 @@ class SubprocessReplica(ReplicaBase):
             line = line.strip()
             if not line:
                 continue
+            # fault site rpc.recv: the worker's event is dropped,
+            # garbled, or delivered late
+            line = self.faults.mangle_line("rpc.recv", line)
+            if line is None:
+                continue
             try:
                 msg = json.loads(line)
-            except ValueError:
+            except ValueError as e:
                 logger.warning(
                     "replica %s: undecodable worker line %r",
                     self.replica_id, line[:200],
                 )
+                count_suppressed("serving.rpc_undecodable_line", e)
                 continue
             self._dispatch(msg)
         # EOF: the worker is gone — fail everything still outstanding so
@@ -351,6 +436,7 @@ class SubprocessReplica(ReplicaBase):
                 "replica %s: unknown worker event %r",
                 self.replica_id, event,
             )
+            count_suppressed("serving.rpc_unknown_event")
 
     def _await_reply(self, rpc_id, timeout, make_exc):
         """Wait for ``rpc_id``'s reply; raises ``make_exc()`` on timeout
@@ -395,6 +481,34 @@ class SubprocessReplica(ReplicaBase):
             ),
         )
 
+    def _call_retrying(self, msg, timeout=None):
+        """:meth:`_call` with retry-and-backoff for IDEMPOTENT control
+        ops (snapshot, drain, adapter management): a transient transport
+        failure — one corrupted line, one slow op-loop pass — costs a
+        retry, not a replica marked unresponsive. Submit ops must never
+        ride this path: re-sending a generate is a duplicate
+        generation."""
+        attempt = 0
+        while True:
+            try:
+                return self._call(msg, timeout=timeout)
+            except (TimeoutError, ReplicaRPCError) as e:
+                proc = self._proc
+                if attempt >= self._rpc_retries or (
+                    proc is None or proc.poll() is not None
+                ):
+                    raise
+                # swallowed-and-retried: never silently (docs/resilience.md)
+                count_suppressed("serving.rpc_retry", e)
+                self.rpc_retries_used += 1
+                logger.debug(
+                    "replica %s: retrying %r after %r (attempt %d/%d)",
+                    self.replica_id, msg.get("op"), e, attempt + 1,
+                    self._rpc_retries,
+                )
+                time.sleep(self._rpc_backoff_secs * (2.0 ** attempt))
+                attempt += 1
+
     # -- serving --------------------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens=32, **kwargs):
         rpc_id = self._rpc_ids()
@@ -412,10 +526,9 @@ class SubprocessReplica(ReplicaBase):
             })
             reply = self._await_reply(
                 rpc_id, self._rpc_timeout,
-                lambda: RequestRejected(
+                lambda: ReplicaRPCError(
                     f"replica {self.replica_id}: worker did not "
-                    f"acknowledge the submission",
-                    reason=REJECT_DRAINING,
+                    f"acknowledge the submission"
                 ),
             )
         except Exception:
@@ -453,7 +566,7 @@ class SubprocessReplica(ReplicaBase):
             )
         if load_dir is None:
             raise ValueError("load_dir is required")
-        reply = self._call(
+        reply = self._call_retrying(
             {"op": "load_adapter", "name": str(name),
              "load_dir": str(load_dir), "tag": tag},
             timeout=timeout,
@@ -463,34 +576,95 @@ class SubprocessReplica(ReplicaBase):
         return int(reply["index"])
 
     def unload_adapter(self, name, timeout=30.0):
-        reply = self._call(
+        reply = self._call_retrying(
             {"op": "unload_adapter", "name": str(name)}, timeout=timeout
         )
         if reply.get("error"):
             raise RuntimeError(reply["error"])
         return int(reply["index"])
 
-    def load_snapshot(self):
+    def _snapshot_now(self):
         if self._proc is None or self._proc.poll() is not None:
             return _dead_snapshot(failed=not self._shutdown_requested)
+        if time.monotonic() < self._unresponsive_until:
+            snap = _dead_snapshot(failed=False)
+            snap["unresponsive"] = True
+            return snap
         try:
-            reply = self._call({"op": "snapshot"})
+            reply = self._call_retrying({"op": "snapshot"})
         except (TimeoutError, RequestRejected):
-            # RequestRejected = the pipe died between the poll() check
-            # and the write; callers treat load_snapshot as
-            # non-throwing — a dead replica IS a dead snapshot
+            proc = self._proc
+            if proc is not None and proc.poll() is None:
+                # the process is ALIVE but not answering past the retry
+                # budget: an unresponsive replica, not a corpse — the
+                # router steers traffic away and lets zombie detection
+                # (docs/serving.md) decide on a restart, instead of
+                # mistaking one long GC pause for a death sentence. The
+                # verdict is cached for one timeout window so callers
+                # don't re-pay the retry budget per placement pass.
+                self._unresponsive_until = (
+                    time.monotonic() + self._rpc_timeout
+                )
+                snap = _dead_snapshot(failed=False)
+                snap["unresponsive"] = True
+                return snap
+            # genuinely exited between the poll() check and the RPC —
+            # a dead replica IS a dead snapshot
             return _dead_snapshot(failed=not self._shutdown_requested)
+        self._unresponsive_until = 0.0
         snap = reply["snapshot"]
         snap.setdefault("alive", not snap.get("stopped", False))
         snap.setdefault("failed", bool(snap.get("driver_failed")))
+        self._reconcile_orphans(snap)
         return snap
+
+    def _reconcile_orphans(self, snap):
+        """A worker reporting fully idle while this parent still holds
+        outstanding requests older than the RPC timeout means their
+        ``finished`` events were LOST on the pipe (dropped line, reader
+        hiccup). Fail-finish them so the router re-routes: the worker's
+        answer never reached any caller, so re-deriving it elsewhere
+        keeps exactly-once delivery."""
+        if not (
+            snap.get("alive")
+            and snap.get("queue_depth") == 0
+            and snap.get("active_slots") == 0
+        ):
+            return
+        horizon = time.monotonic() - 2.0 * self._rpc_timeout
+        orphans = []
+        with self._state_lock:
+            for rpc_id, req in list(self._outstanding.items()):
+                if req.created_at < horizon:
+                    orphans.append(self._outstanding.pop(rpc_id))
+        for req in orphans:
+            logger.warning(
+                "replica %s: request %s finished on the worker but its "
+                "completion event never arrived; failing it for re-route",
+                self.replica_id, req.rpc_id,
+            )
+            count_suppressed("serving.rpc_lost_completion")
+            req._finish(req.tokens, _FINISH_ERROR)
+
+    def set_brownout(self, on):
+        """Fire-and-forget brownout toggle (docs/serving.md); a dead
+        pipe is ignored — a replica that cannot hear the toggle is not
+        serving traffic either."""
+        try:
+            self._send({"op": "brownout", "on": bool(on)})
+        except RequestRejected as e:
+            count_suppressed("serving.brownout_toggle", e)
 
     # -- lifecycle ------------------------------------------------------
     def drain(self):
         try:
             self._send({"op": "drain"})
-        except RequestRejected:
-            pass  # already gone: drained by definition
+        except RequestRejected as e:
+            # _send only fails on a dead process or a broken pipe —
+            # neither heals within this worker incarnation, so a retry
+            # buys nothing: the replica is drained by definition, but
+            # never silently (docs/resilience.md "no silent swallows")
+            count_suppressed("serving.drain_rpc", e)
 
     def restart(self):
         self.shutdown()
@@ -503,8 +677,9 @@ class SubprocessReplica(ReplicaBase):
         self._shutdown_requested = True
         try:
             self._send({"op": "shutdown"})
-        except RequestRejected:
-            pass
+        except RequestRejected as e:
+            # the worker died before the goodbye; the kill below reaps it
+            count_suppressed("serving.shutdown_rpc", e)
         try:
             proc.wait(grace)
         except subprocess.TimeoutExpired:
@@ -516,6 +691,13 @@ class SubprocessReplica(ReplicaBase):
             proc.wait(grace)
         if self._reader is not None:
             self._reader.join(grace)
+            if self._reader.is_alive():
+                logger.warning(
+                    "replica %s: reader thread outlived its %.1fs join "
+                    "grace (daemon thread; it dies with the process)",
+                    self.replica_id, grace,
+                )
+                count_suppressed("serving.reader_join_timeout")
             self._reader = None
         self._proc = None
 
@@ -540,6 +722,7 @@ def _dead_snapshot(failed):
         "free_slots": 0, "num_slots": 0, "health": 2,
         "mean_prefill_ms": 0.0, "mean_decode_ms": 0.0,
         "requests_shed": 0.0, "restarts_used": 0,
+        "requests_completed": 0, "tokens_generated": 0,
         "driving": False, "stopped": True, "driver_failed": failed,
-        "alive": False, "failed": failed,
+        "alive": False, "failed": failed, "unresponsive": False,
     }

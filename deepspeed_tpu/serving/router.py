@@ -45,10 +45,17 @@ from ..inference.scheduler import (
     REJECT_DRAINING,
     RequestRejected,
 )
-from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS, histogram_quantile
+from ..resilience.faults import NULL_INJECTOR
+from ..telemetry.registry import (
+    DEFAULT_TIME_BUCKETS_MS,
+    count_suppressed,
+    histogram_quantile,
+)
 from ..telemetry.tracing import NOOP_TRACER, TraceContext
 from ..utils.logging import logger
 from .admission import AdmissionController, FleetOverloaded, RateLimited  # noqa: F401  (re-exported)
+from .breaker import BREAKER_CLOSED, BREAKER_OPEN, build_breaker
+from .replica import ReplicaRPCError
 
 _FINISH_ERROR = "error"
 _FINISH_CANCELLED = "cancelled"
@@ -286,7 +293,11 @@ class FleetRouter:
                  rate_limit=(None, 1), per_tenant_limits=None,
                  registry=None, telemetry=None, clock=time.monotonic,
                  monitor_interval=0.002, telemetry_refresh_secs=0.25,
-                 tracer=None):
+                 tracer=None, breaker_failure_threshold=3,
+                 breaker_backoff_secs=0.5, breaker_backoff_max_secs=30.0,
+                 zombie_secs=0.0, zombie_restart_budget=2,
+                 brownout_queue_ratio=None, brownout_max_new_tokens=16,
+                 fault_injector=None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         from ..telemetry.manager import register_serving_metrics
@@ -304,6 +315,53 @@ class FleetRouter:
         self.capacity_floor = float(capacity_floor)
         self.shed_queue_ratio = float(shed_queue_ratio)
         self.max_reroutes = int(max_reroutes)
+        # chaos sites the router itself hosts (router.place); NULL unless
+        # the config armed one (resilience/faults.py)
+        self._faults = (
+            fault_injector if fault_injector is not None else NULL_INJECTOR
+        )
+        # per-replica circuit breakers (breaker.py): fed by submit-path
+        # outcomes, filtered on in _candidates — an open replica costs
+        # placement nothing instead of a doomed submit + re-route
+        self._breakers = {
+            rid: build_breaker(
+                rid,
+                failure_threshold=breaker_failure_threshold,
+                backoff_secs=breaker_backoff_secs,
+                backoff_max_secs=breaker_backoff_max_secs,
+                clock=clock,
+            )
+            for rid in self._order
+        }
+        # zombie detection (monitor loop): rid -> (progress marker, stamp)
+        self.zombie_secs = float(zombie_secs)
+        self.zombie_restart_budget = int(zombie_restart_budget)
+        self._progress = {}
+        # the sweep costs one snapshot RPC per routable replica: pace it
+        # well under the detection window instead of every monitor tick
+        self._zombie_sweep_secs = max(
+            self.zombie_secs / 5.0, float(monitor_interval)
+        )
+        self._last_zombie_sweep = 0.0
+        self._zombie_restarts_used = {rid: 0 for rid in self._order}
+        # replicas the router itself condemned (restart loop exhausted,
+        # zombie budget spent): swept by _sweep_failed_replicas exactly
+        # like a dead decode driver
+        self._force_failed = set()
+        # brownout degradation state (docs/serving.md "Brownout"):
+        # None = feature off; active state flips on the fleet queue fill
+        self.brownout_queue_ratio = (
+            None if brownout_queue_ratio is None
+            else float(brownout_queue_ratio)
+        )
+        self.brownout_max_new_tokens = int(brownout_max_new_tokens)
+        self._brownout = False
+        # transitions are check-then-act + a per-replica toggle fan-out,
+        # raced by submit threads and the monitor's refresh: serialized
+        # on a dedicated lock so state/gauge/replica toggles can't end
+        # up mutually inconsistent (a latched half-transition would skip
+        # prefix registration fleet-wide until the next crossing)
+        self._brownout_lock = threading.Lock()
         if isinstance(placement, str):
             if placement not in PLACEMENT_POLICIES:
                 raise ValueError(
@@ -341,7 +399,11 @@ class FleetRouter:
         # the whole request. NOOP passthrough unless armed.
         self.tracer = tracer if tracer is not None else NOOP_TRACER
         self._telemetry_refresh_secs = float(telemetry_refresh_secs)
-        self._last_refresh = 0.0
+        # anchored at construction so the monitor's FIRST tick does not
+        # race start()'s explicit refresh with a redundant snapshot
+        # sweep of its own — the cadence means "every N seconds", not
+        # "and once immediately"
+        self._last_refresh = float(clock())
         self._refreshes = 0
         # refreshes run from the monitor thread AND lifecycle/test
         # callers; the exporters' atomic tmp+rename writes must not race
@@ -366,6 +428,11 @@ class FleetRouter:
         self._restarts = reg.counter("fleet/replica_restarts")
         self._evictions = reg.counter("fleet/replicas_evicted")
         self._adapter_loads = reg.counter("fleet/adapter_loads")
+        self._breaker_opens = reg.counter("fleet/breaker_opens")
+        self._breaker_probes = reg.counter("fleet/breaker_probes")
+        self._zombie_restarts = reg.counter("fleet/zombie_restarts")
+        self._brownout_gauge = reg.gauge("fleet/brownout")
+        self._browned_out = reg.counter("fleet/requests_browned_out")
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
@@ -389,6 +456,17 @@ class FleetRouter:
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout)
+            if self._monitor.is_alive():
+                # a join that times out is NOT a clean shutdown: the
+                # monitor is wedged (stuck RPC, hung restart) and may
+                # still touch replicas while we tear them down — say so
+                # and count it instead of returning as if clean
+                logger.warning(
+                    "fleet: monitor thread still alive after the %.1fs "
+                    "shutdown join; proceeding with teardown around it",
+                    timeout,
+                )
+                count_suppressed("serving.router.monitor_join_timeout")
             self._monitor = None
         for rid in self._order:
             if rid not in self._evicted:
@@ -441,9 +519,15 @@ class FleetRouter:
             self._routable.discard(replica_id)
         replica.drain()
 
-    def restart_replica(self, replica_id, wait_timeout=60.0):
+    def restart_replica(self, replica_id, wait_timeout=60.0,
+                        restart_attempts=3):
         """Drain ``replica_id``, wait for it to go idle, rebuild it, and
-        return it to the routable set."""
+        return it to the routable set. A rebuild that RAISES (flapping
+        replica: chaos site ``replica.flap``, OOM-on-init, bad worker
+        spec) is retried with backoff up to ``restart_attempts`` times;
+        exhausting them condemns the replica to the monitor's eviction
+        sweep instead of leaving it in an unroutable limbo. Returns True
+        when the replica rejoined."""
         replica = self._replicas[replica_id]
         self.drain(replica_id)
         if not replica.wait_idle(wait_timeout):
@@ -452,7 +536,30 @@ class FleetRouter:
                 "anyway (outstanding requests will re-route)",
                 replica_id, wait_timeout,
             )
-        replica.restart()
+        restarted = False
+        for attempt in range(max(int(restart_attempts), 1)):
+            try:
+                replica.restart()
+                restarted = True
+                break
+            except Exception as e:
+                logger.warning(
+                    "fleet: replica %s restart attempt %d/%d failed: %r",
+                    replica_id, attempt + 1, restart_attempts, e,
+                )
+                count_suppressed("serving.replica_restart_failed", e)
+                time.sleep(0.05 * (2.0 ** attempt))
+        if not restarted:
+            logger.error(
+                "fleet: replica %s failed every restart attempt; "
+                "condemning it to eviction", replica_id,
+            )
+            self.tracer.event(
+                "router.restart_failed", attrs={"replica": replica_id}
+            )
+            with self._lock:
+                self._force_failed.add(replica_id)
+            return False
         # a rebuilt replica starts with an EMPTY adapter pool: replay the
         # fleet-wide registry before traffic routes back to it, so tenant
         # requests never bounce off a restarted replica
@@ -460,17 +567,27 @@ class FleetRouter:
             try:
                 replica.load_adapter(name, **kwargs)
                 self._adapter_loads.inc()
-            except Exception:
+            except Exception as e:
                 logger.exception(
                     "fleet: reloading adapter %r onto restarted replica "
                     "%s failed; its requests will fail on this replica",
                     name, replica_id,
                 )
+                count_suppressed("serving.adapter_replay_failed", e)
         self._restarts.inc()
+        # a rebuilt replica is a fresh start for its breaker too
+        self._breakers[replica_id].record_success()
+        # and it must re-hear the current brownout state (a worker
+        # restart forgets the toggle)
+        if self._brownout:
+            self._set_replica_brownout(replica_id, True)
         with self._lock:
             self._evicted.discard(replica_id)
             self._routable.add(replica_id)
+            self._force_failed.discard(replica_id)
+        self._progress.pop(replica_id, None)
         self.refresh_telemetry()
+        return True
 
     def rolling_restart(self, wait_timeout=60.0):
         """Drain + restart every live replica, ONE at a time, never
@@ -605,17 +722,29 @@ class FleetRouter:
                     f"({fastest:.0f}ms): unmeetable fleet-wide",
                     reason=REJECT_DEADLINE,
                 )
-        if priority > 0:
-            fill = sum(s["queue_depth"] for _rid, s in candidates)
-            cap = sum(s["queue_capacity"] for _rid, s in candidates)
-            if cap > 0 and fill >= self.shed_queue_ratio * cap:
-                self._rejected.inc()
-                self._trace_reject("overload", tenant)
-                raise FleetOverloaded(
-                    f"fleet queue fill {fill}/{cap} past the shed ratio "
-                    f"{self.shed_queue_ratio}: shedding priority-"
-                    f"{priority} submission"
+        fill = sum(s["queue_depth"] for _rid, s in candidates)
+        cap = sum(s["queue_capacity"] for _rid, s in candidates)
+        if priority > 0 and cap > 0 and fill >= self.shed_queue_ratio * cap:
+            self._rejected.inc()
+            self._trace_reject("overload", tenant)
+            raise FleetOverloaded(
+                f"fleet queue fill {fill}/{cap} past the shed ratio "
+                f"{self.shed_queue_ratio}: shedding priority-"
+                f"{priority} submission"
+            )
+        # brownout band (docs/serving.md): between brownout_queue_ratio
+        # and the shed ratio the fleet DEGRADES sheddable traffic instead
+        # of growing the queue toward the cliff — the generation budget
+        # clamps to the configured floor (and replicas skip prefix-miss
+        # registration work), so throughput bends rather than cliffs
+        brownout = self._update_brownout(fill / cap if cap > 0 else 0.0)
+        if brownout and priority > 0:
+            requested = int(fleet_req.kwargs.get("max_new_tokens", 32))
+            if requested > self.brownout_max_new_tokens:
+                fleet_req.kwargs["max_new_tokens"] = (
+                    self.brownout_max_new_tokens
                 )
+                self._browned_out.inc()
         if self.tracer.enabled and fleet_req.trace_ctx is not None:
             # admission verdict span: rate-limit + pressure + deadline
             # gates all passed (rejections record flight-recorder events
@@ -692,11 +821,16 @@ class FleetRouter:
     def _candidates(self):
         """(replica_id, snapshot) pairs for the currently routable,
         healthy-or-degraded replicas, in registration order (placement
-        determinism depends on stable ordering)."""
+        determinism depends on stable ordering). Replicas behind an OPEN
+        circuit breaker are excluded up front — every placement policy
+        sees the same filtered set, so none of them can burn a submit
+        (and a re-route) on a replica known to be failing its RPCs."""
         routable = self._routable_ids()
         out = []
         for rid in self._order:
             if rid not in routable:
+                continue
+            if not self._breakers[rid].routable():
                 continue
             snap = self._replicas[rid].load_snapshot()
             if snap.get("failed") or not snap.get("alive"):
@@ -732,22 +866,73 @@ class FleetRouter:
             )
         while candidates:
             with self._placement_lock:
-                rid = self.placement.choose(
-                    candidates, fleet_req.prompt_tokens, context=context
-                )
-                was_hit = getattr(self.placement, "last_hit", False)
+                try:
+                    # fault site: a raising placement policy (chaos) or
+                    # a genuinely buggy custom policy — the submission
+                    # must not die with it
+                    self._faults.maybe_raise("router.place")
+                    rid = self.placement.choose(
+                        candidates, fleet_req.prompt_tokens,
+                        context=context,
+                    )
+                    was_hit = getattr(self.placement, "last_hit", False)
+                except Exception as e:
+                    logger.warning(
+                        "fleet: placement policy %s raised (%r); falling "
+                        "back to registration order",
+                        getattr(self.placement, "name",
+                                type(self.placement).__name__), e,
+                    )
+                    count_suppressed("serving.router_place", e)
+                    rid = candidates[0][0]
+                    was_hit = False
+            breaker = self._breakers[rid]
+            probing = breaker.state == BREAKER_OPEN
+            if not breaker.allow_request():
+                # raced another submit into the window's single half-open
+                # probe ticket (or the window has not elapsed): this
+                # replica is not available to THIS request
+                candidates = [c for c in candidates if c[0] != rid]
+                continue
+            if probing:
+                # this submit IS the window's one half-open probe
+                self._breaker_probes.inc()
+                if self.tracer.enabled:
+                    self.tracer.event(
+                        "router.circuit",
+                        attrs={"replica": rid, "state": "half_open"},
+                    )
             attempts += 1
             try:
                 inner = self._replicas[rid].submit(
                     fleet_req.prompt_tokens, **submit_kwargs
                 )
-            except (RequestRejected, AdapterUnavailable):
-                # AdapterUnavailable is per-REPLICA, not per-request: a
-                # replica missing the adapter (failed restart replay,
-                # targeted load) drops from the candidate set and the
-                # request falls through to a replica that holds it
+            except ReplicaRPCError as e:
+                # the TRANSPORT failed (timeout, dead/corrupt pipe):
+                # breaker food — N consecutive of these open the circuit
+                self._note_breaker_failure(rid, e)
                 candidates = [c for c in candidates if c[0] != rid]
                 continue
+            except (RequestRejected, AdapterUnavailable):
+                # a healthy door rejection (queue full, raced a drain,
+                # missing adapter): the replica ANSWERED, so its breaker
+                # resets — AdapterUnavailable is per-REPLICA, not
+                # per-request: drop it from the set and fall through to
+                # a replica that can serve
+                self._note_breaker_success(rid)
+                candidates = [c for c in candidates if c[0] != rid]
+                continue
+            except Exception as e:
+                # an UNCLASSIFIED submit failure (bad kwargs, unknown
+                # worker error type) propagates to the caller — but a
+                # half-open probe ticket must not leak with it, or the
+                # breaker wedges HALF_OPEN and the replica never rejoins:
+                # count it as an unanswered probe (the next window
+                # re-probes)
+                if probing:
+                    self._note_breaker_failure(rid, e)
+                raise
+            self._note_breaker_success(rid)
             if was_hit:
                 # counted only on a PLACED hit: a sticky replica that
                 # rejected at its door and fell through to another one
@@ -774,13 +959,111 @@ class FleetRouter:
             return inner, rid
         return None, None
 
+    # -- circuit breakers (docs/serving.md "Circuit breakers") ----------
+    def _note_breaker_failure(self, rid, exc):
+        breaker = self._breakers[rid]
+        before = breaker.state
+        breaker.record_failure()
+        if breaker.state == BREAKER_OPEN:
+            if before != BREAKER_OPEN:
+                self._breaker_opens.inc()
+                logger.warning(
+                    "fleet: circuit OPEN for replica %s after %d "
+                    "consecutive RPC failure(s) (last: %r); next probe "
+                    "in %.2fs", rid, breaker.consecutive_failures, exc,
+                    breaker.open_window_remaining,
+                )
+            if self.tracer.enabled and before != BREAKER_OPEN:
+                self.tracer.event(
+                    "router.circuit",
+                    attrs={"replica": rid, "state": "open",
+                           "failures": breaker.consecutive_failures},
+                )
+
+    def _note_breaker_success(self, rid):
+        breaker = self._breakers[rid]
+        before = breaker.state
+        breaker.record_success()
+        if before != BREAKER_CLOSED:
+            logger.warning(
+                "fleet: circuit CLOSED for replica %s (probe answered); "
+                "rejoining placement with state intact", rid,
+            )
+            if self.tracer.enabled:
+                self.tracer.event(
+                    "router.circuit",
+                    attrs={"replica": rid, "state": "closed"},
+                )
+
+    def breaker_state(self, replica_id):
+        """The replica's circuit state (breaker.py constants) — what the
+        fleet/replica{i}/circuit_state gauge exports."""
+        return self._breakers[replica_id].state
+
+    # -- brownout (docs/serving.md "Brownout degradation") --------------
+    def _update_brownout(self, queue_ratio):
+        """Flip the fleet brownout state from the current queue-fill
+        ratio; transitions export the gauge, record a flight-recorder
+        instant event, and propagate the toggle to every live replica
+        (engines then skip prefix-miss registration work). Returns the
+        active state."""
+        if self.brownout_queue_ratio is None:
+            return False
+        active = queue_ratio >= self.brownout_queue_ratio
+        with self._brownout_lock:
+            if active == self._brownout:
+                return active
+            self._brownout = active
+            return self._brownout_transition(active, queue_ratio)
+
+    def _brownout_transition(self, active, queue_ratio):
+        """(under self._brownout_lock) export + propagate one brownout
+        edge; transitions are rare, so holding the lock across the
+        replica toggle RPCs keeps every observer consistent."""
+        self._brownout_gauge.set(1.0 if active else 0.0)
+        logger.warning(
+            "fleet: brownout %s (queue fill ratio %.3f vs threshold "
+            "%.3f) — sheddable traffic %s",
+            "ENTERED" if active else "EXITED", queue_ratio,
+            self.brownout_queue_ratio,
+            "degrades instead of growing the queue" if active
+            else "serves at full budget again",
+        )
+        if self.tracer.enabled:
+            self.tracer.event(
+                "router.brownout",
+                attrs={"state": int(active),
+                       "queue_ratio": round(float(queue_ratio), 4)},
+            )
+        for rid in self._order:
+            if rid not in self._evicted:
+                self._set_replica_brownout(rid, active)
+        return active
+
+    def _set_replica_brownout(self, rid, on):
+        hook = getattr(self._replicas[rid], "set_brownout", None)
+        if hook is None:
+            return
+        try:
+            hook(on)
+        except Exception as e:
+            # a replica that cannot hear the toggle is already in worse
+            # trouble than a missed brownout; count, don't crash the tick
+            count_suppressed("serving.brownout_toggle", e)
+
+    @property
+    def brownout(self):
+        """True while the fleet is in the brownout band."""
+        return self._brownout
+
     # -- monitor --------------------------------------------------------
     def _monitor_loop(self):
         while not self._stop.is_set():
             try:
                 self._tick()
-            except Exception:
+            except Exception as e:
                 logger.exception("fleet monitor tick failed")
+                count_suppressed("serving.monitor_tick", e)
             self._stop.wait(self._monitor_interval)
 
     def _tick(self):
@@ -793,21 +1076,92 @@ class FleetRouter:
                 "fleet: preemption signal received — draining all replicas"
             )
             self.drain_fleet()
+        self._sweep_zombies()
         self._sweep_failed_replicas()
         self._sweep_outstanding()
         now = self._clock()
         if now - self._last_refresh >= self._telemetry_refresh_secs:
             self.refresh_telemetry()
 
+    def _sweep_zombies(self):
+        """Zombie detection (docs/serving.md): a replica whose snapshot
+        shows work in flight but whose completion counters have not
+        moved for ``zombie_secs`` — or whose live process has stopped
+        answering snapshot RPCs altogether — is drained-then-restarted
+        under ``zombie_restart_budget``; past the budget it is condemned
+        to the eviction sweep. Each detection dumps the flight recorder
+        (the wedged state IS the debugging moment)."""
+        if self.zombie_secs <= 0:
+            return
+        now = self._clock()
+        if now - self._last_zombie_sweep < self._zombie_sweep_secs:
+            return
+        self._last_zombie_sweep = now
+        for rid in list(self._routable_ids()):
+            if rid in self._evicted:
+                continue
+            snap = self._replicas[rid].load_snapshot()
+            unresponsive = bool(snap.get("unresponsive"))
+            stuck = unresponsive or (
+                snap.get("alive") and snap.get("active_slots", 0) > 0
+            )
+            marker = (
+                snap.get("requests_completed"),
+                snap.get("tokens_generated"),
+            )
+            prev = self._progress.get(rid)
+            if not stuck or prev is None or (
+                not unresponsive and marker != prev[0]
+            ):
+                # idle, first sighting, or real progress: re-anchor
+                self._progress[rid] = (marker, now)
+                continue
+            if now - prev[1] < self.zombie_secs:
+                continue
+            used = self._zombie_restarts_used[rid]
+            logger.warning(
+                "fleet: replica %s is a ZOMBIE (%s for %.1fs; restart "
+                "%d/%d)", rid,
+                "unresponsive RPC" if unresponsive
+                else "active slots with frozen completion counters",
+                now - prev[1], used + 1, self.zombie_restart_budget,
+            )
+            self.tracer.dump_flight(f"zombie_replica_{rid}")
+            if self.tracer.enabled:
+                self.tracer.event(
+                    "router.zombie",
+                    attrs={"replica": rid,
+                           "unresponsive": unresponsive,
+                           "restarts_used": used},
+                )
+            self._progress.pop(rid, None)
+            if used >= self.zombie_restart_budget:
+                logger.error(
+                    "fleet: replica %s zombie past its restart budget "
+                    "(%d); evicting", rid, self.zombie_restart_budget,
+                )
+                with self._lock:
+                    self._force_failed.add(rid)
+                continue
+            self._zombie_restarts_used[rid] = used + 1
+            self._zombie_restarts.inc()
+            # the zombie never goes idle by definition: skip the drain
+            # wait and rebuild now — its in-flight requests fail-finish
+            # and the outstanding sweep re-routes them
+            self.restart_replica(rid, wait_timeout=0.0)
+
     def _sweep_failed_replicas(self):
+        with self._lock:
+            force_failed = set(self._force_failed)
         for rid in self._order:
             if rid in self._evicted:
                 continue
             replica = self._replicas[rid]
-            if replica.failed:
+            if replica.failed or rid in force_failed:
                 logger.warning(
                     "fleet: evicting replica %s (decode driver dead past "
-                    "its restart budget); re-routing its requests", rid,
+                    "its restart budget, a failed restart, or a zombie "
+                    "past its budget); re-routing its requests", rid,
                 )
                 # eviction is a debugging moment: dump the flight
                 # recorder's last-N spans/events (no-op when tracing off)
@@ -929,6 +1283,8 @@ class FleetRouter:
         reg = self.metrics
         total_queue = 0
         total_active = 0
+        total_capacity = 0
+        routable_queue = 0
         available = 0
         prefix_hits = 0
         prefix_lookups = 0
@@ -942,6 +1298,9 @@ class FleetRouter:
                 snap = self._replicas[rid].load_snapshot()
                 alive_val = 1.0 if snap.get("alive") else 0.0
             prefix = f"fleet/replica{rid}"
+            reg.gauge(f"{prefix}/circuit_state").set(
+                float(self._breakers[rid].state)
+            )
             if snap is not None:
                 reg.gauge(f"{prefix}/queue_depth").set(snap["queue_depth"])
                 reg.gauge(f"{prefix}/slot_occupancy").set(
@@ -977,11 +1336,23 @@ class FleetRouter:
                     adapters_resident.update(loaded)
                 total_queue += snap["queue_depth"]
                 total_active += snap["active_slots"]
-                # degraded replicas still take priority-0 traffic, so
-                # they count as available; draining/stopped ones do not
                 if rid in routable and snap.get("alive"):
+                    # degraded replicas still take priority-0 traffic, so
+                    # they count as available; draining/stopped do not —
+                    # and ONLY routable replicas feed the brownout ratio
+                    # (both terms: a draining replica's backlog is not
+                    # pressure on the replicas actually taking traffic,
+                    # matching the submit path's candidate-based ratio)
                     available += 1
+                    total_capacity += snap["queue_capacity"]
+                    routable_queue += snap["queue_depth"]
             reg.gauge(f"{prefix}/alive").set(alive_val)
+        # brownout state follows the fill ratio DOWN too: the monitor's
+        # refresh cadence is what ends a brownout window once the queue
+        # drains (submissions alone would leave the last state latched)
+        self._update_brownout(
+            routable_queue / total_capacity if total_capacity > 0 else 0.0
+        )
         reg.gauge("fleet/queue_depth").set(total_queue)
         reg.gauge("fleet/slot_occupancy").set(total_active)
         reg.gauge("fleet/replicas_total").set(

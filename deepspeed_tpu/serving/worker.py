@@ -58,6 +58,7 @@ import threading
 import time
 
 from ..inference.scheduler import RequestRejected
+from ..resilience.faults import NULL_INJECTOR
 
 
 class WorkerServer:
@@ -75,6 +76,10 @@ class WorkerServer:
         self._state_lock = threading.Lock()
         self._tracked = {}  # rpc_id -> (request, first_token_announced)
         self._stop = threading.Event()
+        # the engine's own fault injector (resilience/faults.py), adopted
+        # at init so the "replica.hang" chaos site can stall THIS op loop
+        # — the worker-side half of the serving seams
+        self._faults = NULL_INJECTOR
 
     def _emit(self, msg):
         with self._write_lock:
@@ -114,6 +119,8 @@ class WorkerServer:
     # -- ops -----------------------------------------------------------
     def _op_init(self, msg):
         self._engine = self._build(msg["spec"])
+        resilience = getattr(self._engine, "resilience", None)
+        self._faults = getattr(resilience, "faults", NULL_INJECTOR)
         # replica-prefixed request ids (inference/scheduler.py): two
         # workers (or one worker across a restart) must never emit
         # colliding ids into fleet telemetry
@@ -189,8 +196,24 @@ class WorkerServer:
                 line = line.strip()
                 if not line:
                     continue
-                msg = json.loads(line)
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    # one corrupted line on the pipe must cost its op,
+                    # not the whole worker (the parent's ack timeout +
+                    # breaker absorb the lost op; mirrors the parent
+                    # reader's tolerance)
+                    print(
+                        f"worker: undecodable line {line[:200]!r}",
+                        file=sys.stderr, flush=True,
+                    )
+                    continue
                 op = msg.get("op")
+                # fault site: op-loop stall (args.duration_ms) — every
+                # parent RPC, snapshots included, waits this out while
+                # the PROCESS stays alive: the hung-worker failure mode
+                # the parent's unresponsive-snapshot path absorbs
+                self._faults.maybe_stall("replica.hang")
                 if op == "init":
                     self._op_init(msg)
                 elif op == "submit":
@@ -210,6 +233,12 @@ class WorkerServer:
                         msg,
                         lambda: self._engine.unload_adapter(msg["name"]),
                     )
+                elif op == "brownout":
+                    # fleet brownout toggle (docs/serving.md): fire-and-
+                    # forget like drain; engines without the hook ignore
+                    hook = getattr(self._engine, "set_brownout", None)
+                    if hook is not None:
+                        hook(bool(msg.get("on")))
                 elif op == "drain":
                     self._engine.scheduler.drain()
                 elif op == "shutdown":
@@ -229,10 +258,129 @@ class WorkerServer:
                 self._engine.close()
 
 
+class _StubRequest:
+    """Request handle with the InferenceRequest result surface, finished
+    by a timer (or never, in hang mode)."""
+
+    def __init__(self, tokens):
+        self._pending = list(tokens)
+        self.tokens = []
+        self.finish_reason = None
+        self.first_token_at = None
+        self._done = threading.Event()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def _finish(self):
+        self.tokens = self._pending
+        self.first_token_at = time.monotonic()
+        self.finish_reason = "max_new_tokens"
+        self._done.set()
+
+
+class StubWorkerEngine:
+    """A jax-free engine for exercising the replica RPC seam itself —
+    chaos tests and the fault-site matrix drive REAL worker subprocesses
+    without paying a jax import + compile per case. Spec block::
+
+        {"stub": {"delay_secs": 0.02, "hang": false}, "config": {...}}
+
+    Answers are a pure function of the prompt (deterministic across
+    replicas, so exactly-once re-routing is assertable bitwise);
+    ``hang: true`` makes it accept requests and never finish them — the
+    zombie-replica failure mode (active slots, frozen completion
+    counters). The config's ``resilience.fault_injection`` block arms
+    the worker-side sites (``replica.hang``) like the real engine's
+    does."""
+
+    def __init__(self, stub_spec, config):
+        from ..resilience.faults import build_fault_injector_from_dict
+
+        self.delay_secs = float(stub_spec.get("delay_secs", 0.0))
+        self.hang = bool(stub_spec.get("hang", False))
+        fi = (config.get("resilience") or {}).get("fault_injection") or {}
+
+        class _Res:
+            faults = build_fault_injector_from_dict(fi)
+
+        self.resilience = _Res()
+        self.scheduler = self
+        self.brownout = False
+        self._lock = threading.Lock()
+        self._active = []
+        self._completed = 0
+        self._tokens_out = 0
+        self._draining = False
+
+    # -- scheduler surface the worker/replica tier drives ---------------
+    def serve_forever(self):
+        pass
+
+    def set_id_prefix(self, replica_id):
+        pass
+
+    def drain(self):
+        self._draining = True
+
+    def set_brownout(self, on):
+        self.brownout = bool(on)
+
+    def submit(self, prompt, max_new_tokens=32, **kwargs):
+        if self._draining:
+            raise RequestRejected(
+                "stub engine draining", reason="draining"
+            )
+        base = int(prompt[-1]) if prompt else 0
+        req = _StubRequest(
+            [(base + i + 1) % 1000 for i in range(int(max_new_tokens))]
+        )
+        with self._lock:
+            self._active.append(req)
+        if not self.hang:
+            timer = threading.Timer(
+                self.delay_secs, self._complete, args=(req,)
+            )
+            timer.daemon = True
+            timer.start()
+        return req
+
+    def _complete(self, req):
+        req._finish()
+        with self._lock:
+            if req in self._active:
+                self._active.remove(req)
+            self._completed += 1
+            self._tokens_out += len(req.tokens)
+
+    def load_snapshot(self):
+        with self._lock:
+            active = len(self._active)
+            completed, tokens = self._completed, self._tokens_out
+        return {
+            "queue_depth": 0, "queue_capacity": 8,
+            "active_slots": active, "free_slots": max(8 - active, 0),
+            "num_slots": 8, "health": 2 if self._draining else 0,
+            "mean_prefill_ms": 1.0, "mean_decode_ms": 1.0,
+            "requests_shed": 0.0, "restarts_used": 0,
+            "requests_completed": completed, "tokens_generated": tokens,
+            "driving": True, "stopped": self._draining,
+            "driver_failed": False,
+        }
+
+    def close(self):
+        self._draining = True
+
+
 def build_engine_from_spec(spec):
     """The production engine builder: a GPT-2 from the spec's model
     kwargs, params from ``init_seed`` (or the config's verified
-    checkpoint load), behind ``init_inference``."""
+    checkpoint load), behind ``init_inference``. A spec carrying a
+    ``"stub"`` block builds the jax-free :class:`StubWorkerEngine`
+    instead (chaos/protocol testing of the RPC seam)."""
+    if spec.get("stub") is not None:
+        return StubWorkerEngine(spec["stub"], spec.get("config") or {})
     import jax
     import jax.numpy as jnp
 

@@ -142,6 +142,14 @@ SERVING_METRICS = (
     ("gauge", "fleet/prefix_hit_rate", "fleet-wide prefix-cache hit rate (sum of replica hits / lookups at the last refresh; 0 with no paged replicas)"),
     ("counter", "fleet/adapter_loads", "per-replica LoRA adapter installs driven through the router's load_adapter"),
     ("gauge", "fleet/adapters_loaded", "distinct LoRA adapters resident across the fleet at the last refresh"),
+    # chaos hardening (docs/serving.md "Circuit breakers" / "Zombie
+    # detection" / "Brownout degradation"); per-replica circuit_state
+    # gauges ride dynamically as fleet/replica{i}/circuit_state
+    ("counter", "fleet/breaker_opens", "circuit-breaker trips: a replica hit its consecutive-RPC-failure threshold and left every placement candidate set"),
+    ("counter", "fleet/breaker_probes", "half-open probe submissions (exactly one per open backoff window)"),
+    ("counter", "fleet/zombie_restarts", "replicas drained-then-restarted by zombie detection (active slots with frozen completion counters, or a live-but-unresponsive worker)"),
+    ("gauge", "fleet/brownout", "1 while the fleet queue fill sits in the brownout band (sheddable requests degrade instead of queueing toward the shed cliff)"),
+    ("counter", "fleet/requests_browned_out", "priority > 0 submissions admitted with max_new_tokens clamped to the brownout floor"),
 )
 
 
@@ -315,7 +323,11 @@ class Telemetry:
                 self._window_start_mono = None
             hist.observe(
                 (now - self._window_start) * 1000.0,
-                trace_id=span["trace_id"] if span else None,
+                # only SAMPLED traces reach the export file: an exemplar
+                # pointing at an unsampled trace is a dead link
+                trace_id=(
+                    span["trace_id"] if span and span["sampled"] else None
+                ),
             )
             self._window_start = None
         self._windows_ended += 1

@@ -516,6 +516,16 @@ def test_serving_defaults():
     assert cfg.serving_rate_limit_rps is None
     assert cfg.serving_rate_limit_burst == 1
     assert cfg.serving_rate_limit_per_tenant == {}
+    assert cfg.serving_rpc_timeout_secs == 10.0
+    assert cfg.serving_rpc_retries == 2
+    assert cfg.serving_rpc_backoff_secs == 0.05
+    assert cfg.serving_zombie_secs == 0.0  # zombie sweep off by default
+    assert cfg.serving_zombie_restart_budget == 2
+    assert cfg.serving_cb_failure_threshold == 3
+    assert cfg.serving_cb_backoff_secs == 0.5
+    assert cfg.serving_cb_backoff_max_secs == 30.0
+    assert cfg.serving_brownout_queue_ratio is None  # brownout off
+    assert cfg.serving_brownout_max_new_tokens == 16
 
 
 def test_serving_valid_block_parses():
@@ -533,7 +543,28 @@ def test_serving_valid_block_parses():
             "burst": 5,
             "per_tenant": {"gold": {"requests_per_sec": 100}},
         },
+        "rpc_timeout_secs": 2.5,
+        "rpc_retries": 0,
+        "rpc_backoff_secs": 0.2,
+        "zombie_secs": 12.0,
+        "zombie_restart_budget": 1,
+        "circuit_breaker": {
+            "failure_threshold": 1,
+            "backoff_secs": 0.25,
+            "backoff_max_secs": 8.0,
+        },
+        "brownout": {"queue_ratio": 0.4, "max_new_tokens": 8},
     })
+    assert cfg.serving_rpc_timeout_secs == 2.5
+    assert cfg.serving_rpc_retries == 0
+    assert cfg.serving_rpc_backoff_secs == 0.2
+    assert cfg.serving_zombie_secs == 12.0
+    assert cfg.serving_zombie_restart_budget == 1
+    assert cfg.serving_cb_failure_threshold == 1
+    assert cfg.serving_cb_backoff_secs == 0.25
+    assert cfg.serving_cb_backoff_max_secs == 8.0
+    assert cfg.serving_brownout_queue_ratio == 0.4
+    assert cfg.serving_brownout_max_new_tokens == 8
     assert cfg.serving_replicas == 4
     assert cfg.serving_backend == "subprocess"
     assert cfg.serving_placement == "prefix_affinity"
@@ -572,6 +603,26 @@ def test_serving_valid_block_parses():
     {"rate_limit": {"per_tenant": {"gold": {"rps": 1}}}},  # unknown key
     {"rate_limit": {"per_tenant": {"gold": {"requests_per_sec": 0}}}},
     {"rate_limit": {"per_tenant": {"gold": {"burst": 0}}}},
+    {"rpc_timeout_secs": 0},
+    {"rpc_timeout_secs": "fast"},
+    {"rpc_retries": -1},
+    {"rpc_retries": True},
+    {"rpc_backoff_secs": 0},
+    {"zombie_secs": -1},
+    {"zombie_secs": "never"},
+    {"zombie_restart_budget": -1},
+    {"zombie_restart_budget": 1.5},
+    {"circuit_breaker": {"threshold": 3}},        # typo'd key
+    {"circuit_breaker": {"failure_threshold": 0}},
+    {"circuit_breaker": {"backoff_secs": 0}},
+    {"circuit_breaker": {"backoff_max_secs": -1}},
+    {"circuit_breaker": {"backoff_secs": 5.0, "backoff_max_secs": 1.0}},
+    {"brownout": {"ratio": 0.5}},                 # typo'd key != off
+    {"brownout": {"queue_ratio": 0}},
+    {"brownout": {"queue_ratio": 1.0}},           # must sit below shed
+    {"brownout": {"queue_ratio": 0.8}},           # >= default shed 0.75
+    {"brownout": {"queue_ratio": 0.5, "max_new_tokens": 0}},
+    {"shed_queue_ratio": 0.5, "brownout": {"queue_ratio": 0.5}},
 ])
 def test_serving_rejects(block):
     from deepspeed_tpu.config.config import DeepSpeedConfigError

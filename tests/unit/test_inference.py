@@ -855,6 +855,11 @@ def test_load_snapshot_reports_live_idle_state():
         assert snap["driver_failed"] is False
         assert snap["mean_prefill_ms"] == 0.0
 
+        # completion-progress markers: the fleet tier's zombie detection
+        # watches these move (docs/serving.md "Zombie detection")
+        assert snap["requests_completed"] == 0
+        assert snap["tokens_generated"] == 0
+
         # pile submissions up WITHOUT stepping: an idle replica, loaded
         for _ in range(3):
             eng.submit(_prompt(4), max_new_tokens=2)
@@ -869,5 +874,10 @@ def test_load_snapshot_reports_live_idle_state():
         assert snap["mean_prefill_ms"] > 0.0
         assert snap["mean_decode_ms"] > 0.0
         assert eng.metrics.snapshot()["infer/queue_depth"] == 0
+        # progress moved with the completed work, JSON-safe ints
+        assert snap["requests_completed"] == 3
+        assert snap["tokens_generated"] == 6
+        assert isinstance(snap["requests_completed"], int)
+        assert isinstance(snap["tokens_generated"], int)
     finally:
         eng.close()

@@ -1198,3 +1198,119 @@ def test_ragged_window_error_is_not_healed(tmp_path):
     with pytest.raises(RuntimeError, match="ran dry mid-window"):
         engine.train_batch(src)
     assert engine.supervisor.rollbacks == 0  # never tried to heal this
+
+
+# ---------------------------------------------------------------------------
+# serving-seam fault sites (resilience/faults.py additions, PR 10):
+# line mangling for the rpc.* pipe sites + the dict-form builder
+# ---------------------------------------------------------------------------
+def test_serving_sites_registered_and_validated():
+    from deepspeed_tpu.resilience.faults import (
+        KNOWN_FAULT_SITES,
+        RPC_FAULT_MODES,
+        FaultSpec,
+    )
+
+    for site in ("rpc.send", "rpc.recv", "replica.hang", "replica.flap",
+                 "router.place", "snapshot.stale"):
+        assert site in KNOWN_FAULT_SITES
+        FaultSpec(site)  # constructible
+    assert RPC_FAULT_MODES == ("drop", "corrupt", "delay")
+    # the config validator rejects a typo'd rpc mode (it must not
+    # silently mean "drop")
+    with pytest.raises(DeepSpeedConfigError, match="args.mode"):
+        DeepSpeedConfig(None, param_dict={
+            "train_batch_size": 8,
+            "resilience": {"fault_injection": {
+                "enabled": True,
+                "faults": [{"site": "rpc.send",
+                            "args": {"mode": "garble"}}],
+            }},
+        }, world_size=1)
+
+
+def test_mangle_line_modes():
+    import time as _time
+
+    from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+
+    line = '{"op": "submit", "id": 7}'
+    # drop
+    inj = FaultInjector(
+        [FaultSpec("rpc.send", times=1, args={"mode": "drop"}, seed=0)],
+        seed=0,
+    )
+    assert inj.mangle_line("rpc.send", line) is None
+    assert inj.mangle_line("rpc.send", line) == line  # spec exhausted
+    assert inj.injected["rpc.send"] == 1
+    # corrupt: undecodable as JSON, original prefix preserved for logs
+    inj = FaultInjector(
+        [FaultSpec("rpc.send", times=1, args={"mode": "corrupt"}, seed=0)],
+        seed=0,
+    )
+    corrupted = inj.mangle_line("rpc.send", line)
+    assert corrupted is not None and corrupted != line
+    with pytest.raises(ValueError):
+        json.loads(corrupted)
+    # delay: returns the line intact, late
+    inj = FaultInjector(
+        [FaultSpec("rpc.recv", times=1,
+                   args={"mode": "delay", "delay_ms": 50}, seed=0)],
+        seed=0,
+    )
+    t0 = _time.monotonic()
+    assert inj.mangle_line("rpc.recv", line) == line
+    assert _time.monotonic() - t0 >= 0.045
+    # unknown mode raises loudly at fire time (the config validator
+    # catches it earlier on the config path)
+    inj = FaultInjector(
+        [FaultSpec("rpc.send", times=1, args={"mode": "zap"}, seed=0)],
+        seed=0,
+    )
+    with pytest.raises(ValueError, match="unknown rpc fault mode"):
+        inj.mangle_line("rpc.send", line)
+
+
+def test_mangle_line_probabilistic_determinism():
+    """Same (seed, site) => the same traversals are mangled — a chaos
+    failure on the pipe reproduces byte-for-byte."""
+    from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+
+    def pattern(seed):
+        inj = FaultInjector(
+            [FaultSpec("rpc.recv", times=0, probability=0.4,
+                       args={"mode": "drop"}, seed=seed)],
+            seed=seed,
+        )
+        return [
+            inj.mangle_line("rpc.recv", f"line-{i}") is None
+            for i in range(40)
+        ]
+
+    first = pattern(seed=11)
+    assert first == pattern(seed=11)
+    assert any(first) and not all(first)  # 0.4: some dropped, some not
+    assert first != pattern(seed=12)  # a different seed moves the draws
+
+
+def test_build_fault_injector_from_dict():
+    from deepspeed_tpu.resilience.faults import (
+        NULL_INJECTOR,
+        build_fault_injector_from_dict,
+    )
+
+    assert build_fault_injector_from_dict(None) is NULL_INJECTOR
+    assert build_fault_injector_from_dict({"enabled": False}) is NULL_INJECTOR
+    assert build_fault_injector_from_dict(
+        {"enabled": True, "faults": []}
+    ) is NULL_INJECTOR
+    inj = build_fault_injector_from_dict({
+        "enabled": True, "seed": 3,
+        "faults": [{"site": "replica.hang", "times": 2,
+                    "args": {"duration_ms": 5}}],
+    })
+    assert inj.enabled
+    assert inj.maybe_stall("replica.hang") is True
+    assert inj.maybe_stall("replica.hang") is True
+    assert inj.maybe_stall("replica.hang") is False  # times exhausted
+    assert inj.injected["replica.hang"] == 2

@@ -16,13 +16,20 @@ import jax.numpy as jnp
 import deepspeed_tpu
 from deepspeed_tpu.inference import RequestRejected
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
 from deepspeed_tpu.serving import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
     FleetOverloaded,
     FleetRouter,
     LeastLoaded,
     PrefixAffinity,
     RateLimited,
+    ReplicaRPCError,
     RoundRobin,
+    SubprocessReplica,
     TokenBucket,
 )
 from deepspeed_tpu.serving.replica import ReplicaBase
@@ -39,7 +46,8 @@ _IDLE_SNAP = {
     "queue_depth": 0, "queue_capacity": 8, "active_slots": 0,
     "free_slots": 2, "num_slots": 2, "health": 0,
     "mean_prefill_ms": 1.0, "mean_decode_ms": 1.0, "requests_shed": 0.0,
-    "restarts_used": 0, "driving": True, "stopped": False,
+    "restarts_used": 0, "requests_completed": 0, "tokens_generated": 0,
+    "driving": True, "stopped": False,
     "driver_failed": False, "alive": True, "failed": False,
 }
 
@@ -68,12 +76,18 @@ class StubReplica(ReplicaBase):
     rejection, explicit failure injection."""
 
     def __init__(self, replica_id, snapshot=None, autofinish=None,
-                 reject_with=None):
+                 reject_with=None, heal_on_restart=False,
+                 restart_autofinish=None):
         super().__init__(replica_id)
         self.snap = dict(_IDLE_SNAP, **(snapshot or {}))
         self.autofinish = autofinish  # tokens to finish with, or None
         self.reject_with = reject_with
+        self.heal_on_restart = heal_on_restart
+        self.restart_autofinish = restart_autofinish
         self.handles = []
+        self.submit_calls = 0
+        self.submit_kwargs = []
+        self.brownouts = []
         self.failed = False
         self.drained = False
         self.shutdowns = 0
@@ -83,6 +97,8 @@ class StubReplica(ReplicaBase):
         return self
 
     def submit(self, prompt_tokens, **kwargs):
+        self.submit_calls += 1
+        self.submit_kwargs.append(dict(kwargs))
         if self.reject_with is not None:
             raise self.reject_with
         handle = StubHandle(prompt_tokens)
@@ -97,16 +113,33 @@ class StubReplica(ReplicaBase):
         snap["alive"] = snap["alive"] and not self.failed
         return snap
 
+    def set_brownout(self, on):
+        self.brownouts.append(bool(on))
+
     def drain(self):
         self.drained = True
 
     def restart(self):
+        # a REAL replica restart fail-finishes anything still in flight
+        # (fresh engine / fresh worker) — the monitor re-routes those
+        for handle in self.handles:
+            if not handle.done:
+                handle._finish([], "error")
         self.restarts += 1
         self.failed = False
+        if self.heal_on_restart:
+            self.snap["active_slots"] = 0
+            self.snap["unresponsive"] = False
+        if self.restart_autofinish is not None:
+            self.autofinish = self.restart_autofinish
         return self
 
     def shutdown(self):
         self.shutdowns += 1
+        # a dead replica's engine/worker fail-finishes whatever it held
+        for handle in self.handles:
+            if not handle.done:
+                handle._finish([], "error")
 
 
 def _stub_router(replicas, **kw):
@@ -795,3 +828,622 @@ def test_subprocess_replica_end_to_end_greedy_parity():
     finally:
         replica.shutdown()
     assert not replica.alive and not replica.failed
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers (serving/breaker.py, docs/serving.md "Circuit breakers")
+# ---------------------------------------------------------------------------
+def test_circuit_breaker_state_machine():
+    """Closed -> open after N CONSECUTIVE failures, exponentially
+    backed-off windows with exactly one half-open probe each, success
+    closes, probe failure re-opens with a doubled window."""
+    clock = [0.0]
+    br = CircuitBreaker(failure_threshold=2, backoff_secs=1.0,
+                        backoff_max_secs=8.0, clock=lambda: clock[0],
+                        seed=3)
+    assert br.state == BREAKER_CLOSED and br.routable()
+    br.record_failure()
+    assert br.state == BREAKER_CLOSED  # 1 < threshold
+    br.record_success()
+    br.record_failure()
+    assert br.state == BREAKER_CLOSED  # success reset the streak
+    br.record_failure()
+    br.record_failure()
+    assert br.state == BREAKER_OPEN
+    assert not br.routable() and not br.allow_request()
+    # window 1: base 1.0s (+ <=10% jitter)
+    assert 1.0 <= br.open_window_remaining <= 1.1
+    clock[0] += 1.2
+    assert br.routable()
+    assert br.allow_request()           # THE probe ticket
+    assert br.state == BREAKER_HALF_OPEN
+    assert not br.allow_request()       # one probe per window, exactly
+    assert not br.routable()
+    br.record_failure()                 # probe failed: re-open, doubled
+    assert br.state == BREAKER_OPEN
+    assert 2.0 <= br.open_window_remaining <= 2.2
+    clock[0] += 2.3
+    assert br.allow_request()
+    br.record_success()                 # probe answered: rejoin
+    assert br.state == BREAKER_CLOSED and br.routable()
+    assert br.consecutive_failures == 0
+
+
+def test_circuit_breaker_backoff_caps_and_jitter_deterministic():
+    clock = [0.0]
+
+    def windows(seed):
+        clock[0] = 0.0
+        br = CircuitBreaker(failure_threshold=1, backoff_secs=1.0,
+                            backoff_max_secs=4.0,
+                            clock=lambda: clock[0], seed=seed)
+        out = []
+        for _ in range(5):
+            br.record_failure()
+            out.append(br.open_window_remaining)
+            clock[0] += br.open_window_remaining + 0.01
+            assert br.allow_request()
+        return out
+
+    first = windows(seed=9)
+    assert first == windows(seed=9)  # same seed => same jitter sequence
+    # the exponential caps at backoff_max (jitter rides on top)
+    assert first[-1] <= 4.0 * 1.1
+    assert first[0] < first[1] < first[2]
+
+
+def test_router_breaker_opens_skips_probes_and_rejoins():
+    """The acceptance pin: a replica failing N consecutive RPCs is
+    skipped by placement while open, receives exactly one half-open
+    probe per backoff window, and rejoins with its state intact (no
+    restart, no eviction, no affinity forget) on probe success."""
+    clock = [0.0]
+    flaky = StubReplica("0", reject_with=ReplicaRPCError("pipe torn"))
+    healthy = StubReplica("1", autofinish=[5])
+    router = _stub_router(
+        [flaky, healthy], clock=lambda: clock[0],
+        breaker_failure_threshold=2, breaker_backoff_secs=1.0,
+    )
+    try:
+        # least-loaded ties break to replica 0: every submit tries the
+        # flaky one first while its breaker is closed
+        assert router.submit([1], max_new_tokens=1).result(5.0) == [5]
+        assert router.breaker_state("0") == BREAKER_CLOSED
+        assert router.submit([1], max_new_tokens=1).result(5.0) == [5]
+        assert router.breaker_state("0") == BREAKER_OPEN
+        calls_when_opened = flaky.submit_calls
+        # open: dropped from the candidate set entirely
+        for _ in range(3):
+            assert router.submit([1], max_new_tokens=1).result(5.0) == [5]
+        assert flaky.submit_calls == calls_when_opened
+        assert [rid for rid, _ in router._candidates()] == ["1"]
+        # window elapses: exactly ONE probe goes through, fails, re-opens
+        clock[0] += 1.2
+        assert router.submit([1], max_new_tokens=1).result(5.0) == [5]
+        assert flaky.submit_calls == calls_when_opened + 1
+        assert router.breaker_state("0") == BREAKER_OPEN
+        assert router.submit([1], max_new_tokens=1).result(5.0) == [5]
+        assert flaky.submit_calls == calls_when_opened + 1  # window shut
+        # replica heals; next window's probe succeeds and it rejoins
+        flaky.reject_with = None
+        flaky.autofinish = [7]
+        clock[0] += 3.0
+        req = router.submit([1], max_new_tokens=1)
+        assert req.result(5.0) == [7] and req.replica_id == "0"
+        assert router.breaker_state("0") == BREAKER_CLOSED
+        # rejoined with state INTACT: the breaker never restarted or
+        # evicted the replica, so pool/affinity state survived untouched
+        assert flaky.restarts == 0 and flaky.shutdowns == 0
+        assert router.evicted_ids == set()
+        snap = router.metrics.snapshot()
+        assert snap["fleet/breaker_opens"] == 2
+        assert snap["fleet/breaker_probes"] == 2
+        router.refresh_telemetry()
+        snap = router.metrics.snapshot()
+        assert snap["fleet/replica0/circuit_state"] == BREAKER_CLOSED
+    finally:
+        router.shutdown()
+
+
+@pytest.mark.parametrize("placement", [
+    "least_loaded", "round_robin", "prefix_affinity", "adapter_affinity",
+])
+def test_open_breaker_excluded_from_every_placement_policy(placement):
+    flaky = StubReplica("0", autofinish=[1])
+    healthy = StubReplica("1", autofinish=[2])
+    router = _stub_router([flaky, healthy], placement=placement,
+                          breaker_failure_threshold=1,
+                          breaker_backoff_secs=60.0)
+    try:
+        router._note_breaker_failure("0", RuntimeError("rpc"))
+        assert router.breaker_state("0") == BREAKER_OPEN
+        for i in range(4):
+            req = router.submit([9, 9, 9, 9, i], max_new_tokens=1)
+            assert req.result(5.0) == [2]
+            assert req.replica_id == "1"
+        assert flaky.submit_calls == 0
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# zombie detection (docs/serving.md "Zombie detection")
+# ---------------------------------------------------------------------------
+def test_zombie_replica_detected_restarted_and_request_rerouted():
+    """A replica with active slots and frozen completion counters is
+    drained-then-restarted after zombie_secs; its in-flight request
+    fail-finishes with the restart and re-routes exactly once."""
+    zombie = StubReplica("0", snapshot={"active_slots": 1},
+                         heal_on_restart=True, restart_autofinish=[99])
+    backup = StubReplica("1", snapshot={"queue_depth": 9}, autofinish=[3])
+    router = _stub_router([zombie, backup], zombie_secs=0.05,
+                          monitor_interval=0.005)
+    try:
+        req = router.submit([1, 2], max_new_tokens=1)
+        assert req.replica_id == "0"  # lands on the (sticking) zombie
+        assert req.result(10.0) == [99]
+        assert req.reroutes == 1
+        assert zombie.restarts == 1
+        assert zombie.drained  # drained-then-restarted, not killed cold
+        snap = router.metrics.snapshot()
+        assert snap["fleet/zombie_restarts"] == 1
+        assert snap["fleet/replica_restarts"] == 1
+        assert router.evicted_ids == set()  # restart sufficed
+    finally:
+        router.shutdown()
+
+
+def test_zombie_past_restart_budget_is_evicted():
+    zombie = StubReplica("0", snapshot={"active_slots": 1})  # never heals
+    backup = StubReplica("1", snapshot={"queue_depth": 9}, autofinish=[3])
+    router = _stub_router([zombie, backup], zombie_secs=0.04,
+                          zombie_restart_budget=1, monitor_interval=0.005)
+    try:
+        req = router.submit([1], max_new_tokens=1)
+        assert req.result(10.0) == [3]  # survives via re-route
+        deadline = time.monotonic() + 10.0
+        while router.evicted_ids != {"0"} and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.evicted_ids == {"0"}
+        snap = router.metrics.snapshot()
+        assert snap["fleet/zombie_restarts"] == 1  # budget 1, then evict
+        assert zombie.restarts == 1
+        assert snap["fleet/replicas_evicted"] == 1
+    finally:
+        router.shutdown()
+
+
+def test_unresponsive_replica_counts_as_zombie():
+    """A live-but-unresponsive worker (snapshot RPCs failing with the
+    process alive) is zombie food even with no visible active slots —
+    frozen is frozen."""
+    hung = StubReplica("0", snapshot={"unresponsive": True, "alive": False},
+                       heal_on_restart=True)
+    router = _stub_router([hung], zombie_secs=0.04, monitor_interval=0.005)
+    try:
+        deadline = time.monotonic() + 10.0
+        while hung.restarts == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hung.restarts == 1
+        assert router.metrics.snapshot()["fleet/zombie_restarts"] == 1
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# brownout degradation (docs/serving.md "Brownout degradation")
+# ---------------------------------------------------------------------------
+def test_brownout_clamps_sheddable_requests_between_thresholds():
+    """The acceptance pin: between brownout_queue_ratio and the shed
+    ratio, priority > 0 requests COMPLETE with max_new_tokens clamped to
+    the floor instead of raising FleetOverloaded; above the shed ratio
+    the existing rejection is unchanged; leaving the band restores full
+    budgets."""
+    full = StubReplica("0", snapshot={"queue_depth": 4}, autofinish=[1])
+    router = _stub_router(
+        [full], shed_queue_ratio=0.75, brownout_queue_ratio=0.5,
+        brownout_max_new_tokens=4,
+    )
+    try:
+        # fill 4/8 = 0.5: inside the brownout band [0.5, 0.75)
+        req = router.submit([1], priority=1, max_new_tokens=32)
+        assert req.result(5.0) == [1]  # completes, NOT FleetOverloaded
+        assert full.submit_kwargs[-1]["max_new_tokens"] == 4
+        assert router.brownout
+        snap = router.metrics.snapshot()
+        assert snap["fleet/brownout"] == 1.0
+        assert snap["fleet/requests_browned_out"] == 1
+        assert full.brownouts[-1] is True  # replicas heard the toggle
+        # priority 0 keeps its full budget even in the band
+        router.submit([1], priority=0, max_new_tokens=32).result(5.0)
+        assert full.submit_kwargs[-1]["max_new_tokens"] == 32
+        # above the shed ratio: rejection behavior unchanged
+        full.snap["queue_depth"] = 7
+        with pytest.raises(FleetOverloaded):
+            router.submit([1], priority=1, max_new_tokens=32)
+        router.submit([1], priority=0, max_new_tokens=32).result(5.0)
+        # queue drains: the monitor's refresh EXITS the brownout window
+        full.snap["queue_depth"] = 0
+        router.refresh_telemetry()
+        assert not router.brownout
+        assert router.metrics.snapshot()["fleet/brownout"] == 0.0
+        assert full.brownouts[-1] is False
+        router.submit([1], priority=1, max_new_tokens=32).result(5.0)
+        assert full.submit_kwargs[-1]["max_new_tokens"] == 32
+    finally:
+        router.shutdown()
+
+
+def test_brownout_requires_config_and_small_requests_uncounted():
+    """Without brownout_queue_ratio the band never engages; requests
+    already under the floor are admitted untouched and uncounted."""
+    full = StubReplica("0", snapshot={"queue_depth": 4}, autofinish=[1])
+    router = _stub_router([full], shed_queue_ratio=0.75)
+    try:
+        router.submit([1], priority=1, max_new_tokens=32).result(5.0)
+        assert full.submit_kwargs[-1]["max_new_tokens"] == 32
+        assert not router.brownout
+        assert router.metrics.snapshot()["fleet/brownout"] == 0.0
+    finally:
+        router.shutdown()
+    full2 = StubReplica("0", snapshot={"queue_depth": 4}, autofinish=[1])
+    router2 = _stub_router([full2], shed_queue_ratio=0.75,
+                           brownout_queue_ratio=0.5,
+                           brownout_max_new_tokens=8)
+    try:
+        router2.submit([1], priority=1, max_new_tokens=2).result(5.0)
+        assert full2.submit_kwargs[-1]["max_new_tokens"] == 2
+        assert router2.metrics.snapshot()[
+            "fleet/requests_browned_out"] == 0
+    finally:
+        router2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# serving-seam fault sites (resilience/faults.py, docs/resilience.md):
+# the chaos matrix — every site injected against a live 2-replica fleet
+# finishes all submitted requests exactly once, or fail-finishes typed.
+# ---------------------------------------------------------------------------
+class _FakeEngine:
+    """The InferenceEngine surface InProcessReplica drives, scripted and
+    jax-free: deterministic answers from the prompt so exactly-once
+    re-routing is assertable bitwise."""
+
+    class _Sched:
+        def __init__(self):
+            self._stop = threading.Event()
+            self.driver_failed = False
+
+        def drain(self):
+            pass
+
+    def __init__(self):
+        self.scheduler = self._Sched()
+
+    def serve_forever(self):
+        pass
+
+    def submit(self, prompt, max_new_tokens=32, **kwargs):
+        handle = StubHandle(prompt)
+        base = int(prompt[-1]) if prompt else 0
+        handle._finish(
+            [(base + i + 1) % 1000 for i in range(int(max_new_tokens))],
+            "max_new_tokens",
+        )
+        return handle
+
+    def load_snapshot(self):
+        return dict(_IDLE_SNAP)
+
+    def close(self):
+        self.scheduler._stop.set()
+
+
+def _expected_answer(prompt, max_new):
+    base = int(prompt[-1])
+    return [(base + i + 1) % 1000 for i in range(max_new)]
+
+
+def test_chaos_router_place_fault_absorbed_by_fallback():
+    """A raising placement policy (chaos site router.place) must cost a
+    fallback choice, never the submission."""
+    from deepspeed_tpu.serving import InProcessReplica
+    from deepspeed_tpu.telemetry.registry import diagnostics_registry
+
+    injector = FaultInjector(
+        [FaultSpec("router.place", times=2, seed=0)], seed=0
+    )
+    replicas = [InProcessReplica(str(i), _FakeEngine) for i in range(2)]
+    router = FleetRouter(replicas, monitor_interval=0.001,
+                         fault_injector=injector).start()
+    try:
+        before = diagnostics_registry().snapshot().get(
+            "internal/suppressed_errors/serving.router_place", 0
+        )
+        reqs = [router.submit([10 + i], max_new_tokens=3) for i in range(4)]
+        for i, req in enumerate(reqs):
+            assert req.result(10.0) == _expected_answer([10 + i], 3)
+            assert req.finish_reason == "max_new_tokens"
+        assert injector.injected["router.place"] == 2
+        after = diagnostics_registry().snapshot()[
+            "internal/suppressed_errors/serving.router_place"
+        ]
+        assert after - before == 2  # absorbed, counted, never silent
+    finally:
+        router.shutdown()
+
+
+def test_chaos_snapshot_stale_fault_survived():
+    """Stale load snapshots skew placement but must never lose or
+    duplicate a request."""
+    from deepspeed_tpu.serving import InProcessReplica
+
+    injector = FaultInjector(
+        [FaultSpec("snapshot.stale", times=3, seed=0)], seed=0
+    )
+    replicas = [
+        InProcessReplica(str(i), _FakeEngine, fault_injector=injector)
+        for i in range(2)
+    ]
+    router = FleetRouter(replicas, monitor_interval=0.001).start()
+    try:
+        reqs = [router.submit([20 + i], max_new_tokens=3) for i in range(6)]
+        for i, req in enumerate(reqs):
+            assert req.result(10.0) == _expected_answer([20 + i], 3)
+        deadline = time.monotonic() + 5.0
+        while (
+            injector.injected.get("snapshot.stale", 0) < 3
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)  # the monitor's snapshot polls finish it off
+        assert injector.injected["snapshot.stale"] == 3
+    finally:
+        router.shutdown()
+
+
+def test_snapshot_stale_fault_freezes_previous_values():
+    """The site's contract at the replica seam: an armed traversal
+    returns the PREVIOUS call's values, bit for bit."""
+    injector = FaultInjector(
+        [FaultSpec("snapshot.stale", times=2, seed=0)], seed=0
+    )
+
+    class Probe(ReplicaBase):
+        def __init__(self):
+            super().__init__("p", fault_injector=injector)
+            self.n = 0
+
+        def _snapshot_now(self):
+            self.n += 1
+            return dict(_IDLE_SNAP, queue_depth=self.n)
+
+    probe = Probe()
+    assert probe.load_snapshot()["queue_depth"] == 1  # nothing cached yet
+    assert probe.load_snapshot()["queue_depth"] == 1  # frozen (fault 1)
+    assert probe.load_snapshot()["queue_depth"] == 1  # frozen (fault 2)
+    assert probe.load_snapshot()["queue_depth"] == 2  # spec exhausted
+    assert probe.n == 2
+
+
+def test_chaos_replica_flap_restart_retried_then_rejoins():
+    """replica.flap: the first restart attempt crashes; the router's
+    retry loop absorbs it and the replica rejoins."""
+    from deepspeed_tpu.serving import InProcessReplica
+
+    # traversals 1-2 are the two initial start()s; the restart is 3
+    injector = FaultInjector(
+        [FaultSpec("replica.flap", after=2, times=1, seed=0)], seed=0
+    )
+    replicas = [
+        InProcessReplica(str(i), _FakeEngine, fault_injector=injector)
+        for i in range(2)
+    ]
+    router = FleetRouter(replicas, monitor_interval=0.001).start()
+    try:
+        assert router.restart_replica("0") is True
+        assert injector.injected["replica.flap"] == 1
+        req = router.submit([30], max_new_tokens=2)
+        assert req.result(10.0) == _expected_answer([30], 2)
+        assert router.evicted_ids == set()
+        assert router.metrics.snapshot()["fleet/replica_restarts"] == 1
+    finally:
+        router.shutdown()
+
+
+def test_chaos_replica_flap_exhausted_restarts_evicts():
+    """A replica that crashes on EVERY restart attempt is condemned and
+    evicted instead of parking in an unroutable limbo."""
+    from deepspeed_tpu.serving import InProcessReplica
+
+    injector = FaultInjector(
+        [FaultSpec("replica.flap", after=2, times=0, seed=0)], seed=0
+    )
+    replicas = [
+        InProcessReplica(str(i), _FakeEngine, fault_injector=injector)
+        for i in range(2)
+    ]
+    router = FleetRouter(replicas, monitor_interval=0.001).start()
+    try:
+        assert router.restart_replica("0") is False
+        deadline = time.monotonic() + 10.0
+        while router.evicted_ids != {"0"} and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.evicted_ids == {"0"}
+        # the survivor keeps serving
+        req = router.submit([40], max_new_tokens=2)
+        assert req.result(10.0) == _expected_answer([40], 2)
+        assert req.replica_id == "1"
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# rpc.* sites + RPC hardening over REAL worker subprocesses (the stub
+# engine keeps them jax-free and fast; serving/worker.py StubWorkerEngine)
+# ---------------------------------------------------------------------------
+def _stub_worker_replica(rid, *, faults=None, config=None, stub=None,
+                         rpc_timeout=0.5, rpc_retries=1):
+    spec = {"stub": dict(stub or {})}
+    if config is not None:
+        spec["config"] = config
+    return SubprocessReplica(
+        rid, spec, start_timeout=90.0, rpc_timeout=rpc_timeout,
+        rpc_retries=rpc_retries, rpc_backoff_secs=0.01,
+        fault_injector=faults,
+    )
+
+
+@pytest.mark.parametrize("site,mode", [
+    ("rpc.send", "drop"),
+    ("rpc.recv", "corrupt"),
+    ("replica.hang", None),
+])
+def test_chaos_matrix_rpc_sites_exactly_once(site, mode):
+    """The pipe-seam chaos matrix against a live 2-replica subprocess
+    fleet: the armed fault costs the flaky replica a breaker trip, and
+    every submission still finishes exactly once with the bitwise
+    expected answer (absorbed by fall-through placement)."""
+    faults0 = None
+    config0 = None
+    # deterministic traversal targeting (telemetry refresh is pushed out
+    # of the way below, so the pipe traffic is exactly: init, the
+    # start() refresh snapshot, then per submit a candidates snapshot
+    # followed by the submit op itself):
+    if site == "replica.hang":
+        # worker-side injector (rides the spec config into the worker
+        # process); its op-loop counting starts AFTER init, so the first
+        # submit is traversal 3 (refresh snap, candidates snap, submit)
+        config0 = {"resilience": {"fault_injection": {
+            "enabled": True,
+            "faults": [{"site": "replica.hang", "after": 2, "times": 1,
+                        "args": {"duration_ms": 900}}],
+        }}}
+    else:
+        # parent-side injector: init/ready (1), refresh snap (2),
+        # candidates snap (3), first submit op/ack (4)
+        faults0 = FaultInjector(
+            [FaultSpec(site, after=3, times=1, args={"mode": mode},
+                       seed=0)],
+            seed=0,
+        )
+    # a small stub delay keeps the finished event strictly AFTER the
+    # submit ack on the pipe, so the armed traversal is the ack
+    r0 = _stub_worker_replica("0", faults=faults0, config=config0,
+                              stub={"delay_secs": 0.05})
+    r1 = _stub_worker_replica("1", stub={"delay_secs": 0.05})
+    router = FleetRouter(
+        [r0, r1], monitor_interval=0.005, telemetry_refresh_secs=3600.0,
+        breaker_failure_threshold=1, breaker_backoff_secs=0.25,
+    ).start()
+    try:
+        reqs = [router.submit([10 + i], max_new_tokens=3) for i in range(4)]
+        for i, req in enumerate(reqs):
+            assert req.result(60.0) == _expected_answer([10 + i], 3)
+            assert req.finish_reason == "max_new_tokens"
+        if faults0 is not None:
+            assert faults0.injected[site] == 1  # pinned per (seed, site)
+        # the transport failure fed the breaker, not a re-route
+        snap = router.metrics.snapshot()
+        assert snap["fleet/breaker_opens"] >= 1
+        assert snap["fleet/requests_rerouted"] == 0
+    finally:
+        router.shutdown()
+
+
+def test_reply_after_timeout_is_dropped_not_matched_later():
+    """Satellite pin: a reply landing AFTER its waiter timed out (an
+    injected rpc.recv delay) is discarded by the reader — it neither
+    leaks in _replies nor gets matched to a later rpc_id."""
+    injector = FaultInjector(
+        [FaultSpec("rpc.recv", after=1, times=1,
+                   args={"mode": "delay", "delay_ms": 700}, seed=0)],
+        seed=0,
+    )
+    replica = _stub_worker_replica(
+        "late", faults=injector, rpc_timeout=0.2, rpc_retries=0
+    )
+    replica.start()
+    try:
+        with pytest.raises(ReplicaRPCError):
+            replica.submit([1, 2], max_new_tokens=2)  # ack arrives late
+        time.sleep(1.2)  # let the delayed ack land (and be dropped)
+        with replica._reply_cond:
+            assert replica._replies == {}
+            assert replica._expected == set()
+        # the transport is healthy again and later rpc_ids are untouched
+        snap = replica.load_snapshot()
+        assert snap["alive"] and not snap.get("unresponsive")
+        handle = replica.submit([3], max_new_tokens=2)
+        assert handle.result(30.0) == _expected_answer([3], 2)
+    finally:
+        replica.shutdown()
+
+
+def test_rpc_retry_absorbs_transient_control_op_failure():
+    """Idempotent control ops (snapshot) retry with backoff through a
+    transient transport fault; the retry is counted, the caller never
+    sees it."""
+    injector = FaultInjector(
+        [FaultSpec("rpc.recv", after=1, times=1,
+                   args={"mode": "delay", "delay_ms": 400}, seed=0)],
+        seed=0,
+    )
+    replica = _stub_worker_replica(
+        "retry", faults=injector, rpc_timeout=0.2, rpc_retries=2
+    )
+    replica.start()
+    try:
+        snap = replica.load_snapshot()  # first attempt eats the delay
+        assert snap["alive"] and not snap.get("unresponsive")
+        assert replica.rpc_retries_used >= 1
+    finally:
+        replica.shutdown()
+
+
+def test_hung_worker_reads_unresponsive_not_failed():
+    """A worker whose op loop stalls past the retry budget is classified
+    UNRESPONSIVE (alive process, no answers) — not failed: it must not
+    be mistaken for a corpse and evicted over one long pause."""
+    config = {"resilience": {"fault_injection": {
+        "enabled": True,
+        # worker op-loop counting starts after init: the first snapshot
+        # op below is traversal 1
+        "faults": [{"site": "replica.hang", "times": 1,
+                    "args": {"duration_ms": 700}}],
+    }}}
+    replica = _stub_worker_replica(
+        "hung", config=config, rpc_timeout=0.1, rpc_retries=0
+    )
+    replica.start()
+    try:
+        snap = replica.load_snapshot()  # snapshot op triggers the stall
+        assert snap.get("unresponsive") is True
+        assert snap["failed"] is False and snap["alive"] is False
+        time.sleep(1.0)  # the stall passes; the worker answers again
+        snap = replica.load_snapshot()
+        assert snap["alive"] and not snap.get("unresponsive")
+    finally:
+        replica.shutdown()
+
+
+def test_zombie_subprocess_hang_engine_restarted_and_rerouted():
+    """End to end over real processes: a worker whose ENGINE wedges
+    (accepts work, never finishes it) is zombie-detected from its frozen
+    completion counters, drained-then-restarted, and its request
+    re-routes to the survivor."""
+    r0 = _stub_worker_replica("0", stub={"hang": True})
+    r1 = _stub_worker_replica("1")
+    router = FleetRouter(
+        [r0, r1], monitor_interval=0.01, zombie_secs=0.4,
+        zombie_restart_budget=2, placement="round_robin",
+    ).start()
+    try:
+        req = router.submit([50], max_new_tokens=2)  # round-robin: r0
+        assert req.replica_id == "0"
+        assert req.result(120.0) == _expected_answer([50], 2)
+        assert req.replica_id == "1" and req.reroutes == 1
+        snap = router.metrics.snapshot()
+        assert snap["fleet/zombie_restarts"] == 1
+        assert router.evicted_ids == set()
+    finally:
+        router.shutdown()
