@@ -201,6 +201,41 @@ def _measure_engine_unfused(engine, batch, warmup_windows, measure_windows,
 # workers: run exactly ONE attempt in this process; print JSON on success,
 # exit(OOM_EXIT) when the attempt doesn't fit.
 # ---------------------------------------------------------------------------
+def _agreeing_draft_target(cfg, params_host, draft_layers):
+    """Build a zero-residual agreeing draft/target pair for the
+    speculative-decoding scenarios: zero the residual-path OUTPUT
+    projections (attn_ow/output_w + biases) of every layer >=
+    ``draft_layers`` in a copy of ``params_host``, so the deep target's
+    logits equal a ``draft_layers``-layer truncation's by construction
+    (acceptance ceiling 1.0 — the bench measures the speculative
+    MACHINERY, not draft quality). Returns ``(target_params,
+    draft_model, draft_params)``; both bench sites and the unit suite's
+    ``_agreeing_pair`` (tests/unit/test_speculative.py) rely on this
+    exact key set, so a residual-path param change must update both."""
+    import copy
+
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    tgt = copy.deepcopy(params_host)
+    th = tgt["transformer"]["h"]
+    for key in ("attn_ow", "output_w", "attn_ob", "output_b"):
+        arr = np.array(th[key])
+        arr[draft_layers:] = 0.0
+        th[key] = arr
+    dcfg = GPT2Config(
+        vocab_size=cfg.vocab_size, n_positions=cfg.n_positions,
+        n_embd=cfg.n_embd, n_layer=draft_layers, n_head=cfg.n_head,
+        dropout=0.0, use_flash=False,
+    )
+    dmodel = GPT2LMHeadModel(dcfg)
+    dparams = copy.deepcopy(tgt)
+    dparams["transformer"]["h"] = {
+        k: np.array(v)[:draft_layers]
+        for k, v in tgt["transformer"]["h"].items()
+    }
+    return tgt, dmodel, dparams
+
+
 def _host_init(init_model, *example_args):
     """Initialize params on the host CPU (param shapes don't depend on the
     attention impl; Pallas doesn't lower on the CPU backend, so callers
@@ -1023,12 +1058,15 @@ def bench_infer():
     def phase_breakdown(engine):
         """Per-phase means from the tracer's span ring: where a
         request's wall time actually went (queue vs prefill vs decode
-        steps) — the attribution the aggregate TTFT histogram can't
-        give."""
+        steps — and on a speculative engine, each decode step's
+        draft/verify/commit split) — the attribution the aggregate TTFT
+        histogram can't give."""
         agg = {}
         for span in engine.tracer.flight_snapshot():
             if span["name"] in (
-                "sched.queue", "sched.prefill", "sched.decode_step"
+                "sched.queue", "sched.prefill", "sched.decode_step",
+                "sched.spec_draft", "sched.spec_verify",
+                "sched.spec_commit",
             ):
                 agg.setdefault(span["name"], []).append(span["dur_ms"])
         return {
@@ -1083,9 +1121,26 @@ def bench_infer():
     paged = build(paged=True)
     out_p = measure(paged)
 
-    # prefix-hit vs cold TTFT on templated prompts (96-token shared
-    # header = 3 full pages, 8-token unique tail). Averaged over repeats;
-    # each repeat's template differs so every cold is genuinely cold.
+    paged.close()
+
+    # prefix-hit vs cold TTFT on templated prompts (240-token shared
+    # header = 7 full pages, 8-token unique tail, through a 256-token
+    # prefill window so the COLD side pays a real prompt's compute —
+    # with the 128-window the ratio sat within noise of the 2x gate on
+    # fast hosts: the hit's ~constant dispatch+sample overhead bounds
+    # it, and the gate is about COMPUTE scaling with the suffix, not
+    # the prompt). Averaged over repeats; each repeat's template
+    # differs so every cold is genuinely cold.
+    prefix_engine = deepspeed_tpu.init_inference(
+        model=model, model_parameters=params,
+        config={"inference": {
+            "max_batch_slots": SLOTS, "max_seq_len": 512,
+            "prefill_len": 256, "sampling": {"greedy": True},
+            "kv_block_size": 32, "kv_pool_blocks": 40,
+            "prefix_cache": {"suffix_buckets": [16, 32, 64, 128]},
+        }},
+    )
+
     def ttft_of(engine, p):
         r = engine.submit(p, max_new_tokens=2)
         engine.scheduler.run_until_idle()
@@ -1093,23 +1148,122 @@ def bench_infer():
         return (r.first_token_at - r.submitted_at) * 1e3
 
     # warm the hit path's suffix-prefill program (first hit compiles it)
-    w_template = prompt(96, 99)
-    ttft_of(paged, w_template + prompt(8, 98))
-    ttft_of(paged, w_template + prompt(8, 97))
+    w_template = prompt(240, 99)
+    ttft_of(prefix_engine, w_template + prompt(8, 98))
+    ttft_of(prefix_engine, w_template + prompt(8, 97))
     cold_ms, hit_ms = [], []
     for rep in range(5):
-        template = prompt(96, 100 + rep)
-        cold_ms.append(ttft_of(paged, template + prompt(8, 200 + rep)))
-        hit_ms.append(ttft_of(paged, template + prompt(8, 300 + rep)))
+        template = prompt(240, 100 + rep)
+        cold_ms.append(
+            ttft_of(prefix_engine, template + prompt(8, 200 + rep))
+        )
+        hit_ms.append(
+            ttft_of(prefix_engine, template + prompt(8, 300 + rep))
+        )
     cold_ttft = sum(cold_ms) / len(cold_ms)
     hit_ttft = sum(hit_ms) / len(hit_ms)
-    hits = paged.metrics.counter("infer/prefix_hits").value
-    paged.close()
+    hits = prefix_engine.metrics.counter("infer/prefix_hits").value
+    prefix_engine.close()
     assert hits >= 5, f"expected 5 prefix hits, saw {hits}"
     speedup = cold_ttft / max(hit_ttft, 1e-9)
     assert speedup >= 2.0, (
         f"prefix-hit TTFT {hit_ttft:.1f}ms is not >= 2x faster than cold "
         f"{cold_ttft:.1f}ms (x{speedup:.2f})"
+    )
+
+    # ---- speculative decoding at batch 1 (docs/inference.md
+    # "Speculative decoding"): the draft/target pair is CONSTRUCTED to
+    # agree — the draft carries the target's first DRAFT_LAYERS blocks
+    # (plus embeddings/ln_f) and the target's remaining blocks are
+    # zero-residual (attn_ow/output_w/biases = 0: a pre-LN block with a
+    # zero output projection contributes exactly 0.0 to the stream), so
+    # acceptance sits at its ceiling while the target still pays
+    # full-depth compute per verify. The scenario TARGET is deeper than
+    # the latency rows' model (default 2x layers) so the draft/target
+    # cost ratio mirrors the shallow-drafts-for-deep-targets geometry
+    # speculative decoding exists for (355M drafting for the 48-layer
+    # 1.5B — GPT2_MODELS carries both; the LM head, which both models
+    # pay per proposal, caps how cheap a same-width draft can get). It
+    # measures the speculative MACHINERY's throughput at reported
+    # acceptance — real-model acceptance is workload-dependent, which
+    # is why the rate is a first-class output. Greedy parity vs the
+    # unfused non-speculative reference is asserted bitwise; the >= 2x
+    # batch-1 DECODE tokens/sec gate (first token to completion —
+    # prefill is TTFT's story, measured above) is the ISSUE-11
+    # acceptance criterion.
+    spec_layers = int(os.environ.get(
+        "BENCH_SPEC_TARGET_LAYERS", 2 * cfg.n_layer
+    ))
+    draft_layers = int(os.environ.get(
+        "BENCH_SPEC_DRAFT_LAYERS", max(1, cfg.n_layer // 4)
+    ))
+    spec_k = int(os.environ.get("BENCH_SPEC_K", 8))
+    scfg = GPT2Config(
+        vocab_size=cfg.vocab_size, n_positions=cfg.n_positions,
+        n_embd=cfg.n_embd, n_layer=spec_layers, n_head=cfg.n_head,
+        dropout=0.0, use_flash=False,
+    )
+    smodel = GPT2LMHeadModel(scfg)
+    sids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 8)), jnp.int32)
+    sparams = smodel.init(
+        {"params": jax.random.PRNGKey(2), "dropout": jax.random.PRNGKey(3)},
+        sids, sids,
+    )["params"]
+    host, dmodel, dparams = _agreeing_draft_target(
+        scfg, jax.tree_util.tree_map(np.asarray, sparams), draft_layers
+    )
+
+    def build_spec(speculative):
+        block = {"max_batch_slots": SLOTS, "max_seq_len": MAX_SEQ,
+                 "prefill_len": PREFILL, "sampling": {"greedy": True},
+                 "kv_block_size": 32, "kv_pool_blocks": 40}
+        kw = {}
+        if speculative:
+            block["speculative"] = {"k": spec_k}
+            kw = dict(draft_model=dmodel, draft_parameters=dparams)
+        return deepspeed_tpu.init_inference(
+            model=smodel, model_parameters=host,
+            config={
+                "inference": block,
+                "telemetry": {
+                    "enabled": True, "output_path": trace_tmp,
+                    "job_name": f"infer_spec_{speculative}",
+                    "exporters": [], "watchdog": {"enabled": False},
+                    "tracing": {"enabled": True, "ring_events": 8192,
+                                "export": "none"},
+                },
+            },
+            **kw,
+        )
+
+    SPEC_NEW = 48
+
+    def batch1_decode_tps(engine, seed):
+        engine.generate([prompt(64, 90)], max_new_tokens=4)  # warm
+        r = engine.submit(prompt(64, seed), max_new_tokens=SPEC_NEW)
+        engine.scheduler.run_until_idle()
+        done = time.monotonic()
+        out = r.result(0)
+        return (SPEC_NEW - 1) / (done - r.first_token_at), out
+
+    e_plain = build_spec(speculative=False)
+    tps_plain, out_plain = batch1_decode_tps(e_plain, 91)
+    e_plain.close()
+    e_spec = build_spec(speculative=True)
+    tps_spec, out_spec = batch1_decode_tps(e_spec, 91)
+    assert out_spec == out_plain, (
+        "speculative greedy output diverged from the non-speculative "
+        "reference"
+    )
+    spec_snap = e_spec.metrics.snapshot()
+    acceptance = spec_snap["infer/spec_acceptance_rate"]
+    spec_phases = phase_breakdown(e_spec)
+    e_spec.close()
+    spec_speedup = tps_spec / max(tps_plain, 1e-9)
+    assert spec_speedup >= 2.0, (
+        f"speculative batch-1 decode {tps_spec:.1f} tok/s is not >= 2x "
+        f"the non-speculative {tps_plain:.1f} tok/s (x{spec_speedup:.2f},"
+        f" acceptance {acceptance:.2f})"
     )
 
     result = {
@@ -1128,6 +1282,18 @@ def bench_infer():
                 "cold_ttft_ms": round(cold_ttft, 3),
                 "hit_ttft_ms": round(hit_ttft, 3),
                 "ttft_speedup": round(speedup, 2),
+            },
+            "speculative": {
+                "decode_tokens_per_sec_batch1": round(tps_spec, 2),
+                "nonspec_decode_tokens_per_sec_batch1": round(
+                    tps_plain, 2
+                ),
+                "vs_nonspec_batch1": round(spec_speedup, 2),
+                "acceptance_rate": round(float(acceptance), 3),
+                "draft_layers": draft_layers,
+                "target_layers": spec_layers,
+                "k": spec_k,
+                "phase_breakdown_ms": spec_phases,
             },
         },
     }
@@ -1287,6 +1453,124 @@ def smoke_infer_paged():
             "pool_reclaimed": int(
                 snap.get("infer/kv_blocks_reclaimed", 0)
             ),
+        },
+    }))
+
+
+def smoke_spec():
+    """CI fast path (``python bench.py --smoke-spec``): speculative
+    decoding + the fused Pallas decode path (docs/inference.md "Fused
+    decode attention" / "Speculative decoding") on a tiny CPU GPT-2.
+    Asserts the acceptance invariants:
+
+      - PARITY: the speculative engine's greedy tokens are
+        bitwise-identical to a FUSED non-speculative paged engine's
+        across a mixed workload with a mid-flight join (chaining both
+        new decode paths to the XLA truth the unit tests pin);
+      - ACCEPTANCE > 0: the draft's proposals actually commit (the
+        draft is the target's first block, the target's upper blocks
+        zero-residual, so the pair agrees by construction);
+      - NO RECOMPILES: scheduler steps whose bursts commit different
+        token counts (acceptance length is DATA) add zero XLA backend
+        compiles after warmup;
+      - the infer/spec_* telemetry streams move.
+
+    Prints one JSON line and exits non-zero on any failed check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    VOCAB = 128
+    cfg = GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False,
+    )
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    ids0 = jnp.asarray(rng.integers(0, VOCAB, (1, 8)), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids0, ids0,
+    )["params"]
+    # zero-residual upper block => target logits == 1-layer draft logits
+    tgt, dmodel, dparams = _agreeing_draft_target(
+        cfg, jax.tree_util.tree_map(np.asarray, params), draft_layers=1
+    )
+
+    def prompt(n, seed):
+        return [int(t)
+                for t in np.random.default_rng(seed).integers(0, VOCAB, n)]
+
+    block = {"max_batch_slots": 4, "max_seq_len": 48, "prefill_len": 32,
+             "kv_block_size": 8, "sampling": {"greedy": True}}
+    # the reference runs the FUSED (non-speculative) path, the other
+    # engine the speculative path: one parity check covers both new
+    # decode paths against each other (each is separately pinned
+    # against the XLA truth in the unit suites)
+    e_ref = deepspeed_tpu.init_inference(
+        model=model, model_parameters=tgt,
+        config={"inference": dict(block, fused_decode=True)},
+    )
+    e_spec = deepspeed_tpu.init_inference(
+        model=model, model_parameters=tgt,
+        config={"inference": dict(block, speculative={"k": 3})},
+        draft_model=dmodel, draft_parameters=dparams,
+    )
+
+    # PARITY over a mixed workload
+    prompts = [prompt(9, 1), prompt(5, 2), prompt(13, 3)]
+    ref_out = e_ref.generate(prompts, max_new_tokens=10)
+    spec_out = e_spec.generate(prompts, max_new_tokens=10)
+    assert spec_out == ref_out, "speculative greedy output diverged"
+
+    # NO RECOMPILES across varied acceptance lengths + a mid-flight join
+    recompiles = e_spec.metrics.counter("jax/recompiles")
+    warm = recompiles.value
+    assert warm > 0
+    r1 = e_spec.submit(prompt(8, 4), max_new_tokens=12)
+    r1r = e_ref.submit(prompt(8, 4), max_new_tokens=12)
+    e_spec.scheduler.step()
+    e_ref.scheduler.step()
+    r2 = e_spec.submit(prompt(7, 5), max_new_tokens=8)
+    r2r = e_ref.submit(prompt(7, 5), max_new_tokens=8)
+    e_spec.scheduler.run_until_idle()
+    e_ref.scheduler.run_until_idle()
+    assert r1.result(0) == r1r.result(0)
+    assert r2.result(0) == r2r.result(0)
+    spec_recompiles = int(recompiles.value - warm)
+    assert spec_recompiles == 0, (
+        f"{spec_recompiles} recompiles across acceptance lengths"
+    )
+
+    # ACCEPTANCE > 0 and the spec_* streams move
+    snap = e_spec.metrics.snapshot()
+    assert snap["infer/spec_proposed"] > 0, "no proposals counted"
+    assert snap["infer/spec_accepted"] > 0, "zero draft tokens accepted"
+    acceptance = snap["infer/spec_acceptance_rate"]
+    assert acceptance > 0, "acceptance rate stayed 0"
+    # multi-token commits: fewer decode steps than tokens generated
+    steps = snap["infer/token_latency_ms/count"]
+    tokens = snap["infer/tokens_generated"]
+    assert steps < tokens, (steps, tokens)
+    assert e_ref.metrics.gauge("infer/fused_decode").value == 1
+    e_ref.close()
+    e_spec.close()
+
+    print(json.dumps({
+        "metric": "smoke_speculative_fused_decode",
+        "value": 1.0,
+        "unit": "pass",
+        "vs_baseline": 1.0,
+        "extras": {
+            "acceptance_rate": round(float(acceptance), 3),
+            "spec_proposed": int(snap["infer/spec_proposed"]),
+            "spec_accepted": int(snap["infer/spec_accepted"]),
+            "decode_steps": int(steps),
+            "tokens_generated": int(tokens),
+            "recompiles_after_warmup": spec_recompiles,
         },
     }))
 
@@ -2067,6 +2351,9 @@ def main():
         return
     if "--smoke-infer-paged" in sys.argv:
         smoke_infer_paged()
+        return
+    if "--smoke-spec" in sys.argv:
+        smoke_spec()
         return
     if "--infer" in sys.argv:
         bench_infer()

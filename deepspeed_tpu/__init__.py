@@ -83,6 +83,8 @@ def init_inference(
     mesh=None,
     param_specs=None,
     rng_seed=0,
+    draft_model=None,
+    draft_parameters=None,
 ):
     """Build a continuous-batching serving engine around ``model``
     (deepspeed_tpu/inference/, docs/inference.md): KV-cache decode,
@@ -91,6 +93,9 @@ def init_inference(
     and the ``submit``/``serve_forever`` server mode. The reference
     stopped at training; this is the serving act on top of the same
     sharded params, mesh, telemetry, and verified-checkpoint layers.
+    ``draft_model``/``draft_parameters`` supply the draft for
+    speculative decoding (the ``inference.speculative`` block,
+    docs/inference.md "Speculative decoding").
     """
     from .inference.engine import init_inference as _init_inference
 
@@ -101,6 +106,8 @@ def init_inference(
         mesh=mesh,
         param_specs=param_specs,
         rng_seed=rng_seed,
+        draft_model=draft_model,
+        draft_parameters=draft_parameters,
     )
 
 

@@ -511,6 +511,26 @@ class DeepSpeedConfig:
             inf_dict, C.INFERENCE_KV_POOL_BLOCKS,
             C.INFERENCE_KV_POOL_BLOCKS_DEFAULT,
         )
+        self.inference_fused_decode = get_scalar_param(
+            inf_dict, C.INFERENCE_FUSED_DECODE,
+            C.INFERENCE_FUSED_DECODE_DEFAULT,
+        )
+        # the speculative block's PRESENCE is the enable switch (its keys
+        # all have workable defaults); the raw dict is kept for the
+        # unknown-key check — a typo'd "k" must not mean "default k"
+        self.inference_speculative_enabled = (
+            inf_dict.get(C.INFERENCE_SPECULATIVE) is not None
+        )
+        spec_dict = get_dict_param(inf_dict, C.INFERENCE_SPECULATIVE)
+        self._inference_speculative_raw = spec_dict
+        self.inference_speculative_k = get_scalar_param(
+            spec_dict, C.INFERENCE_SPECULATIVE_K,
+            C.INFERENCE_SPECULATIVE_K_DEFAULT,
+        )
+        self.inference_speculative_draft_checkpoint = get_scalar_param(
+            spec_dict, C.INFERENCE_SPECULATIVE_DRAFT_CHECKPOINT,
+            C.INFERENCE_SPECULATIVE_DRAFT_CHECKPOINT_DEFAULT,
+        )
         pc_dict = get_dict_param(inf_dict, C.INFERENCE_PREFIX_CACHE)
         self.inference_prefix_cache_enabled = get_scalar_param(
             pc_dict, C.INFERENCE_PREFIX_CACHE_ENABLED,
@@ -1287,6 +1307,54 @@ class DeepSpeedConfig:
                 f"logical extent must equal the contiguous cache's "
                 f"(the bitwise-parity contract)"
             )
+        fused = self.inference_fused_decode
+        if not isinstance(fused, bool):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FUSED_DECODE} must be a "
+                f"boolean, got {fused!r}"
+            )
+        if fused and bs == 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_FUSED_DECODE} requires the "
+                f"paged cache: the flash-decode kernel streams KV PAGES "
+                f"through the block table (set "
+                f"{C.INFERENCE_KV_BLOCK_SIZE} > 0)"
+            )
+        if self.inference_speculative_enabled:
+            spec = self._inference_speculative_raw
+            known = {
+                C.INFERENCE_SPECULATIVE_K,
+                C.INFERENCE_SPECULATIVE_DRAFT_CHECKPOINT,
+            }
+            unknown = set(spec) - known
+            if unknown:
+                # a typo'd "k" must not silently mean "default k=4"
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{C.INFERENCE_SPECULATIVE}: unknown "
+                    f"keys {sorted(unknown)}; valid: {sorted(known)}"
+                )
+            k = self.inference_speculative_k
+            if not isinstance(k, int) or isinstance(k, bool) or k < 1:
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{C.INFERENCE_SPECULATIVE}."
+                    f"{C.INFERENCE_SPECULATIVE_K} must be an integer >= 1 "
+                    f"draft tokens per step, got {k!r}"
+                )
+            ckpt = self.inference_speculative_draft_checkpoint
+            if not isinstance(ckpt, str):
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{C.INFERENCE_SPECULATIVE}."
+                    f"{C.INFERENCE_SPECULATIVE_DRAFT_CHECKPOINT} must be "
+                    f"a path string ('' = serve the passed-in draft "
+                    f"parameters), got {ckpt!r}"
+                )
+            if bs == 0:
+                raise DeepSpeedConfigError(
+                    f"{C.INFERENCE}.{C.INFERENCE_SPECULATIVE} requires "
+                    f"the paged cache: the target's batched verify step "
+                    f"writes through the block tables (set "
+                    f"{C.INFERENCE_KV_BLOCK_SIZE} > 0)"
+                )
         pc = self.inference_prefix_cache_enabled
         if pc is not None and not isinstance(pc, bool):
             raise DeepSpeedConfigError(

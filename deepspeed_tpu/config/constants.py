@@ -550,6 +550,34 @@ INFERENCE_PREFIX_CACHE_ENABLED = "enabled"
 INFERENCE_PREFIX_CACHE_ENABLED_DEFAULT = None
 INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS = "suffix_buckets"
 INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS_DEFAULT = None
+# Fused decode attention (docs/inference.md "Fused decode attention"):
+# swaps the paged decode step's gather-then-einsum attention for the
+# Pallas single-query flash-decode kernel
+# (ops/decode_attention.py:paged_flash_decode) — the slot's live KV
+# pages stream through VMEM via the block table with an online softmax,
+# no [slots, heads, max_len, hd] gathered temporary, and zero-length
+# (dead) slots early-out. Requires the paged cache (kv_block_size > 0);
+# the XLA path stays the greedy-parity reference. Off-TPU the kernel
+# runs in Pallas interpret mode, so the switch is testable everywhere.
+INFERENCE_FUSED_DECODE = "fused_decode"
+INFERENCE_FUSED_DECODE_DEFAULT = False
+# Speculative decoding (docs/inference.md "Speculative decoding"): a
+# small DRAFT model proposes k greedy tokens per scheduler step and the
+# target verifies all of them in ONE fixed-shape batched step against
+# the paged cache — the accepted prefix plus the target's correction
+# token commit together, so a decode step yields up to k+1 tokens.
+# Greedy output is bitwise-identical to the non-speculative path by
+# construction (every committed token is the target's own argmax). k is
+# static (zero steady-state recompiles; acceptance length is data);
+# draft_checkpoint optionally loads the draft's params through the
+# verified-load path (the draft module itself is passed to
+# init_inference as draft_model/draft_parameters). Requires the paged
+# cache and greedy sampling.
+INFERENCE_SPECULATIVE = "speculative"
+INFERENCE_SPECULATIVE_K = "k"
+INFERENCE_SPECULATIVE_K_DEFAULT = 4
+INFERENCE_SPECULATIVE_DRAFT_CHECKPOINT = "draft_checkpoint"
+INFERENCE_SPECULATIVE_DRAFT_CHECKPOINT_DEFAULT = ""
 # Optional checkpoint to serve from: loaded through the resilience
 # verified-load path (manifest check + host-side parse + newest-valid
 # fallback) before params pin to device shardings.

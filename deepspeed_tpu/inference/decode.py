@@ -48,6 +48,16 @@ per-slot GATHERED A/B matmuls (ops/transformer.py:apply_lora): ids are
 arrays, not shapes, so a batch mixing any adapters (including ids never
 seen before) runs the one compiled program — the same indirection trick
 as the block tables (pinned in tests/unit/test_adapters.py).
+
+Two perf modes stack on the paged layout (docs/inference.md "Fused
+decode attention" / "Speculative decoding"): ``fused=True`` routes the
+decode step's attention through the Pallas single-query flash-decode
+kernel and the LoRA deltas through the SGMV kernel
+(ops/decode_attention.py) — greedy-parity, not bitwise-logit,
+equivalent to the XLA path — and the engine's speculative mode reuses
+:func:`gpt2_prefill_suffix` as the target's one-shot batched VERIFY
+step over draft proposals (per-slot start positions; writes past the
+sequence cap sink to the null page).
 """
 
 import typing
@@ -102,13 +112,17 @@ def _final_norm_and_logits(config, tp, x):
     return x @ tp["wte"].T
 
 
-def _layer_lora(adapters, adapter_ids, lora_scale):
+def _layer_lora(adapters, adapter_ids, lora_scale, fused=False):
     """(scan-xs adapter pytree, per-layer lora builder) pair: with no
     adapter pool the xs contribution is an EMPTY pytree and every layer
     sees ``lora=None`` — the traced ops are exactly the pre-adapter
-    program's, which is what keeps adapter-disabled engines bitwise."""
+    program's, which is what keeps adapter-disabled engines bitwise.
+    ``fused`` routes decode-shaped apply_lora calls through the Pallas
+    SGMV kernel (ops/decode_attention.py) instead of the XLA gather."""
     if adapters is None:
         return {}, lambda ad: None
+    if fused:
+        return dict(adapters), lambda ad: (ad, adapter_ids, lora_scale, True)
     return dict(adapters), lambda ad: (ad, adapter_ids, lora_scale)
 
 
@@ -250,7 +264,8 @@ def write_prefill_to_pool(pool: KVPool, ks, vs, block_ids, offsets):
 
 def gpt2_decode_step_paged(config, params, tokens, positions,
                            pool: KVPool, block_tables, adapters=None,
-                           adapter_ids=None, lora_scale=1.0):
+                           adapter_ids=None, lora_scale=1.0,
+                           fused=False):
     """One incremental token for every slot over the paged pool — the
     block-table twin of :func:`gpt2_decode_step` (identical embedding,
     layer-scan, and head arithmetic through the shared decode core, so
@@ -258,19 +273,25 @@ def gpt2_decode_step_paged(config, params, tokens, positions,
     / ``positions`` are [slots] int32; ``block_tables`` [slots,
     max_blocks] int32 holds physical page ids (0 = null page);
     ``adapter_ids`` [slots] picks each slot's LoRA adapter from the
-    pool (0 = identity). Returns ``(logits [slots, vocab_padded],
-    pool)``."""
+    pool (0 = identity). ``fused`` (``inference.fused_decode``) swaps
+    each layer's attention for the Pallas single-query flash-decode
+    kernel and the gathered LoRA matmuls for the SGMV kernel
+    (ops/decode_attention.py) — greedy-parity (not bitwise-logit)
+    equivalent to the XLA path, which stays the reference. Returns
+    ``(logits [slots, vocab_padded], pool)``."""
     tp = params["transformer"]
     layer_cfg = config.layer_config()
     x = tp["wte"][tokens] + tp["wpe"][positions]  # [slots, H]
     x = x[:, None, :]  # [slots, 1, H]
-    ad_xs, lora_of = _layer_lora(adapters, adapter_ids, lora_scale)
+    ad_xs, lora_of = _layer_lora(
+        adapters, adapter_ids, lora_scale, fused=fused
+    )
 
     def body(x, xs):
         pl, kp, vp, ad = xs
         x, kp, vp = transformer_block_decode_paged(
             layer_cfg, pl, x, kp, vp, block_tables, positions,
-            lora=lora_of(ad),
+            lora=lora_of(ad), fused=fused,
         )
         return x, (kp, vp)
 

@@ -25,6 +25,8 @@ the training engine's streams — and onto a private registry otherwise
 (counting is cheap; tests and the bench smoke read it either way).
 """
 
+import time
+
 import numpy as np
 
 import jax
@@ -73,6 +75,8 @@ class InferenceEngine:
         mesh=None,
         param_specs=None,
         rng_seed=0,
+        draft_model=None,
+        draft_parameters=None,
     ):
         mcfg = getattr(model, "config", None)
         if mcfg is None or not all(
@@ -191,6 +195,100 @@ class InferenceEngine:
             self.kv_pool_blocks = 0
             self.prefix_cache_enabled = False
             self._suffix_buckets = []
+
+        # ---- fused decode attention (docs/inference.md) ---------------
+        # the Pallas flash-decode + SGMV path; the XLA gather path stays
+        # the greedy-parity reference. A pallas_call inside a plain
+        # GSPMD-jitted program is not partitioned (ops/attention.py has
+        # the same constraint), so a multi-device mesh falls back to the
+        # XLA path rather than silently all-gathering the page pool.
+        self.fused_decode = bool(cfg.inference_fused_decode)
+        if self.fused_decode and not self.paged:
+            # config validation catches the explicit case; engine-derived
+            # geometry re-checks here
+            raise DeepSpeedConfigError(
+                "inference.fused_decode requires the paged cache "
+                "(kv_block_size > 0): the kernel streams KV pages "
+                "through the block table"
+            )
+        if (
+            self.fused_decode
+            and dict(self._mesh.shape).get(C.MODEL_AXIS, 1) > 1
+        ):
+            # kv_pool_partition_specs shards HEADS over the model axis;
+            # a pallas_call inside plain GSPMD jit is not partitioned
+            # (XLA would all-gather the whole page pool per step —
+            # ops/attention.py documents the same constraint). With the
+            # model axis at 1 every operand is effectively replicated
+            # and the kernel is safe under any host/device count.
+            log_dist(
+                "inference.fused_decode requested with a model-parallel "
+                "mesh (sharded KV pool heads); a pallas_call is not "
+                "GSPMD-partitioned — falling back to the XLA paged "
+                "decode path",
+                ranks=[0],
+            )
+            self.fused_decode = False
+
+        # ---- speculative decoding geometry (docs/inference.md) --------
+        self.speculative = bool(cfg.inference_speculative_enabled)
+        self.spec_k = int(cfg.inference_speculative_k)
+        if self.speculative and self.fused_decode:
+            # the speculative step's compute is the draft's contiguous
+            # decode plus the target's multi-token verify — the
+            # single-query flash kernel serves NO tokens there. Disable
+            # it (and its gauge) rather than report a kernel that never
+            # ran; a fused multi-query verify is the named follow-up.
+            log_dist(
+                "inference.fused_decode is inert under speculative "
+                "decoding (the verify step is multi-token XLA, the "
+                "draft rides its own contiguous cache) — disabling the "
+                "flag so telemetry reports what actually served",
+                ranks=[0],
+            )
+            self.fused_decode = False
+        if self.speculative:
+            if not self.paged:
+                raise DeepSpeedConfigError(
+                    "inference.speculative requires the paged cache "
+                    "(kv_block_size > 0): the batched verify step "
+                    "writes through the block tables"
+                )
+            if draft_model is None or draft_parameters is None:
+                raise DeepSpeedConfigError(
+                    'the "speculative" inference block is configured '
+                    "but init_inference received no draft: pass "
+                    "draft_model (a smaller GPT-2 module) and "
+                    "draft_parameters (its param tree)"
+                )
+            if not cfg.inference_greedy and cfg.inference_temperature > 0:
+                raise DeepSpeedConfigError(
+                    "speculative decoding preserves exact output for "
+                    "GREEDY decoding only (every committed token is the "
+                    "target's own argmax); set inference.sampling.greedy "
+                    "or temperature 0"
+                )
+            dcfg = getattr(draft_model, "config", None)
+            if dcfg is None or not all(
+                hasattr(dcfg, a) for a in ("n_layer", "n_head", "n_embd",
+                                           "n_positions", "layer_config")
+            ):
+                raise DeepSpeedConfigError(
+                    "draft_model must be a GPT-2-family module (a "
+                    ".config with n_layer/n_head/n_embd/n_positions)"
+                )
+            if getattr(dcfg, "vocab_size", None) != getattr(
+                mcfg, "vocab_size", None
+            ):
+                raise DeepSpeedConfigError(
+                    f"draft vocab_size={getattr(dcfg, 'vocab_size', None)}"
+                    f" != target vocab_size="
+                    f"{getattr(mcfg, 'vocab_size', None)}: proposals are "
+                    "token ids — the vocabularies must match exactly"
+                )
+            self.draft_config = dcfg
+        else:
+            self.draft_config = None
 
         # ---- multi-tenant LoRA geometry (docs/adapters.md) ------------
         self.multi_lora = bool(cfg.adapters_enabled)
@@ -470,6 +568,151 @@ class InferenceEngine:
             )
         )
 
+        # ---- speculative decoding state (docs/inference.md) -----------
+        # the draft rides its own CONTIGUOUS cache (it shares nothing —
+        # no paging/prefix machinery needed for a model this small) and
+        # the slot/length bookkeeping of the target, so draft state
+        # needs no extra accounting: the position-masking invariant
+        # makes rejected-proposal cache rows harmless exactly like dead-
+        # slot ride-along writes.
+        if self.speculative:
+            dcfg = self.draft_config
+            if dcfg.n_positions < self.max_seq_len:
+                raise DeepSpeedConfigError(
+                    f"draft n_positions={dcfg.n_positions} < resolved "
+                    f"max_seq_len={self.max_seq_len}: the draft must "
+                    "reach every position the target serves"
+                )
+            draft_params = draft_parameters
+            if cfg.inference_speculative_draft_checkpoint:
+                from ..runtime.checkpointing import load_module_state
+
+                loaded, _, dtag = load_module_state(
+                    cfg.inference_speculative_draft_checkpoint,
+                    draft_params,
+                    resilience=self.resilience,
+                )
+                if loaded is None:
+                    raise RuntimeError(
+                        f"no loadable draft checkpoint under "
+                        f"{cfg.inference_speculative_draft_checkpoint!r} "
+                        "(see the resilience/corruption_fallbacks "
+                        "counter and logs)"
+                    )
+                draft_params = loaded
+                log_dist(
+                    f"speculative draft serving checkpoint {dtag}",
+                    ranks=[0],
+                )
+            replicated = NamedSharding(self._mesh, P())
+            self._draft_params = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda p: jnp.asarray(p, self.compute_dtype),
+                    draft_params,
+                ),
+                jax.tree_util.tree_map(lambda _: replicated, draft_params),
+            )
+            self._draft_cache_sharding = KVCache(
+                k=replicated, v=replicated
+            )
+            self._draft_cache = jax.device_put(
+                init_kv_cache(
+                    dcfg, self.num_slots, self.max_seq_len,
+                    self.compute_dtype,
+                ),
+                self._draft_cache_sharding,
+            )
+            # per-slot token at index lengths-1 (the committed token
+            # BEFORE the uncached last) — the propose program's sync
+            # step re-feeds it to close the full-acceptance cache hole
+            self._spec_prev_tokens = np.zeros(self.num_slots, np.int32)
+            draft_vocab = int(dcfg.vocab_size)
+            spec_k = self.spec_k
+
+            def draft_prefill_fn(dp, toks):
+                return gpt2_prefill(dcfg, dp, toks)
+
+            self._jit_draft_prefill = jax.jit(draft_prefill_fn)
+            self._jit_draft_write = jax.jit(
+                write_prefill_to_cache,
+                donate_argnums=(0,) if donate_cache else (),
+            )
+
+            def propose_fn(dp, prev_tokens, tokens, positions, cache):
+                """One sync step + k greedy draft steps under one
+                program: proposals [slots, k]. k is STATIC (the scan
+                length) — acceptance is data, so no steady-state
+                recompiles.
+
+                The SYNC step re-feeds the token at index
+                ``positions - 1`` (the burst's second-to-last commit):
+                after a FULLY-accepted cycle the target committed k+1
+                tokens but the draft's propose only wrote k cache rows,
+                leaving the last accepted proposal's row a hole the
+                next propose would attend as garbage (measured: draft
+                acceptance collapsed to ~0.67 even with draft ==
+                target). For hole-free slots the rewrite recomputes
+                bitwise-identical k/v from an identical cache prefix —
+                a no-op by value."""
+                from .sampling import mask_padded_vocab
+
+                _, cache = gpt2_decode_step(
+                    dcfg, dp, prev_tokens,
+                    jnp.maximum(positions - 1, 0), cache,
+                )
+
+                def body(carry, _):
+                    toks, pos, c = carry
+                    logits, c = gpt2_decode_step(dcfg, dp, toks, pos, c)
+                    nxt = jnp.argmax(
+                        mask_padded_vocab(
+                            logits.astype(jnp.float32), draft_vocab
+                        ),
+                        axis=-1,
+                    ).astype(jnp.int32)
+                    return (nxt, pos + 1, c), nxt
+
+                (_, _, cache), props = jax.lax.scan(
+                    body, (tokens, positions, cache), None, length=spec_k
+                )
+                return jnp.transpose(props), cache  # [slots, k]
+
+            self._jit_draft_propose = jax.jit(
+                propose_fn, donate_argnums=(4,) if donate_cache else ()
+            )
+
+            def verify_fn(p, toks, start, pool, tables, *ad):
+                """ONE fixed-shape batched target step over the k+1
+                verify tokens [last, d_1..d_k] per slot: suffix-prefill
+                arithmetic against the paged cache (k/v written through
+                the block tables, causal attention over prefix +
+                verify rows), greedy-argmaxed per row. Row i is the
+                target's own next token after consuming verify token i —
+                the accept/commit oracle."""
+                apool, aids = _split_ad(ad)
+                logits, pool = gpt2_prefill_suffix(
+                    mcfg, p, toks, start, pool, tables, adapters=apool,
+                    adapter_ids=aids, **lora_kw,
+                )
+                from .sampling import mask_padded_vocab
+
+                greedy = jnp.argmax(
+                    mask_padded_vocab(
+                        logits.astype(jnp.float32),
+                        self._sampling_statics["vocab_size"],
+                    ),
+                    axis=-1,
+                ).astype(jnp.int32)
+                return greedy, pool
+
+            self._jit_spec_verify = jax.jit(
+                verify_fn, donate_argnums=(3,) if donate_cache else ()
+            )
+        # per-step draft/verify/commit phase stats, read by the
+        # scheduler's sched.spec_* span recording (None when the last
+        # step was not speculative)
+        self.spec_step_stats = None
+
         # ---- KV metric streams ----------------------------------------
         self._kv_occupancy = self.metrics.gauge("infer/kv_pool_occupancy")
         self._kv_bytes = self.metrics.gauge("infer/kv_cache_bytes")
@@ -480,6 +723,14 @@ class InferenceEngine:
         self._kv_bytes.set(
             int(self._cache.k.nbytes) + int(self._cache.v.nbytes)
         )
+
+        # ---- fused/speculative streams (docs/observability.md) --------
+        self.metrics.gauge("infer/fused_decode").set(
+            1 if self.fused_decode else 0
+        )
+        self._spec_proposed = self.metrics.counter("infer/spec_proposed")
+        self._spec_accepted = self.metrics.counter("infer/spec_accepted")
+        self._spec_rate = self.metrics.gauge("infer/spec_acceptance_rate")
 
         # ---- adapters/* metric streams (docs/observability.md) --------
         if self.multi_lora:
@@ -569,7 +820,7 @@ class InferenceEngine:
         logits, pool = gpt2_decode_step_paged(
             self.model_config, params, tokens, positions, pool, tables,
             adapters=adapters, adapter_ids=adapter_ids,
-            lora_scale=self.adapter_scale,
+            lora_scale=self.adapter_scale, fused=self.fused_decode,
         )
         next_tokens = sample_tokens(
             logits, key, temps, **self._sampling_statics
@@ -957,6 +1208,24 @@ class InferenceEngine:
                 jnp.full((1,), temperature, jnp.float32),
             )
             first = int(np.asarray(first)[0])
+        if self.speculative:
+            # the draft mirrors the slot: full prompt prefill into its
+            # own contiguous cache (the draft shares no pages, and a
+            # target-side prefix HIT says nothing about the draft's
+            # cache). The draft is small — this rides inside TTFT
+            # without moving it much, and buys every subsequent decode
+            # step its k proposals.
+            dpad = np.zeros((1, self.prefill_len), np.int32)
+            dpad[0, :plen] = prompt_tokens
+            _, dks, dvs = self._jit_draft_prefill(
+                self._draft_params, jnp.asarray(dpad)
+            )
+            self._draft_cache = self._jit_draft_write(
+                self._draft_cache, jnp.int32(slot), dks, dvs
+            )
+            # index lengths-1 == the last PROMPT token (already cached
+            # by the draft prefill; the sync rewrite is value-identical)
+            self._spec_prev_tokens[slot] = int(prompt_tokens[-1])
         if self.paged and self.prefix_cache_enabled and not self._brownout:
             # publish this prompt's full pages so later requests share
             # them (no-op for pages already in the registry; the hash
@@ -1072,6 +1341,17 @@ class InferenceEngine:
             self._slot_prefix_len.clear()
             self._slot_hashes.clear()
             self._sync_pool_metrics()
+        if self.speculative:
+            # the draft's cache died with the crashed step too; its
+            # params, like the target's, never left device
+            self._draft_cache = jax.device_put(
+                init_kv_cache(
+                    self.draft_config, self.num_slots, self.max_seq_len,
+                    self.compute_dtype,
+                ),
+                self._draft_cache_sharding,
+            )
+            self._spec_prev_tokens[:] = 0
         self._lengths[:] = 0
         self._last_tokens[:] = 0
         if self.multi_lora:
@@ -1089,10 +1369,15 @@ class InferenceEngine:
     def decode_tokens(self, active_slots):
         """One fixed-shape decode step over ALL slots; commits length /
         last-token bookkeeping for ``active_slots`` and returns their
-        sampled tokens as host ints (same order)."""
+        sampled tokens as host ints (same order). On a SPECULATIVE
+        engine each entry is instead a LIST of 1..k+1 committed tokens
+        (the accepted draft prefix plus the target's correction) — the
+        scheduler commits them in order."""
         # fault site: decode-driver crash (resilience/faults.py) — raises
         # through the scheduler's step, exercising the auto-restart path
         self.resilience.faults.maybe_raise("decode.step")
+        if self.speculative:
+            return self._decode_tokens_spec(active_slots)
         self._key, sub = jax.random.split(self._key)
         args = (
             self.params,
@@ -1118,6 +1403,92 @@ class InferenceEngine:
             self._lengths[slot] += 1
             self._last_tokens[slot] = token
             out.append(token)
+        return out
+
+    def _decode_tokens_spec(self, active_slots):
+        """One speculative decode cycle (docs/inference.md "Speculative
+        decoding"): the draft proposes ``k`` greedy tokens per slot
+        (one scanned program), the target verifies all of them in ONE
+        fixed-shape batched step against the paged cache, and the
+        accepted prefix plus the target's correction token commit —
+        every committed token is the target's own argmax, so greedy
+        output is bitwise-identical to the sequential path by
+        construction. Returns one token LIST per active slot.
+
+        Cache hygiene needs no rollback on rejection: rejected
+        proposals' k/v sit at positions BEYOND the committed length, so
+        the causal position mask hides them until the next cycle's
+        verify (target) / propose (draft) overwrites those same rows —
+        the dead-slot ride-along argument applied forward in time."""
+        k = self.spec_k
+        t0 = time.monotonic()
+        props, self._draft_cache = self._jit_draft_propose(
+            self._draft_params,
+            jnp.asarray(self._spec_prev_tokens),
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(self._lengths),
+            self._draft_cache,
+        )
+        props = np.asarray(props)  # [slots, k]
+        t1 = time.monotonic()
+        # verify tokens per slot: [last, d_1 .. d_k] — row i's argmax is
+        # the target's next token after consuming verify token i
+        verify_tokens = np.concatenate(
+            [self._last_tokens[:, None], props], axis=1
+        ).astype(np.int32)
+        args = (
+            self.params,
+            jnp.asarray(verify_tokens),
+            jnp.asarray(self._lengths),
+            self._cache,
+            jnp.asarray(self._block_tables),
+        )
+        if self.multi_lora:
+            args = args + (
+                self._adapter_pool, jnp.asarray(self._slot_adapters),
+            )
+        greedy, self._cache = self._jit_spec_verify(*args)
+        greedy = np.asarray(greedy)  # [slots, k+1]
+        t2 = time.monotonic()
+        out = []
+        proposed = accepted = committed = 0
+        for slot in active_slots:
+            g, pr = greedy[slot], props[slot]
+            j = 0
+            while j < k and pr[j] == g[j]:
+                j += 1
+            # d_1..d_j matched the target's own choices; g[j] is the
+            # target's token at the first divergence (the BONUS token
+            # when everything matched)
+            toks = [int(t) for t in pr[:j]] + [int(g[j])]
+            self._lengths[slot] += len(toks)
+            # token at the new index lengths-1: the burst's second-to-
+            # last commit, or the previous last for a 1-token burst —
+            # what the next propose's sync step re-feeds
+            self._spec_prev_tokens[slot] = (
+                toks[-2] if len(toks) >= 2 else self._last_tokens[slot]
+            )
+            self._last_tokens[slot] = toks[-1]
+            proposed += k
+            accepted += j
+            committed += len(toks)
+            out.append(toks)
+        t3 = time.monotonic()
+        self._spec_proposed.inc(proposed)
+        self._spec_accepted.inc(accepted)
+        total = self._spec_proposed.value
+        self._spec_rate.set(
+            self._spec_accepted.value / total if total else 0.0
+        )
+        # phase stats for the scheduler's sched.spec_* spans — the
+        # draft/verify/commit attribution the flight recorder dumps
+        self.spec_step_stats = {
+            "draft_t0": t0, "draft_t1": t1,
+            "verify_t0": t1, "verify_t1": t2,
+            "commit_t0": t2, "commit_t1": t3,
+            "proposed": proposed, "accepted": accepted,
+            "committed": committed,
+        }
         return out
 
     # -- serving API ----------------------------------------------------
@@ -1192,6 +1563,8 @@ def init_inference(
     mesh=None,
     param_specs=None,
     rng_seed=0,
+    draft_model=None,
+    draft_parameters=None,
 ):
     """Build a serving engine around ``model`` (reference analog: the
     training-side ``deepspeed.initialize``; early DeepSpeed had no
@@ -1201,6 +1574,10 @@ def init_inference(
     the engine (docs/inference.md); ``model_parameters`` provides the
     parameter pytree (overwritten in place of value — not structure —
     when ``inference.checkpoint.load_dir`` names a checkpoint to serve).
+    ``draft_model``/``draft_parameters`` supply the DRAFT for
+    speculative decoding (required when the ``inference.speculative``
+    block is configured; ``speculative.draft_checkpoint`` optionally
+    replaces the draft parameters through the verified-load path).
     Returns an :class:`InferenceEngine`.
     """
     return InferenceEngine(
@@ -1210,4 +1587,6 @@ def init_inference(
         mesh=mesh,
         param_specs=param_specs,
         rng_seed=rng_seed,
+        draft_model=draft_model,
+        draft_parameters=draft_parameters,
     )

@@ -451,6 +451,19 @@ class ContinuousBatchingScheduler:
                     "block)"
                 )
             resolve(adapter)  # ValueError on an unloaded name; counts it
+        resolved_temp = (
+            self._default_temperature
+            if temperature is None else float(temperature)
+        )
+        if resolved_temp > 0 and getattr(
+            self._engine, "speculative", False
+        ):
+            raise ValueError(
+                f"temperature={resolved_temp} on a speculative engine: "
+                "speculative decoding preserves exact output for GREEDY "
+                "requests only (every committed token is the target's "
+                "argmax); submit with temperature 0"
+            )
         n = len(prompt_tokens)
         if n == 0:
             raise ValueError("empty prompt")
@@ -774,10 +787,45 @@ class ContinuousBatchingScheduler:
                 "sched.decode_step", t0, t1, ctx=self._driver_ctx,
                 attrs={"active_slots": len(active), "step": self._steps},
             )
+            # speculative engines report the step's draft/verify/commit
+            # phase split (docs/observability.md): three sibling spans
+            # under the driver trace, so flight-recorder dumps and the
+            # bench's per-phase breakdown attribute the decode-step time
+            stats = getattr(self._engine, "spec_step_stats", None)
+            if stats is not None:
+                self._tracer.record(
+                    "sched.spec_draft", stats["draft_t0"],
+                    stats["draft_t1"], ctx=self._driver_ctx,
+                    attrs={"proposed": stats["proposed"]},
+                )
+                self._tracer.record(
+                    "sched.spec_verify", stats["verify_t0"],
+                    stats["verify_t1"], ctx=self._driver_ctx,
+                    attrs={
+                        "proposed": stats["proposed"],
+                        "accepted": stats["accepted"],
+                    },
+                )
+                self._tracer.record(
+                    "sched.spec_commit", stats["commit_t0"],
+                    stats["commit_t1"], ctx=self._driver_ctx,
+                    attrs={"committed": stats["committed"]},
+                )
         self._token_latency_ms.observe((t1 - t0) * 1e3)
         for slot, token in zip(active, next_tokens):
             req = self._slots[slot]
-            if req is not None:
+            if req is None:
+                continue
+            if isinstance(token, (list, tuple)):
+                # speculative burst: the accepted draft prefix plus the
+                # target's correction commit in order; tokens past a
+                # finish (EOS / max_new / length cap) are discarded —
+                # the freed slot's engine-side state resets at reuse
+                for t in token:
+                    if req.done:
+                        break
+                    self._count_token(req, t)
+            else:
                 self._count_token(req, token)
         self._occupancy.set(len(self.active_slots))
         self._update_health()

@@ -234,6 +234,14 @@ def apply_lora(cfg, p, lora, name, x, y):
       batch mixing any adapters runs ONE compiled program; the gather +
       einsum is row-independent along the slot dim, which is what makes
       a mixed batch bitwise-equal to per-adapter single-slot runs.
+      A 4th element ``fused=True`` routes single-token (decode-shaped)
+      calls through the Pallas SGMV kernel
+      (ops/decode_attention.py:lora_sgmv): the per-slot A/B rows are
+      read straight from the pool by scalar-prefetched ids instead of
+      materializing gathered ``[B, in, r]`` weight stacks — the
+      adapter-heavy-batch half of the fused decode path
+      (``inference.fused_decode``). Multi-token calls (prefill, suffix,
+      speculative verify) keep the XLA gather path.
     - per-layer ``{name}_lora_a`` / ``{name}_lora_b`` entries riding in
       the param dict ``p`` (the fine-tune path, ``cfg.lora_rank > 0``):
       one shared adapter, differentiated with the rest of ``p``.
@@ -242,11 +250,17 @@ def apply_lora(cfg, p, lora, name, x, y):
     the adapter-disabled path adds zero ops.
     """
     if lora is not None:
-        pools, ids, scale = lora
+        pools, ids, scale = lora[0], lora[1], lora[2]
+        fused = lora[3] if len(lora) > 3 else False
         ab = pools.get(name)
         if ab is None:
             return y
         a, b = ab
+        if fused and x.shape[1] == 1:
+            from .decode_attention import lora_sgmv
+
+            delta = lora_sgmv(x[:, 0, :], a, b, ids)  # [B, out] f32
+            return y + (scale * delta[:, None, :]).astype(y.dtype)
         t = jnp.einsum("bsi,bir->bsr", x, a[ids])
         return y + (scale * jnp.einsum("bsr,bro->bso", t, b[ids])).astype(
             y.dtype
@@ -465,21 +479,60 @@ def transformer_block_apply(
     return block(hidden_states)
 
 
-def _decode_block_core(cfg, p, hidden_states, positions, kv_commit,
-                       lora=None):
-    """The shared single-token decode block: LN/qkv/attention/FFN, with
-    the CACHE CONTAINER abstracted behind ``kv_commit(k_new, v_new) ->
-    (k_full, v_full, carry)`` — ``k_full``/``v_full`` are [B, heads, K,
-    hd] views holding every cached position (whatever the physical
-    layout), ``carry`` is the updated container state threaded back to
-    the caller. The contiguous and paged paths share every arithmetic op
-    through this function, which is what makes their greedy decode
-    bitwise-identical (pinned in tests/unit/test_paged_kv.py): identical
-    einsum contractions over identical K, and masked positions contribute
-    exactly 0.0 whatever garbage the physical layout parks there.
+def _attend_gathered(q, k_full, v_full, positions, live=None):
+    """The XLA reference single-query decode attention over a gathered
+    contiguous view: ``q`` [B, heads, hd], ``k_full``/``v_full`` [B,
+    heads, K, hd], masked to key indices ``<= positions``. This is the
+    bitwise-parity anchor — the contiguous and paged XLA paths run this
+    EXACT arithmetic over identical views, so their greedy decode is
+    bitwise-identical (pinned in tests/unit/test_paged_kv.py), and the
+    fused Pallas kernel (ops/decode_attention.py) is validated against
+    it.
 
-    ``lora``: optional ``(pools, ids, scale)`` batched-adapter source
-    (:func:`apply_lora`) — per-slot gathered A/B matmuls on every
+    ``live`` [B] bool (paged path): slots whose block table is empty
+    attend only the NULL page's garbage — their context is forced to
+    exact zeros instead (``jnp.where`` keeps live rows bitwise-
+    untouched), matching the fused kernel's dead-slot early-out."""
+    b, heads, hd = q.shape
+    max_len = k_full.shape[2]
+    # [B, heads, max_len] scores in f32 (MXU-accumulate dtype discipline
+    # of ops/attention.py); future positions masked by validity, so the
+    # garbage beyond each row's length never contributes
+    sm_scale = 1.0 / (hd ** 0.5)
+    s = jnp.einsum(
+        "bhd,bhkd->bhk", q, k_full, preferred_element_type=jnp.float32
+    ) * sm_scale
+    valid = (
+        jax.lax.broadcasted_iota(jnp.int32, (b, 1, max_len), 2)
+        <= positions[:, None, None]
+    )
+    s = jnp.where(valid, s, NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum(
+        "bhk,bhkd->bhd", probs.astype(v_full.dtype), v_full
+    )
+    if live is not None:
+        ctx = jnp.where(
+            live[:, None, None], ctx, jnp.zeros((), ctx.dtype)
+        )
+    return ctx
+
+
+def _decode_block_core(cfg, p, hidden_states, attend, lora=None):
+    """The shared single-token decode block: LN/qkv/attention/FFN, with
+    the attention CONTEXT computation abstracted behind ``attend(q,
+    k_new, v_new) -> (ctx, carry)`` — ``q``/``k_new``/``v_new`` are this
+    token's split-head projections [B, heads, hd], ``ctx`` the attention
+    context [B, heads, hd] over every cached position, ``carry`` the
+    updated cache container threaded back to the caller. Every cache
+    layout (contiguous, paged-XLA, paged-fused-Pallas) shares the
+    LN/qkv/FFN arithmetic through this function; the XLA layouts
+    additionally share :func:`_attend_gathered`, which is what makes
+    their greedy decode bitwise-identical (pinned in
+    tests/unit/test_paged_kv.py).
+
+    ``lora``: optional ``(pools, ids, scale[, fused])`` batched-adapter
+    source (:func:`apply_lora`) — per-slot gathered A/B matmuls on every
     targeted projection, so one fixed-shape decode program serves slots
     running DIFFERENT adapters concurrently (id 0 = identity)."""
     H = cfg.hidden_size
@@ -505,25 +558,7 @@ def _decode_block_core(cfg, p, hidden_states, positions, kv_commit,
     k_new = k_new.reshape(b, heads, head_dim)
     v_new = v_new.reshape(b, heads, head_dim)
 
-    k_full, v_full, carry = kv_commit(k_new, v_new)
-    max_len = k_full.shape[2]
-
-    # [B, heads, max_len] scores in f32 (MXU-accumulate dtype discipline
-    # of ops/attention.py); future positions masked by validity, so the
-    # garbage beyond each row's length never contributes
-    sm_scale = 1.0 / (head_dim ** 0.5)
-    s = jnp.einsum(
-        "bhd,bhkd->bhk", q, k_full, preferred_element_type=jnp.float32
-    ) * sm_scale
-    valid = (
-        jax.lax.broadcasted_iota(jnp.int32, (b, 1, max_len), 2)
-        <= positions[:, None, None]
-    )
-    s = jnp.where(valid, s, NEG_INF)
-    probs = jax.nn.softmax(s, axis=-1)
-    ctx = jnp.einsum(
-        "bhk,bhkd->bhd", probs.astype(v_full.dtype), v_full
-    )
+    ctx, carry = attend(q, k_new, v_new)
     ctx = ctx.reshape(b, 1, H)
     attn_out = apply_lora(
         cfg, p, lora, "attn_ow", ctx, ctx @ p["attn_ow"] + p["attn_ob"]
@@ -578,7 +613,7 @@ def transformer_block_decode(
     """
     b = hidden_states.shape[0]
 
-    def commit(k_new, v_new):
+    def attend(q, k_new, v_new):
         # scatter this token's k/v into the cache at its position
         # (advanced indexing pairs the two [B] index arrays, so row i
         # writes cache[i, :, positions[i]]); positions are clamped by the
@@ -590,10 +625,10 @@ def transformer_block_decode(
         vc = v_cache.at[rows, :, positions, :].set(
             v_new.astype(v_cache.dtype)
         )
-        return kc, vc, (kc, vc)
+        return _attend_gathered(q, kc, vc, positions), (kc, vc)
 
     x, (kc, vc) = _decode_block_core(
-        cfg, p, hidden_states, positions, commit, lora=lora
+        cfg, p, hidden_states, attend, lora=lora
     )
     return x, kc, vc
 
@@ -607,6 +642,7 @@ def transformer_block_decode_paged(
     block_tables,
     positions,
     lora=None,
+    fused=False,
 ):
     """One incremental-decode step over a BLOCK-PAGED KV cache.
 
@@ -621,10 +657,19 @@ def transformer_block_decode_paged(
     gathers of never-written positions land in a sacrificial page whose
     garbage the validity mask zeroes out of every softmax.
 
-    The write is a 2-element scatter per row; attention gathers the
-    slot's pages back into a [B, heads, max_blocks*block_size, hd] view
-    and runs the exact contiguous einsum over it — index arrays, not
-    shapes, so slots joining/leaving/evicting never recompile. Returns
+    The write is a 2-element scatter per row; with ``fused=False``
+    attention gathers the slot's pages back into a [B, heads,
+    max_blocks*block_size, hd] view and runs the exact contiguous einsum
+    over it — index arrays, not shapes, so slots joining/leaving/evicting
+    never recompile. ``fused=True`` (``inference.fused_decode``) skips
+    the gather entirely: the Pallas single-query flash-decode kernel
+    (ops/decode_attention.py:paged_flash_decode) streams the slot's LIVE
+    pages through VMEM via the block table with an online softmax — no
+    gathered temporary, no compute on null pages or beyond each slot's
+    position. Greedy-parity (not bitwise-logit) equivalent to the XLA
+    path. Empty slots (zero-length block tables — the table's first
+    entry is the null page) contribute exact-zero attention context on
+    BOTH paths instead of attending the null page's garbage. Returns
     ``(out [B,1,H], k_pool, v_pool)``.
     """
     block_size = k_pool.shape[1]
@@ -635,10 +680,21 @@ def transformer_block_decode_paged(
     block_idx = jnp.minimum(positions // block_size, max_blocks - 1)
     phys = block_tables[rows, block_idx]  # [B]
     offs = positions % block_size  # [B]
+    # a slot whose table starts at the null page holds no pages at all —
+    # the dead-slot ride-along (scheduler keeps shapes fixed); its
+    # attention context is forced to exact zeros rather than a softmax
+    # over the null page's garbage
+    live = block_tables[:, 0] != 0
 
-    def commit(k_new, v_new):
+    def attend(q, k_new, v_new):
         kp = k_pool.at[phys, offs, :, :].set(k_new.astype(k_pool.dtype))
         vp = v_pool.at[phys, offs, :, :].set(v_new.astype(v_pool.dtype))
+        if fused:
+            from .decode_attention import paged_flash_decode
+
+            return paged_flash_decode(
+                q, kp, vp, block_tables, positions
+            ), (kp, vp)
         # gather each slot's pages into the contiguous logical view the
         # shared core attends over: [B, MB, bs, heads, hd] -> [B, heads,
         # MB*bs, hd] (transposed to the contiguous cache's layout so the
@@ -649,10 +705,12 @@ def transformer_block_decode_paged(
         v_full = vp[block_tables].reshape(
             b, max_blocks * block_size, vp.shape[2], vp.shape[3]
         ).transpose(0, 2, 1, 3)
-        return k_full, v_full, (kp, vp)
+        return _attend_gathered(
+            q, k_full, v_full, positions, live=live
+        ), (kp, vp)
 
     x, (kp, vp) = _decode_block_core(
-        cfg, p, hidden_states, positions, commit, lora=lora
+        cfg, p, hidden_states, attend, lora=lora
     )
     return x, kp, vp
 
@@ -717,6 +775,14 @@ def transformer_block_prefill_paged(
     )  # [B, S]
     block_idx = jnp.minimum(positions // block_size, max_blocks - 1)
     phys = jnp.take_along_axis(block_tables, block_idx, axis=1)  # [B, S]
+    # rows past the slot's logical extent write to the NULL page instead
+    # of clamping into the slot's REAL last page (which may be a SHARED
+    # prefix page another request still attends). The prefix-hit path
+    # never pads past kv_len (engine._suffix_bucket guarantees it — the
+    # redirect is then an identity select), but the speculative VERIFY
+    # step reuses this block with per-slot start positions that can run
+    # within k tokens of the cap.
+    phys = jnp.where(positions < kv_len, phys, 0)
     offs = positions % block_size
     k_rows = k_new.reshape(b, s, heads, head_dim)  # [B, S, heads, hd]
     v_rows = v_new.reshape(b, s, heads, head_dim)

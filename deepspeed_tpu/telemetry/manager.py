@@ -92,7 +92,7 @@ ENGINE_METRICS = (
 # into its telemetry's registry via register_inference_metrics().
 INFERENCE_METRICS = (
     ("histogram", "infer/ttft_ms", "time to first token: request admission through prefill + first sampled token"),
-    ("histogram", "infer/token_latency_ms", "wall time of one continuous-batching decode step (one token for every active slot)"),
+    ("histogram", "infer/token_latency_ms", "wall time of one continuous-batching decode step (one token per active slot; up to k+1 under speculative decoding — divide by tokens_generated deltas for per-token latency)"),
     ("histogram", "infer/prefill_time_ms", "wall time of one request's prefill (cache write + first-token logits)"),
     ("histogram", "infer/queue_wait_ms", "time a request waited in the admission queue before a slot freed"),
     ("gauge", "infer/tokens_per_sec", "decode tokens generated per second over the last export interval"),
@@ -115,6 +115,13 @@ INFERENCE_METRICS = (
     ("counter", "infer/prefix_hits", "admissions that reused cached prefix pages (only the unique suffix was prefilled)"),
     ("counter", "infer/prefix_misses", "admissions that found no cached prefix pages (cold full prefill)"),
     ("counter", "infer/kv_blocks_reclaimed", "cached refcount-0 pages evicted LRU-first to satisfy new allocations"),
+    # fused decode attention + speculative decoding (docs/inference.md
+    # "Fused decode attention" / "Speculative decoding"; the spec_*
+    # streams stay 0 on a non-speculative engine, fused_decode reads 0)
+    ("gauge", "infer/fused_decode", "1 while the Pallas fused decode-attention path is active (inference.fused_decode), else 0"),
+    ("counter", "infer/spec_proposed", "draft-model tokens proposed to target verification (k per speculative decode step per active slot)"),
+    ("counter", "infer/spec_accepted", "proposed draft tokens the target's verify step accepted (committed without correction)"),
+    ("gauge", "infer/spec_acceptance_rate", "cumulative spec_accepted / spec_proposed (0 before the first speculative step)"),
 )
 
 

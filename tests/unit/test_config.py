@@ -442,6 +442,64 @@ def test_paged_kv_rejects(block):
 
 
 # ---------------------------------------------------------------------------
+# fused decode + speculative decoding keys (docs/inference.md "Fused
+# decode attention" / "Speculative decoding")
+# ---------------------------------------------------------------------------
+def test_fused_and_speculative_defaults_off():
+    cfg = make({"train_batch_size": 8})
+    assert cfg.inference_fused_decode is False
+    assert cfg.inference_speculative_enabled is False
+    assert cfg.inference_speculative_k == 4
+    assert cfg.inference_speculative_draft_checkpoint == ""
+
+
+def test_fused_and_speculative_valid_block_parses():
+    cfg = _inf({
+        "max_seq_len": 256, "kv_block_size": 32,
+        "fused_decode": True,
+        "speculative": {"k": 6, "draft_checkpoint": "/ckpts/draft"},
+    })
+    assert cfg.inference_fused_decode is True
+    assert cfg.inference_speculative_enabled is True
+    assert cfg.inference_speculative_k == 6
+    assert cfg.inference_speculative_draft_checkpoint == "/ckpts/draft"
+
+
+def test_speculative_empty_block_enables_with_defaults():
+    cfg = _inf({"max_seq_len": 256, "kv_block_size": 32,
+                "speculative": {}})
+    assert cfg.inference_speculative_enabled is True
+    assert cfg.inference_speculative_k == 4
+
+
+@pytest.mark.parametrize("block", [
+    {"fused_decode": "yes"},
+    {"fused_decode": 1},
+    {"fused_decode": True},                       # fused needs paging
+    {"speculative": {}},                          # speculative needs paging
+    {"max_seq_len": 256, "kv_block_size": 32,
+     "speculative": {"k": 0}},
+    {"max_seq_len": 256, "kv_block_size": 32,
+     "speculative": {"k": -2}},
+    {"max_seq_len": 256, "kv_block_size": 32,
+     "speculative": {"k": True}},
+    {"max_seq_len": 256, "kv_block_size": 32,
+     "speculative": {"k": 2.5}},
+    {"max_seq_len": 256, "kv_block_size": 32,
+     "speculative": {"draft_checkpoint": 7}},
+    {"max_seq_len": 256, "kv_block_size": 32,
+     "speculative": {"kk": 4}},                   # typo'd key
+    {"max_seq_len": 256, "kv_block_size": 32,
+     "speculative": {"k": 4, "draft": "x"}},      # unknown key
+])
+def test_fused_and_speculative_rejects(block):
+    from deepspeed_tpu.config.config import DeepSpeedConfigError
+
+    with pytest.raises(DeepSpeedConfigError):
+        _inf(block)
+
+
+# ---------------------------------------------------------------------------
 # adapters block: multi-tenant LoRA geometry (docs/adapters.md)
 # ---------------------------------------------------------------------------
 def _ada(block):

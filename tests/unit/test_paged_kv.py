@@ -453,6 +453,213 @@ def test_load_snapshot_reports_pool_and_prefix_state():
         engine.close()
 
 
+def _dead_and_live_setup():
+    """One live slot (real pages, prefilled) beside one dead slot (all-
+    null block table): the fixture the dead-slot masking pins run on."""
+    cfg, model, params = _small_model()
+    prompt = _prompt(11)
+    plen, bs, max_len, slots = len(prompt), 8, 32, 2
+    prefill_len = 16
+    padded = np.zeros((1, prefill_len), np.int32)
+    padded[0, :plen] = prompt
+    logits, ks, vs = jax.jit(
+        lambda p, t: gpt2_prefill(cfg, p, t)
+    )(params, jnp.asarray(padded))
+    pool = init_kv_pool(cfg, 6, bs)
+    table = np.zeros((slots, max_len // bs), np.int32)
+    table[0] = [1, 2, 3, 4]
+    block_ids = np.zeros(prefill_len, np.int32)
+    block_ids[:plen] = [table[0][j // bs] for j in range(plen)]
+    pool = write_prefill_to_pool(
+        pool, ks, vs, jnp.asarray(block_ids),
+        jnp.asarray(np.arange(prefill_len, dtype=np.int32) % bs),
+    )
+    first = int(jnp.argmax(logits[0, plen - 1, :VOCAB]))
+    toks = np.zeros(slots, np.int32)
+    pos = np.zeros(slots, np.int32)
+    toks[0], pos[0] = first, plen
+    return cfg, params, pool, table, toks, pos
+
+
+# ---------------------------------------------------------------------------
+# fused Pallas decode attention (docs/inference.md "Fused decode
+# attention"): greedy parity vs the XLA reference, dead-slot early-out,
+# and the no-recompile pin on the fused path
+# ---------------------------------------------------------------------------
+def test_fused_kernel_matches_gathered_reference():
+    """paged_flash_decode (online softmax over live pages) agrees with
+    the XLA gather-then-softmax reference to float tolerance on every
+    live slot, and emits EXACT zeros for a dead slot — the behavior the
+    greedy-parity engine pins build on."""
+    from deepspeed_tpu.ops.decode_attention import paged_flash_decode
+
+    rng = np.random.default_rng(3)
+    b, heads, hd, bs, mb, pages = 3, 4, 8, 4, 4, 12
+    q = jnp.asarray(rng.normal(size=(b, heads, hd)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(pages, bs, heads, hd)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(pages, bs, heads, hd)), jnp.float32)
+    tables = np.zeros((b, mb), np.int32)
+    tables[0, :2] = [3, 7]
+    tables[2] = [1, 2, 4, 5]  # slot 1 stays dead
+    positions = np.asarray([5, 0, 13], np.int32)
+    out = np.asarray(paged_flash_decode(
+        q, kp, vp, jnp.asarray(tables), jnp.asarray(positions)
+    ))
+    for slot in (0, 2):
+        k_full = np.asarray(kp)[tables[slot]].reshape(
+            mb * bs, heads, hd
+        ).transpose(1, 0, 2)
+        v_full = np.asarray(vp)[tables[slot]].reshape(
+            mb * bs, heads, hd
+        ).transpose(1, 0, 2)
+        s = np.einsum(
+            "hd,hkd->hk", np.asarray(q)[slot], k_full
+        ) / np.sqrt(hd)
+        s = np.where(np.arange(mb * bs) <= positions[slot], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hk,hkd->hd", p, v_full)
+        np.testing.assert_allclose(out[slot], ref, atol=1e-5, rtol=1e-5)
+    assert np.all(out[1] == 0.0), "dead slot must emit exact zeros"
+
+
+def test_dead_slot_masked_on_both_paths_live_logits_pinned():
+    """The dead-slot fix: an empty (all-null-table) slot's attention
+    context is exact zeros on the XLA path AND the fused kernel — so
+    both paths' dead-slot logits are BITWISE-identical (everything
+    outside attention is shared arithmetic over a deterministic
+    embedding) instead of a softmax over the null page's garbage. Live
+    slots' logits stay bitwise-equal to the contiguous reference, so
+    the masking costs the parity contract nothing."""
+    cfg, params, pool, table, toks, pos = _dead_and_live_setup()
+    cache = init_kv_cache(cfg, 2, 32)
+    # seed the contiguous cache with the same prefill rows
+    prompt = _prompt(11)
+    padded = np.zeros((1, 16), np.int32)
+    padded[0, :len(prompt)] = prompt
+    _, ks, vs = jax.jit(
+        lambda p, t: gpt2_prefill(cfg, p, t)
+    )(params, jnp.asarray(padded))
+    cache = write_prefill_to_cache(cache, jnp.int32(0), ks, vs)
+
+    jd_c = jax.jit(lambda p, t, po, c: gpt2_decode_step(cfg, p, t, po, c))
+    jd_x = jax.jit(
+        lambda p, t, po, pl, bt: gpt2_decode_step_paged(cfg, p, t, po, pl, bt)
+    )
+    jd_f = jax.jit(
+        lambda p, t, po, pl, bt: gpt2_decode_step_paged(
+            cfg, p, t, po, pl, bt, fused=True
+        )
+    )
+    lc, _ = jd_c(params, jnp.asarray(toks), jnp.asarray(pos), cache)
+    lx, _ = jd_x(
+        params, jnp.asarray(toks), jnp.asarray(pos), pool, jnp.asarray(table)
+    )
+    lf, _ = jd_f(
+        params, jnp.asarray(toks), jnp.asarray(pos), pool, jnp.asarray(table)
+    )
+    # live slot: XLA paged stays bitwise vs contiguous; fused agrees on
+    # the greedy choice (its online softmax is float-tolerant, not
+    # bitwise)
+    np.testing.assert_array_equal(np.asarray(lc[0]), np.asarray(lx[0]))
+    np.testing.assert_allclose(
+        np.asarray(lf[0]), np.asarray(lx[0]), atol=1e-4, rtol=1e-4
+    )
+    assert int(jnp.argmax(lf[0, :VOCAB])) == int(jnp.argmax(lx[0, :VOCAB]))
+    # dead slot: zero attention context on both paths -> identical
+    # deterministic logits (bitwise: everything outside attend is the
+    # same arithmetic, and both contexts are exact zeros)
+    np.testing.assert_array_equal(np.asarray(lx[1]), np.asarray(lf[1]))
+    assert np.all(np.isfinite(np.asarray(lx[1])))
+
+
+def test_fused_engine_greedy_parity_matrix():
+    """Engine-level fused-vs-XLA pin: concurrent mixed-length requests,
+    a mid-flight join, EOS slot reuse, and a prefix-cache hit all
+    produce exactly the unfused engine's greedy tokens (which are
+    themselves pinned bitwise to the contiguous path above)."""
+    cfg, model, params = _small_model()
+    e_x = _engine(model, params)
+    e_f = _engine(model, params, {"fused_decode": True})
+    try:
+        assert e_f.fused_decode, "fused path did not arm"
+        prompts = [_prompt(9, 1), _prompt(5, 2), _prompt(13, 3)]
+        assert e_x.generate(prompts, max_new_tokens=10) == \
+            e_f.generate(prompts, max_new_tokens=10)
+
+        # mid-flight join
+        r1x = e_x.submit(_prompt(8, 4), max_new_tokens=12)
+        r1f = e_f.submit(_prompt(8, 4), max_new_tokens=12)
+        for _ in range(4):
+            e_x.scheduler.step()
+            e_f.scheduler.step()
+        r2x = e_x.submit(_prompt(7, 5), max_new_tokens=8)
+        r2f = e_f.submit(_prompt(7, 5), max_new_tokens=8)
+        e_x.scheduler.run_until_idle()
+        e_f.scheduler.run_until_idle()
+        assert r1x.result(0) == r1f.result(0)
+        assert r2x.result(0) == r2f.result(0)
+
+        # EOS slot reuse
+        ref = e_x.generate([_prompt(8, 6)], max_new_tokens=8)[0]
+        eos = ref[3]
+        ax = e_x.submit(_prompt(8, 6), max_new_tokens=8, eos_token_id=eos)
+        af = e_f.submit(_prompt(8, 6), max_new_tokens=8, eos_token_id=eos)
+        e_x.scheduler.run_until_idle()
+        e_f.scheduler.run_until_idle()
+        assert ax.finish_reason == af.finish_reason == "eos"
+        assert ax.result(0) == af.result(0)
+
+        # prefix-cache hit rides the fused decode unchanged
+        shared = _prompt(16, 7)
+        assert e_x.generate([shared + _prompt(3, 8)], max_new_tokens=6) == \
+            e_f.generate([shared + _prompt(3, 8)], max_new_tokens=6)
+        assert e_x.generate([shared + _prompt(3, 9)], max_new_tokens=6) == \
+            e_f.generate([shared + _prompt(3, 9)], max_new_tokens=6)
+        assert e_f.metrics.counter("infer/prefix_hits").value >= 1
+        assert e_f.metrics.gauge("infer/fused_decode").value == 1
+        assert e_x.metrics.gauge("infer/fused_decode").value == 0
+    finally:
+        e_x.close()
+        e_f.close()
+
+
+def test_fused_decode_steps_do_not_recompile():
+    """The no-recompile pin extends to the fused path: joins, leaves,
+    and warm prefix hits add zero XLA backend compiles — block tables
+    and positions stay index ARRAYS through the kernel's scalar
+    prefetch."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {"fused_decode": True})
+    try:
+        recompiles = engine.metrics.counter("jax/recompiles")
+        engine.generate([_prompt(8)], max_new_tokens=4)
+        shared = _prompt(16, 7)
+        engine.generate([shared + _prompt(3, 8)], max_new_tokens=4)
+        engine.generate([shared + _prompt(3, 9)], max_new_tokens=4)
+        warm = recompiles.value
+        assert warm > 0
+
+        r1 = engine.submit(_prompt(5, 5), max_new_tokens=6)
+        engine.scheduler.step()
+        r2 = engine.submit(_prompt(11, 6), max_new_tokens=5)
+        r3 = engine.submit(shared + _prompt(2, 10), max_new_tokens=4)
+        engine.scheduler.run_until_idle()
+        assert all(r.done for r in (r1, r2, r3))
+        assert recompiles.value == warm, (
+            f"fused decode path recompiled: {recompiles.value - warm} "
+            "new backend compiles after warmup"
+        )
+    finally:
+        engine.close()
+
+
+def test_fused_decode_requires_paged_cache():
+    cfg, model, params = _small_model()
+    with pytest.raises(DeepSpeedConfigError, match="paged"):
+        _engine(model, params, {"kv_block_size": 0, "fused_decode": True})
+
+
 def test_engine_rejects_block_size_not_dividing_max_seq():
     cfg, model, params = _small_model()
     with pytest.raises(DeepSpeedConfigError, match="multiple"):
