@@ -103,7 +103,13 @@ class DeepSpeedConfig:
 
         self.zero_config = DeepSpeedZeroConfig(pd)
         self.zero_optimization_stage = self.zero_config.stage
-        self.zero_enabled = self.zero_optimization_stage > 0
+        # a non-int stage (e.g. "2") must reach _check_zero's typed error,
+        # not explode on this comparison
+        self.zero_enabled = (
+            isinstance(self.zero_optimization_stage, int)
+            and not isinstance(self.zero_optimization_stage, bool)
+            and self.zero_optimization_stage > 0
+        )
         self.zero_allow_untested_optimizer = get_scalar_param(
             pd,
             C.ZERO_ALLOW_UNTESTED_OPTIMIZER,
@@ -743,12 +749,7 @@ class DeepSpeedConfig:
 
     # ------------------------------------------------------------------
     def _do_error_check(self):
-        if self.zero_enabled:
-            if self.zero_optimization_stage > C.MAX_STAGE_ZERO_OPTIMIZATION:
-                raise DeepSpeedConfigError(
-                    f"ZeRO stage {self.zero_optimization_stage} not supported; "
-                    f"max stage is {C.MAX_STAGE_ZERO_OPTIMIZATION}"
-                )
+        self._check_zero()
         if self.fp16_enabled and self.bf16_enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
         if self.loss_scale < 0:
@@ -768,6 +769,55 @@ class DeepSpeedConfig:
                 'the "amp" block has no TPU equivalent (apex amp is '
                 "CUDA-only); use {'bf16': {'enabled': true}} — bf16 is the "
                 "native mixed-precision path and needs no loss scaler"
+            )
+
+    def _check_zero(self):
+        """Validate the zero_optimization block. Every key must be known
+        (a typo'd knob must not silently mean its default), the stage
+        must be a real stage, and the stage-3 overlap knobs are REJECTED
+        below stage 3 — a config that spells out stage-3 machinery while
+        a typo'd stage leaves params replicated should fail at init, not
+        train at the wrong memory profile."""
+        zc = self.zero_config
+        stage = self.zero_optimization_stage
+        if (
+            not isinstance(stage, int)
+            or isinstance(stage, bool)
+            or stage < 0
+            or stage > C.MAX_STAGE_ZERO_OPTIMIZATION
+        ):
+            raise DeepSpeedConfigError(
+                f"ZeRO stage {stage!r} not supported; stages are 0.."
+                f"{C.MAX_STAGE_ZERO_OPTIMIZATION} "
+                f"({C.MAX_STAGE_ZERO_OPTIMIZATION} = parameter "
+                "partitioning)"
+            )
+        unknown = sorted(set(zc.explicit_keys) - set(C.ZERO_VALID_KEYS))
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"unknown {C.ZERO_OPTIMIZATION} key(s) {unknown}; valid: "
+                f"{sorted(C.ZERO_VALID_KEYS)}"
+            )
+        stage3_set = [
+            k for k in C.ZERO_STAGE3_ONLY_KEYS if k in zc.explicit_keys
+        ]
+        if stage3_set and stage < C.ZERO_OPTIMIZATION_WEIGHTS:
+            raise DeepSpeedConfigError(
+                f"{C.ZERO_OPTIMIZATION} key(s) {stage3_set} configure "
+                f"stage-3 machinery but stage is {stage}; set "
+                f'"{C.ZERO_STAGE}": {C.ZERO_OPTIMIZATION_WEIGHTS} or '
+                "remove them"
+            )
+        gb = zc.stage3_gather_block
+        if not isinstance(gb, int) or isinstance(gb, bool) or gb < 1:
+            raise DeepSpeedConfigError(
+                f"{C.ZERO_OPTIMIZATION}.{C.ZERO_STAGE3_GATHER_BLOCK} "
+                f"must be an integer >= 1, got {gb!r}"
+            )
+        if not isinstance(zc.stage3_latency_hiding, bool):
+            raise DeepSpeedConfigError(
+                f"{C.ZERO_OPTIMIZATION}.{C.ZERO_STAGE3_LATENCY_HIDING} "
+                f"must be a bool, got {zc.stage3_latency_hiding!r}"
             )
 
     def _check_telemetry(self):

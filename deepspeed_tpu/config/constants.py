@@ -206,6 +206,47 @@ ZERO_OFFLOAD_OPTIMIZER = "offload_optimizer"
 ZERO_OFFLOAD_DEVICE = "device"
 ZERO_OFFLOAD_DEVICE_DEFAULT = "none"
 
+# Stage-3 collective/compute overlap knobs (docs/performance.md "ZeRO-3 &
+# collective overlap"); only meaningful — and only ACCEPTED — at stage 3
+# (_check_zero rejects them below it: a config carrying stage3_* knobs
+# with a typo'd stage must fail, not silently train replicated).
+#
+# stage3_gather_block: layers whose JIT weight gathers issue together per
+# scan iteration of the zero3 stack (models/stack.py) — the "gather layer
+# i+1 while computing layer i" double-buffer structure; 1 disables the
+# pairing (strictly sequential gathers).
+ZERO_STAGE3_GATHER_BLOCK = "stage3_gather_block"
+ZERO_STAGE3_GATHER_BLOCK_DEFAULT = 2
+# stage3_latency_hiding: arm XLA's latency-hiding scheduler / async
+# collective flags (runtime/overlap.py) so the gathers and the window's
+# grad reduce-scatter actually schedule under compute on TPU.
+ZERO_STAGE3_LATENCY_HIDING = "stage3_latency_hiding"
+ZERO_STAGE3_LATENCY_HIDING_DEFAULT = True
+
+# every key the zero_optimization object accepts (_check_zero rejects
+# anything else — a typo'd knob must not silently mean its default)
+ZERO_VALID_KEYS = (
+    ZERO_STAGE,
+    ZERO_ALLGATHER_PARTITIONS,
+    ZERO_ALLGATHER_BUCKET_SIZE,
+    ZERO_ALLGATHER_BUCKET_SIZE_DEPRECATED,
+    ZERO_REDUCE_SCATTER,
+    ZERO_REDUCE_BUCKET_SIZE,
+    ZERO_OVERLAP_COMM,
+    ZERO_CONTIGUOUS_GRADIENTS,
+    ZERO_LOAD_FROM_FP32_WEIGHTS,
+    ZERO_MAX_ELEMENTS_PER_COMM,
+    ZERO_MASTER_WEIGHTS,
+    ZERO_OFFLOAD_OPTIMIZER,
+    ZERO_STAGE3_GATHER_BLOCK,
+    ZERO_STAGE3_LATENCY_HIDING,
+)
+# knobs that configure stage-3-only machinery
+ZERO_STAGE3_ONLY_KEYS = (
+    ZERO_STAGE3_GATHER_BLOCK,
+    ZERO_STAGE3_LATENCY_HIDING,
+)
+
 # ZeRO wrapping an optimizer outside the tested set (Adam family / Lamb)
 # needs an explicit opt-in, mirroring the reference's guard
 # (deepspeed_constants.py:37-38, deepspeed_light.py:506-515): sharded

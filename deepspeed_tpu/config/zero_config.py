@@ -28,6 +28,11 @@ class DeepSpeedZeroConfig:
         self.max_elements_per_comm = C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT
         self.master_weights = C.ZERO_MASTER_WEIGHTS_DEFAULT
         self.offload_optimizer_device = C.ZERO_OFFLOAD_DEVICE_DEFAULT
+        self.stage3_gather_block = C.ZERO_STAGE3_GATHER_BLOCK_DEFAULT
+        self.stage3_latency_hiding = C.ZERO_STAGE3_LATENCY_HIDING_DEFAULT
+        # keys the user actually wrote (raw, pre-default): _check_zero
+        # rejects unknown ones and stage3_* knobs below stage 3
+        self.explicit_keys = frozenset()
 
         if param_dict is not None:
             raw = param_dict.get(C.ZERO_OPTIMIZATION)
@@ -47,6 +52,7 @@ class DeepSpeedZeroConfig:
                 )
 
     def _read(self, zero_dict):
+        self.explicit_keys = frozenset(zero_dict.keys())
         self.stage = get_scalar_param(zero_dict, C.ZERO_STAGE, C.ZERO_STAGE_DEFAULT)
         self.allgather_partitions = get_scalar_param(
             zero_dict, C.ZERO_ALLGATHER_PARTITIONS, C.ZERO_ALLGATHER_PARTITIONS_DEFAULT
@@ -100,6 +106,16 @@ class DeepSpeedZeroConfig:
                     f"must be 'none' or 'cpu', got {device!r}"
                 )
             self.offload_optimizer_device = device
+        # stage-3 collective/compute overlap knobs (docs/performance.md);
+        # range/type/stage gating happens in config.py:_check_zero
+        self.stage3_gather_block = get_scalar_param(
+            zero_dict, C.ZERO_STAGE3_GATHER_BLOCK,
+            C.ZERO_STAGE3_GATHER_BLOCK_DEFAULT,
+        )
+        self.stage3_latency_hiding = get_scalar_param(
+            zero_dict, C.ZERO_STAGE3_LATENCY_HIDING,
+            C.ZERO_STAGE3_LATENCY_HIDING_DEFAULT,
+        )
 
     def repr_dict(self):
         return {
@@ -115,6 +131,8 @@ class DeepSpeedZeroConfig:
             C.ZERO_OFFLOAD_OPTIMIZER: {
                 C.ZERO_OFFLOAD_DEVICE: self.offload_optimizer_device
             },
+            C.ZERO_STAGE3_GATHER_BLOCK: self.stage3_gather_block,
+            C.ZERO_STAGE3_LATENCY_HIDING: self.stage3_latency_hiding,
         }
 
     def __repr__(self):

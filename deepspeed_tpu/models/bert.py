@@ -71,6 +71,12 @@ class BertConfig:
     lora_rank: int = 0
     lora_alpha: float = 0.0
     lora_targets: tuple = ()  # () => every LORA_TARGETS matrix
+    # ZeRO-3 layer-wise JIT gather (models/stack.py): armed by the engine
+    # at zero_optimization.stage 3 (runtime/engine.py:_arm_zero3_gather),
+    # never set by hand. None = the plain nn.scan stack.
+    zero3_gather: object = dataclasses.field(
+        default=None, hash=False, compare=False
+    )
 
     @staticmethod
     def bert_large(**kw):
@@ -146,6 +152,26 @@ class BertEncoder(nn.Module):
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None, train=True):
         cfg = self.config
+        if cfg.zero3_gather is not None:
+            # ZeRO-3 layer-wise JIT gather (models/stack.py): same param
+            # names/shapes as the nn.scan stack below, so checkpoints and
+            # stage changes interchange
+            from .stack import _StackedBlockParams, zero3_scan_stack
+
+            layer_cfg = cfg.layer_config()
+            p = _StackedBlockParams(
+                layer_cfg, cfg.num_hidden_layers, name="layer"
+            )()
+            need_rng = train and (
+                cfg.hidden_dropout_prob > 0
+                or cfg.attention_probs_dropout_prob > 0
+            )
+            dropout_key = self.make_rng("dropout") if need_rng else None
+            return zero3_scan_stack(
+                layer_cfg, p, hidden_states, cfg.zero3_gather, cfg.mesh,
+                causal=False, use_flash=cfg.use_flash, train=train,
+                dropout_key=dropout_key, attention_mask=attention_mask,
+            )
         hidden_states, _ = nn.scan(
             lambda mdl, c, _: (mdl(c, attention_mask, train=train), None),
             variable_axes={"params": 0},

@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 from ..config.constants import DATA_AXIS, MODEL_AXIS, SEQUENCE_AXIS
 from ..ops.transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
 from .bert import cross_entropy_ignore_index, _round_up
+from .stack import _StackedBlockParams, zero3_scan_stack
 
 
 @dataclasses.dataclass(unsafe_hash=True)
@@ -80,6 +81,14 @@ class GPT2Config:
     lora_rank: int = 0
     lora_alpha: float = 0.0  # 0 => rank (scaling 1.0)
     lora_targets: tuple = ()  # () => every LORA_TARGETS matrix
+    # ZeRO-3 layer-wise JIT gather (models/stack.py, docs/performance.md
+    # "ZeRO-3 & collective overlap"): armed by the engine at
+    # zero_optimization.stage 3 (runtime/engine.py:_arm_zero3_gather),
+    # never set by hand — a dict {"specs", "stacked_specs", "block"}
+    # describing the gather seam. None = the plain nn.scan stack.
+    zero3_gather: object = dataclasses.field(
+        default=None, hash=False, compare=False
+    )
 
     @property
     def vocab_padded(self):
@@ -127,37 +136,6 @@ class GPT2Config:
             lora_alpha=self.lora_alpha,
             lora_targets=tuple(self.lora_targets),
         )
-
-
-class _StackedBlockParams(nn.Module):
-    """Creates the 12-tensor transformer params with a leading ``layers``
-    axis — the same names/shapes the ``nn.scan`` path produces, so
-    checkpoints interchange between the scanned and pipelined stacks."""
-
-    layer_cfg: object
-    n_layer: int
-
-    @nn.compact
-    def __call__(self):
-        from ..ops.transformer import TRANSFORMER_PARAM_LAYOUT
-
-        cfg = self.layer_cfg
-        H = cfg.hidden_size
-        shapes = {"H": H, "3H": 3 * H, "I": cfg.intermediate}
-        init = nn.initializers.normal(stddev=cfg.initializer_range)
-        makers = {
-            "init": init,
-            "zeros": nn.initializers.zeros,
-            "ones32": nn.initializers.ones,
-            "zeros32": nn.initializers.zeros,
-        }
-        return {
-            name: self.param(
-                name, makers[kind],
-                (self.n_layer, *(shapes[d] for d in dims)), jnp.float32,
-            )
-            for name, dims, kind in TRANSFORMER_PARAM_LAYOUT
-        }
 
 
 class GPT2Model(nn.Module):
@@ -215,6 +193,8 @@ class GPT2Model(nn.Module):
                 None,
             )
             moe_aux = jnp.sum(aux_per_layer)
+        elif cfg.zero3_gather is not None:
+            x = self._zero3_stack(x, train)
         else:
             x, _ = nn.scan(
                 lambda mdl, c, _: (mdl(c, None, train=train), None),
@@ -232,6 +212,24 @@ class GPT2Model(nn.Module):
             )
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_f")(x)
         return (x, wte) if moe_aux is None else (x, wte, moe_aux)
+
+    def _zero3_stack(self, x, train):
+        """Run the layer stack with ZeRO-3 layer-wise JIT gather
+        (models/stack.py): stacked params stay dp-sharded persistently;
+        each scan iteration all-gathers one gather-block of layers just
+        in time and frees them after use (backward re-gathers under the
+        remat policy). Same param names/shapes as the nn.scan stack, so
+        checkpoints and stage changes interchange."""
+        cfg = self.config
+        layer_cfg = cfg.layer_config()
+        p = _StackedBlockParams(layer_cfg, cfg.n_layer, name="h")()
+        need_rng = train and cfg.dropout > 0
+        dropout_key = self.make_rng("dropout") if need_rng else None
+        return zero3_scan_stack(
+            layer_cfg, p, x, cfg.zero3_gather, cfg.mesh,
+            causal=True, use_flash=cfg.use_flash, train=train,
+            dropout_key=dropout_key,
+        )
 
     def _pipelined_stack(self, x, train):
         """Run the layer stack as an SPMD GPipe pipeline over the mesh's

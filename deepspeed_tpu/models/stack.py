@@ -1,0 +1,231 @@
+"""Stacked-parameter layer stacks shared by GPT-2 and BERT.
+
+Two consumers need the transformer stack as EXPLICIT stacked params (one
+``[layers, ...]`` leaf per tensor of the reference 12-tensor layout)
+rather than as an ``nn.scan``-lifted module:
+
+- the SPMD pipeline stack (models/gpt2.py:_pipelined_stack) reshapes the
+  stack into per-stage blocks;
+- the ZeRO-3 stack below, which all-gathers each layer's dp-sharded
+  weights JUST IN TIME inside the scan body and lets backward re-gather
+  them instead of saving ``n_layers x`` full copies (Rajbhandari et al.,
+  P_os+g+p — PAPERS.md "ZeRO").
+
+``_StackedBlockParams`` creates the stacked params with the same
+names/shapes the ``nn.scan`` path produces, so checkpoints (and a
+mid-run stage change) interchange between the scanned, pipelined, and
+ZeRO-3 stacks.
+
+ZeRO-3 gather/free lifecycle (docs/performance.md "ZeRO-3 & collective
+overlap"):
+
+  persistent leaf  [L, ...] sharded over ``data`` (1/dp resident bytes)
+      | scan slices layer l                 (still sharded)
+      | with_sharding_constraint(model-only spec)   <- ALL-GATHER (JIT)
+      | checkpoint_name("zero3_gathered")   (never a saved residual)
+      | transformer_block_apply             (compute on gathered weights)
+      v
+  gathered copy dies at the end of the layer body — steady state holds
+  ONE gather block of full layers, not the stack. Backward re-runs the
+  gather under the layer's ``jax.checkpoint`` (ops/transformer.py:
+  zero3_remat_policy), so its residency profile matches forward.
+
+Collective/compute overlap: the scan body processes ``gather_block``
+layers per iteration (default 2) and issues ALL of the block's gathers
+up front — gather(layer i+1) depends only on its own sharded slice,
+never on layer i's activations, so the compiler (XLA's latency-hiding
+scheduler on TPU, runtime/overlap.py) can run it UNDER layer i's
+compute. The same independence lets the backward overlap each layer's
+re-gather and the window's grad reduce-scatter with backward matmuls.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+from jax.sharding import NamedSharding
+
+from ..ops.transformer import (
+    TRANSFORMER_PARAM_LAYOUT,
+    ZERO3_GATHER_CHECKPOINT_NAME,
+    transformer_block_apply,
+    zero3_remat_policy,
+)
+
+
+class _StackedBlockParams(nn.Module):
+    """Creates the 12-tensor transformer params with a leading ``layers``
+    axis — the same names/shapes the ``nn.scan`` path produces, so
+    checkpoints interchange between the scanned, pipelined, and ZeRO-3
+    stacks."""
+
+    layer_cfg: object
+    n_layer: int
+
+    @nn.compact
+    def __call__(self):
+        cfg = self.layer_cfg
+        H = cfg.hidden_size
+        shapes = {"H": H, "3H": 3 * H, "I": cfg.intermediate}
+        init = nn.initializers.normal(stddev=cfg.initializer_range)
+        makers = {
+            "init": init,
+            "zeros": nn.initializers.zeros,
+            "ones32": nn.initializers.ones,
+            "zeros32": nn.initializers.zeros,
+        }
+        return {
+            name: self.param(
+                name, makers[kind],
+                (self.n_layer, *(shapes[d] for d in dims)), jnp.float32,
+            )
+            for name, dims, kind in TRANSFORMER_PARAM_LAYOUT
+        }
+
+
+def resolve_gather_block(n_layer, requested):
+    """Largest divisor of ``n_layer`` that is <= the requested gather
+    block — the scan body must see whole blocks, and silently rounding UP
+    would gather more layers than the config asked to hold."""
+    gb = max(1, min(int(requested), n_layer))
+    while n_layer % gb:
+        gb -= 1
+    return gb
+
+
+def zero3_scan_stack(
+    layer_cfg,
+    stacked,
+    x,
+    arming,
+    mesh,
+    *,
+    causal,
+    use_flash,
+    train,
+    dropout_key=None,
+    attention_mask=None,
+):
+    """Run the transformer stack over dp-sharded stacked params with
+    layer-wise just-in-time gather (the ZeRO-3 forward/backward seam).
+
+    ``stacked``: the 12-tensor dict of ``[L, ...]`` leaves (persistently
+    dp-sharded by the engine's stage-3 specs). ``arming``: the engine's
+    descriptor (runtime/engine.py:_arm_zero3_gather) —
+
+      ``specs``          {name: per-layer PartitionSpec}, the persistent
+                         spec with the ``data`` axis STRIPPED and the
+                         leading layers dim dropped: constraining a layer
+                         slice to it IS the all-gather (model-parallel
+                         axes stay sharded — stage 3 composes with TP,
+                         it never double-shards an axis);
+      ``stacked_specs``  {name: stacked PartitionSpec} pinning the scan
+                         operand to its persistent sharded layout so
+                         propagation cannot hoist one whole-stack gather
+                         out of the loop;
+      ``block``          gather block size (layers per scan iteration,
+                         the "gather layer i+1 while computing layer i"
+                         overlap structure — see module docstring).
+
+    Numerics contract (pinned in tests/unit/test_zero3.py):
+
+    - This FUNCTION at ``gather_block == 1`` is BITWISE-identical to the
+      ``nn.scan`` stack — loss AND grads — when both run over the same
+      layouts: the same ``transformer_block_apply`` runs per layer in
+      the same order and each layer body compiles in its own scan
+      iteration. At ``gather_block > 1`` (default 2) the unrolled layers
+      share one scan body, so the compiler may fuse across the layer
+      boundary and re-associate a reduction's last ulp — the price of
+      the overlap structure.
+    - End-to-end stage 3 vs stage 2 through the ENGINE: the first window
+      (identical initial params) is bitwise (loss + grad norm), and the
+      gathers/reduce-scatters themselves move exact bytes — but later
+      windows agree to float tolerance, not bitwise: sharding the
+      persistent weights changes which contractions GSPMD splits, and a
+      split contraction accumulates in a different order (sum(K/dp) +
+      sum(K/dp) vs sum(K)). Same math, re-associated — the exact analog
+      of the reference's fp16 bucketed-allreduce vs single-tensor
+      reductions differing in the last bits.
+    - Dropout masks are drawn from a per-layer ``fold_in`` chain like
+      the pipeline stack's, not flax's scan-lifted split — parity with
+      the nn.scan stack therefore additionally requires dropout
+      disabled; with dropout the masks differ by derivation, not
+      distribution.
+    """
+    n_layer = next(iter(stacked.values())).shape[0]
+    gb = resolve_gather_block(n_layer, arming.get("block", 2))
+    gather_specs = arming.get("specs", {})
+    stacked_specs = arming.get("stacked_specs", {})
+    # the inner block must NOT re-wrap itself in jax.checkpoint — the
+    # remat region here is the whole layer body INCLUDING the gather
+    inner_cfg = dataclasses.replace(
+        layer_cfg,
+        normalize_invertible=False,
+        gelu_checkpoint=False,
+        attn_dropout_checkpoint=False,
+    )
+    policy = zero3_remat_policy(layer_cfg)
+
+    # pin the scan operand to its persistent dp-sharded layout: without
+    # the anchor, sharding propagation from the replicated in-body use
+    # can decide to all-gather the ENTIRE stack before the loop — exactly
+    # the n_layers x residency stage 3 exists to avoid
+    anchored = {}
+    for name, leaf in stacked.items():
+        sp = stacked_specs.get(name)
+        if sp is not None and mesh is not None:
+            leaf = jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, sp)
+            )
+        anchored[name] = leaf
+
+    def gather_layer(pl):
+        out = {}
+        for name, leaf in pl.items():
+            sp = gather_specs.get(name)
+            if sp is not None and mesh is not None:
+                leaf = jax.lax.with_sharding_constraint(
+                    leaf, NamedSharding(mesh, sp)
+                )
+            out[name] = checkpoint_name(leaf, ZERO3_GATHER_CHECKPOINT_NAME)
+        return out
+
+    def layer_fn(x, pl, key):
+        # gather INSIDE the checkpointed region: the gathered weights are
+        # intermediates of the remat body, not scan residuals — backward
+        # re-gathers (zero3_remat_policy keeps them unsaveable)
+        pg = gather_layer(pl)
+        return transformer_block_apply(
+            inner_cfg, pg, x, attention_mask,
+            causal=causal, use_flash=use_flash, mesh=mesh,
+            train=train, dropout_rng=key,
+        )
+
+    layer_fn = jax.checkpoint(layer_fn, policy=policy)
+
+    reshaped = {
+        name: leaf.reshape(n_layer // gb, gb, *leaf.shape[1:])
+        for name, leaf in anchored.items()
+    }
+
+    def body(x, xs):
+        block, base = xs
+        # all gb gathers are issued against their own sharded slices
+        # before any depends on this iteration's activations — the
+        # scheduler is free to run gather(i+1) under compute(i)
+        for i in range(gb):
+            pl = {name: leaf[i] for name, leaf in block.items()}
+            key = (
+                jax.random.fold_in(dropout_key, base + i)
+                if dropout_key is not None
+                else None
+            )
+            x = layer_fn(x, pl, key)
+        return x, None
+
+    x, _ = jax.lax.scan(
+        body, x, (reshaped, jnp.arange(0, n_layer, gb, dtype=jnp.int32))
+    )
+    return x

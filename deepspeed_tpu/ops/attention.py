@@ -90,9 +90,14 @@ def mha_reference(
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
-# checkpoint_name tags this module emits (consumed by remat-policy specs,
-# ops/transformer.py:resolve_remat_policy)
-CHECKPOINT_NAMES = ("attn_probs", "flash_out", "flash_lse")
+# checkpoint_name tags remat-policy specs can name (consumed by
+# ops/transformer.py:resolve_remat_policy). attn_probs/flash_* are emitted
+# here; "zero3_gathered" tags the just-in-time all-gathered layer weights
+# of the ZeRO-3 stack (models/stack.py) — naming it in a policy SAVES the
+# gathered weights across backward (skipping the re-gather at n_layers x
+# full-layer HBM cost; the default stage-3 policies deliberately exclude
+# it so backward re-gathers instead).
+CHECKPOINT_NAMES = ("attn_probs", "flash_out", "flash_lse", "zero3_gathered")
 
 
 def pick_block(seq, maximum):

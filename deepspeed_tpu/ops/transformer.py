@@ -135,6 +135,39 @@ def resolve_remat_policy(spec: str):
     return _ft.reduce(jax.checkpoint_policies.save_from_both_policies, policies)
 
 
+#: checkpoint_name tag on the ZeRO-3 stack's just-in-time all-gathered
+#: layer weights (models/stack.py:zero3_scan_stack). Every default remat
+#: policy leaves it unsaved, so backward RE-GATHERS each layer's weights
+#: instead of holding n_layers x full copies as residuals; a policy spec
+#: naming it explicitly (resolve_remat_policy) opts into saving them.
+ZERO3_GATHER_CHECKPOINT_NAME = "zero3_gathered"
+
+
+def zero3_remat_policy(cfg: "DeepSpeedTransformerConfig"):
+    """The ``jax.checkpoint`` policy for one ZeRO-3 stack layer
+    (models/stack.py wraps each layer body — gather INCLUDED — in
+    ``jax.checkpoint`` with this policy, so gathered weights are never
+    scan residuals):
+
+    - remat configured (any reference memory-mode flag): the layer's own
+      policy applies unchanged — "full" saves nothing, and the named/dots
+      policies never match the gathered weights (an all-gather is neither
+      a dot nor one of their saved names) unless the spec names
+      ``zero3_gathered`` explicitly.
+    - remat NOT configured: everything except the gathered weights is
+      saved (``save_anything_except_these_names``) — the memory contract
+      stage 3 needs (backward re-gathers, 1/dp param residency) with the
+      minimum recompute: only the gathers re-run in backward.
+    """
+    if cfg.use_remat:
+        if cfg.remat_policy == "full":
+            return None  # plain jax.checkpoint: nothing saved
+        return resolve_remat_policy(cfg.remat_policy)
+    return jax.checkpoint_policies.save_anything_except_these_names(
+        ZERO3_GATHER_CHECKPOINT_NAME
+    )
+
+
 _STOCHASTIC_NOTICED = [False, False]  # [active-path notice, no-op notice]
 
 

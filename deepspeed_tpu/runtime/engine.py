@@ -335,6 +335,28 @@ class DeepSpeedEngine:
         self._grad_shardings = zero_lib.specs_to_shardings(
             self._grad_specs, self._mesh
         )
+        # ---- ZeRO-3: layer-wise JIT gather + collective overlap -------
+        # (docs/performance.md "ZeRO-3 & collective overlap"). The
+        # persistent param tree above is already dp-sharded by the
+        # stage-3 specs; arming the model's gather seam makes the forward
+        # all-gather each scanned layer's weights JUST IN TIME and free
+        # them after use (backward re-gathers under the remat policy), so
+        # steady-state param HBM is 1/dp instead of "sharded at rest,
+        # fully gathered for the whole step".
+        self.zero3_gather_enabled = False
+        self._zero3_shard_bytes = 0
+        self._zero3_gather_bytes = 0
+        if stage >= C.ZERO_OPTIMIZATION_WEIGHTS and dp_size > 1:
+            self._arm_zero3_gather(model)
+            if getattr(self.config.zero_config, "stage3_latency_hiding", True):
+                from .overlap import arm_latency_hiding
+
+                arm_latency_hiding()
+        else:
+            # a model reused from a previous stage-3 engine still carries
+            # that engine's arming — running its specs/mesh under this
+            # engine's layout would be silently wrong, so disarm
+            self._disarm_zero3_gather(model)
         # Reference ZeRO layout (deepspeed_zero_optimizer.py:256-263):
         # model params live in the compute dtype (replicated over dp like
         # the reference's fp16 params) while the fp32 MASTER copy rides
@@ -393,6 +415,8 @@ class DeepSpeedEngine:
             )
         else:
             self.params = jax.device_put(params_f32, self._param_shardings)
+        if stage >= C.ZERO_OPTIMIZATION_WEIGHTS and dp_size > 1:
+            self._zero3_account_bytes()
 
         # ---- optimizer ------------------------------------------------
         self.optimizer_obj = self._configure_optimizer()
@@ -541,6 +565,15 @@ class DeepSpeedEngine:
                 jax.tree_util.tree_leaves(self.optimizer_state)[0]
             ),
         )
+        if self.telemetry.enabled and (
+            self._zero3_shard_bytes or self._zero3_gather_bytes
+        ):
+            # static stage-3 layout gauges (docs/observability.md): what
+            # the dp sharding buys per chip and what each window pays in
+            # gather traffic for it
+            self.telemetry.set_zero3_layout(
+                self._zero3_shard_bytes, self._zero3_gather_bytes
+            )
 
         # ---- resilience (docs/resilience.md) --------------------------
         # Atomic-commit checkpoint protocol, retryable I/O, corruption
@@ -810,6 +843,155 @@ class DeepSpeedEngine:
             ranks=[0],
         )
         return adapters
+
+    def _arm_zero3_gather(self, model):
+        """Arm the model's ZeRO-3 layer-wise JIT gather seam
+        (models/stack.py; docs/performance.md "ZeRO-3 & collective
+        overlap"). The descriptor carries, per 12-tensor block param:
+
+        - the GATHERED per-layer spec — this leaf's persistent stage-3
+          spec with the ``data`` axis stripped and the leading layers
+          dim dropped. It is derived from ``self._param_specs``, so the
+          gather composes with whatever model-parallel layout the caller
+          passed (TP axes stay sharded; an axis is never double-used);
+        - the persistent STACKED spec, anchoring the scan operand so
+          sharding propagation cannot hoist one whole-stack gather out
+          of the loop;
+        - the gather block size (``zero_optimization.stage3_gather_block``):
+          layers gathered together per scan iteration, the "gather layer
+          i+1 while computing layer i" overlap structure.
+
+        Models without the seam (bare loss_fn callables, custom modules)
+        still train correctly at stage 3 — params stay dp-sharded and
+        XLA places the gathers — they just don't get the layer-wise
+        residency guarantee; logged so the gap is visible.
+        """
+        from jax.sharding import PartitionSpec
+        from ..ops.transformer import TRANSFORMER_PARAM_LAYOUT
+
+        mcfg = getattr(model, "config", None)
+        if mcfg is None or not hasattr(mcfg, "zero3_gather"):
+            log_dist(
+                "ZeRO-3: model exposes no layer-gather seam "
+                "(zero3_gather); persistent params stay dp-sharded and "
+                "XLA chooses gather placement",
+                ranks=[0],
+            )
+            return
+        blockers = []
+        if getattr(mcfg, "pipeline_stages", 1) > 1:
+            blockers.append("pipeline_stages > 1")
+        if getattr(mcfg, "moe_experts", 0) > 0:
+            blockers.append("moe_experts > 0")
+        if getattr(mcfg, "lora_rank", 0) > 0 or self.adapters_enabled:
+            blockers.append("LoRA adapters")
+        if blockers:
+            log_dist(
+                "ZeRO-3: layer-wise gather seam not armed ("
+                + ", ".join(blockers)
+                + " do not compose with the zero3 stack yet); params "
+                "stay dp-sharded, XLA chooses gather placement",
+                ranks=[0],
+            )
+            self._disarm_zero3_gather(model)
+            return
+        block_names = {n for n, _, _ in TRANSFORMER_PARAM_LAYOUT}
+        specs, stacked_specs, conflicts = {}, {}, set()
+        flat = jax.tree_util.tree_flatten_with_path(
+            self._param_specs,
+            is_leaf=lambda v: isinstance(v, jax.sharding.PartitionSpec),
+        )[0]
+        for path, spec in flat:
+            name = zero_lib._key_token(path[-1])
+            if name not in block_names:
+                continue
+            per_layer = PartitionSpec(
+                *zero_lib.gathered_spec(spec)[1:]
+            )
+            if name in specs and (
+                specs[name] != per_layer or stacked_specs[name] != spec
+            ):
+                # two stacks sharing tensor names with different layouts:
+                # replicate conservatively (correct either way) and drop
+                # the anchor rather than pin one stack's layout onto the
+                # other's operand
+                conflicts.add(name)
+            specs[name] = per_layer
+            stacked_specs[name] = spec
+        for name in conflicts:
+            specs[name] = PartitionSpec()
+            stacked_specs.pop(name, None)
+        if not specs:
+            self._disarm_zero3_gather(model)
+            return
+        gb = int(
+            getattr(self.config.zero_config, "stage3_gather_block", 2)
+        )
+        mcfg.zero3_gather = {
+            "specs": specs,
+            "stacked_specs": stacked_specs,
+            "block": gb,
+        }
+        self.zero3_gather_enabled = True
+        log_dist(
+            f"ZeRO-3: layer-wise JIT gather armed over {len(specs)} "
+            f"block tensors (gather_block={gb}; gathered weights remat "
+            "as 'zero3_gathered' — backward re-gathers)",
+            ranks=[0],
+        )
+
+    def _disarm_zero3_gather(self, model):
+        """Clear a gather-seam arming left on the model config by a
+        PREVIOUS engine (the arming is a config mutation so the flax
+        module picks it up inside apply): a non-stage-3 engine — or an
+        arming pass that declined — must not run the zero3 stack with a
+        stale engine's specs/mesh."""
+        mcfg = getattr(model, "config", None)
+        if mcfg is not None and getattr(mcfg, "zero3_gather", None) is not None:
+            mcfg.zero3_gather = None
+            log_dist(
+                "ZeRO-3: disarmed a stale layer-gather seam from a "
+                "previous engine on this model config",
+                ranks=[0],
+            )
+
+    def _zero3_account_bytes(self):
+        """Stage-3 memory/traffic accounting for the telemetry gauges
+        (train/zero3_param_shard_bytes, train/zero3_gather_bytes_per_
+        window): per-chip persistent param bytes under the FULL sharding
+        (every mesh axis a leaf's spec names divides its residency, not
+        just ZeRO's data axis), and the per-chip all-gather volume one
+        window moves for the JIT weight gathers (forward + backward
+        re-gather; each gather materializes the leaf with only the data
+        axis stripped — model-parallel shards stay sharded — so a ring
+        all-gather delivers the other dp shards' (dp-1)/dp of the
+        mp-local portion)."""
+        mesh_axes = dict(self._mesh.shape) if self._mesh is not None else {}
+
+        def spec_factor(spec, skip=()):
+            f = 1
+            for e in spec:
+                names = e if isinstance(e, tuple) else (e,)
+                for n in names:
+                    if n is not None and n not in skip:
+                        f *= mesh_axes.get(n, 1)
+            return f
+
+        resident = gather = 0
+        flat = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        specs_flat = jax.tree_util.tree_leaves(
+            self._param_specs,
+            is_leaf=lambda v: isinstance(v, jax.sharding.PartitionSpec),
+        )
+        for (path, leaf), spec in zip(flat, specs_flat):
+            nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            resident += nbytes // spec_factor(spec)
+            if zero_lib.has_axis(spec):
+                dp = mesh_axes.get(C.DATA_AXIS, 1)
+                mp_local = nbytes // spec_factor(spec, skip=(C.DATA_AXIS,))
+                gather += 2 * (mp_local * (dp - 1) // dp)
+        self._zero3_shard_bytes = resident
+        self._zero3_gather_bytes = gather
 
     def _check_zero_optimizer_tested(self, name):
         """ZeRO wrapping an optimizer outside the tested set requires the

@@ -41,6 +41,38 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..config import constants as C
 from ..parallel import mesh as mesh_lib
 from ..telemetry.registry import count_suppressed
+from ..utils.logging import warn_once
+
+
+def has_axis(spec, axis_name=C.DATA_AXIS):
+    """True when ``spec`` shards any dim over ``axis_name``."""
+    return any(
+        axis_name == e or (isinstance(e, tuple) and axis_name in e)
+        for e in spec
+    )
+
+
+def strip_axis_entry(entry, axis_name=C.DATA_AXIS):
+    """One PartitionSpec entry with ``axis_name`` removed (None / str /
+    tuple forms all handled) — the per-dim piece of "this leaf's spec
+    minus its ZeRO data sharding"."""
+    if entry is None:
+        return None
+    if isinstance(entry, tuple):
+        kept = tuple(e for e in entry if e != axis_name)
+        if not kept:
+            return None
+        return kept if len(kept) > 1 else kept[0]
+    return None if entry == axis_name else entry
+
+
+def gathered_spec(spec, axis_name=C.DATA_AXIS):
+    """``spec`` with the data axis stripped from every dim: the layout a
+    stage-3 leaf takes while a layer COMPUTES with it (model-parallel
+    axes stay sharded; only the ZeRO partition gathers). Constraining a
+    sharded leaf to this spec inside jit IS the just-in-time all-gather
+    (models/stack.py)."""
+    return PartitionSpec(*(strip_axis_entry(e, axis_name) for e in spec))
 
 
 def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=None,
@@ -61,10 +93,7 @@ def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=Non
     existing = existing + (None,) * (len(shape) - len(existing))
     if dp_size <= 1:
         return PartitionSpec(*existing) if existing_spec is not None else PartitionSpec()
-    if any(
-        axis_name == e or (isinstance(e, tuple) and axis_name in e)
-        for e in existing
-    ):
+    if has_axis(existing, axis_name):
         # already sharded over this axis (e.g. MoE expert weights over the
         # data axis): a spec may not repeat a mesh axis — the leaf is
         # already dp_size-way partitioned, which is what ZeRO wants
@@ -86,15 +115,36 @@ def leaf_partition_spec(shape, dp_size, axis_name=C.DATA_AXIS, existing_spec=Non
 
 
 def zero_param_specs(params, dp_size, stage, model_specs=None, prefer_leading=False):
-    """Partition specs for *parameters* (sharded only at stage 3)."""
+    """Partition specs for *parameters* (sharded only at stage 3).
+
+    Stage-3 leaves with NO dp-divisible free dimension stay replicated
+    (warned once, never a crash): the analog of the reference's
+    ``zero_empty_partition`` edge case — small norms/biases whose dims
+    all resist the split simply keep full residency, and the memory
+    accounting (engine zero3 gauges) reflects it.
+    """
 
     def spec(path, leaf):
         ms = _lookup(model_specs, path)
         if stage >= C.ZERO_OPTIMIZATION_WEIGHTS:
-            return leaf_partition_spec(
+            out = leaf_partition_spec(
                 leaf.shape, dp_size, existing_spec=ms,
                 prefer_leading=prefer_leading,
             )
+            if (
+                dp_size > 1
+                and len(leaf.shape) > 0
+                and not has_axis(out, C.DATA_AXIS)
+            ):
+                warn_once(
+                    "zero3-replicated-leaves",
+                    "ZeRO stage 3: parameter leaf %s %s has no free "
+                    "dp%d-divisible dimension — it stays REPLICATED "
+                    "(further such leaves are not logged)",
+                    "/".join(str(_key_token(k)) for k in path),
+                    tuple(leaf.shape), dp_size,
+                )
+            return out
         return ms if ms is not None else PartitionSpec()
 
     return _tree_map_with_path(spec, params)

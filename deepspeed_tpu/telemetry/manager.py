@@ -59,6 +59,15 @@ ENGINE_METRICS = (
     ("counter", "jax/compile_cache_misses", "persistent-compile-cache misses (programs compiled and written to the cache)"),
     ("gauge", "device/bytes_in_use", "device HBM bytes in use (0 when the platform reports none)"),
     ("gauge", "device/peak_bytes_in_use", "peak device HBM bytes in use"),
+    # per-WINDOW HBM high-water (vs device/* above, which samples at the
+    # export cadence): micro_batch headroom becomes visible in the
+    # trajectory instead of inferred from crash logs (bench.py records it
+    # into extras). 0 where the platform reports no memory stats (CPU).
+    ("gauge", "train/hbm_peak_bytes", "per-chip HBM high-water (device memory_stats peak) sampled at every window boundary; 0 when the platform reports none"),
+    # ZeRO-3 layout gauges (docs/performance.md "ZeRO-3 & collective
+    # overlap"): set once at engine init, 0 below stage 3
+    ("gauge", "train/zero3_param_shard_bytes", "per-chip persistent parameter bytes under ZeRO-3 dp sharding (sharded tree / dp + replicated leaves); 0 below stage 3"),
+    ("gauge", "train/zero3_gather_bytes_per_window", "estimated per-chip all-gather traffic per window for ZeRO-3 just-in-time weight gathers (forward + backward re-gather); 0 below stage 3"),
     # dataloader/* is the data-pipeline namespace (docs/performance.md
     # "Input pipeline & compile cache"): the loader's prefetch queue and
     # the window stager (runtime/staging.py) export here together
@@ -205,6 +214,22 @@ def register_inference_metrics(registry):
     return registry
 
 
+def hbm_peak_bytes():
+    """Per-chip HBM high-water (device ``memory_stats`` peak), or None
+    where the platform reports no memory stats (CPU). The single probe
+    behind the ``train/hbm_peak_bytes`` gauge and bench.py's per-attempt
+    ``hbm_peak_bytes`` extra."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return int(stats.get("peak_bytes_in_use", 0))
+
+
 class Telemetry:
     def __init__(
         self,
@@ -238,6 +263,9 @@ class Telemetry:
         self._last_export_time = None
         self._tokens_since_export = 0
         self._samples_since_export = 0
+        # per-window HBM sampling stops probing after the first "platform
+        # reports no memory stats" answer (CPU backends)
+        self._hbm_stats_absent = False
         if not enabled:
             return
         for kind, name, help_text in ENGINE_METRICS:
@@ -338,6 +366,7 @@ class Telemetry:
             )
             self._window_start = None
         self._windows_ended += 1
+        self._sample_hbm_peak()
         if self.watchdog is not None:
             self.watchdog.beat(step=self._windows_ended)
         self.registry.gauge("train/global_steps").set(global_steps)
@@ -383,6 +412,29 @@ class Telemetry:
         if not self.enabled:
             return
         self.registry.gauge("dataloader/queue_depth").set(depth)
+
+    def set_zero3_layout(self, shard_bytes, gather_bytes_per_window):
+        """Static ZeRO-3 layout gauges (engine init, stage 3 only)."""
+        if not self.enabled:
+            return
+        self.registry.gauge("train/zero3_param_shard_bytes").set(
+            shard_bytes
+        )
+        self.registry.gauge("train/zero3_gather_bytes_per_window").set(
+            gather_bytes_per_window
+        )
+
+    def _sample_hbm_peak(self):
+        """Per-window HBM high-water sample (train/hbm_peak_bytes): one
+        cheap host call where the platform reports memory stats, a no-op
+        (after the first probe) everywhere else."""
+        if self._hbm_stats_absent:
+            return
+        peak = hbm_peak_bytes()
+        if peak is None:
+            self._hbm_stats_absent = True  # CPU etc.: stop probing
+            return
+        self.registry.gauge("train/hbm_peak_bytes").set(peak)
 
     # -- window-stager hooks (runtime/staging.py; called from BOTH the
     # consuming thread and the staging worker — registry ops are

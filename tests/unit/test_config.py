@@ -160,6 +160,71 @@ def test_zero_stage_too_high():
         )
 
 
+# ------------------------------------------------- ZeRO stage-3 validation
+def _zero(z):
+    return {"train_batch_size": 4, "zero_optimization": z}
+
+
+@pytest.mark.parametrize("stage", [-1, 4, True, "2", 1.5])
+def test_zero_stage_must_be_real_stage(stage):
+    with pytest.raises(DeepSpeedConfigError):
+        make(_zero({"stage": stage}))
+
+
+@pytest.mark.parametrize(
+    "key", ["stag", "stage3_gather_blocks", "overlap_com", "zero3"]
+)
+def test_zero_unknown_keys_rejected(key):
+    # a typo'd knob must not silently mean its default
+    with pytest.raises(DeepSpeedConfigError, match="unknown"):
+        make(_zero({"stage": 3, key: 1}))
+
+
+@pytest.mark.parametrize(
+    "knob,value",
+    [("stage3_gather_block", 2), ("stage3_latency_hiding", True)],
+)
+@pytest.mark.parametrize("stage", [0, 1, 2])
+def test_zero_stage3_knobs_rejected_below_stage3(knob, value, stage):
+    # stage-3 machinery spelled out while a typo'd stage leaves params
+    # replicated must fail at init, not train at the wrong memory profile
+    with pytest.raises(DeepSpeedConfigError, match="stage-3"):
+        make(_zero({"stage": stage, knob: value}))
+
+
+def test_zero_stage3_knobs_parse_at_stage3():
+    cfg = make(
+        _zero(
+            {
+                "stage": 3,
+                "stage3_gather_block": 4,
+                "stage3_latency_hiding": False,
+            }
+        )
+    )
+    assert cfg.zero_optimization_stage == 3
+    assert cfg.zero_config.stage3_gather_block == 4
+    assert cfg.zero_config.stage3_latency_hiding is False
+
+
+def test_zero_stage3_knob_defaults():
+    cfg = make(_zero({"stage": 3}))
+    assert cfg.zero_config.stage3_gather_block == 2
+    assert cfg.zero_config.stage3_latency_hiding is True
+
+
+@pytest.mark.parametrize("gb", [0, -1, True, "2", 1.5])
+def test_zero_stage3_gather_block_type_checked(gb):
+    with pytest.raises(DeepSpeedConfigError):
+        make(_zero({"stage": 3, "stage3_gather_block": gb}))
+
+
+@pytest.mark.parametrize("lh", [1, "true", None])
+def test_zero_stage3_latency_hiding_type_checked(lh):
+    with pytest.raises(DeepSpeedConfigError):
+        make(_zero({"stage": 3, "stage3_latency_hiding": lh}))
+
+
 def test_fp16_block():
     cfg = make(
         {
