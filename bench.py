@@ -2153,6 +2153,369 @@ def smoke_chaos_fleet():
     }))
 
 
+def _launch_node(node_id, engine_spec, replicas=("r0",), lease_secs=10.0,
+                 resume_grace_secs=10.0):
+    """Spawn one ``python -m deepspeed_tpu.serving.node`` subprocess and
+    block on its stdout 'listening' announcement (printed only after
+    every engine is built — a connecting client never races an
+    initializing model). Returns (proc, (host, port))."""
+    spec = {
+        "node_id": node_id,
+        "replicas": {name: engine_spec for name in replicas},
+        "lease_secs": lease_secs,
+        "resume_grace_secs": resume_grace_secs,
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepspeed_tpu.serving.node",
+         "--spec", json.dumps(spec), "--port", "0"],
+        stdout=subprocess.PIPE, stderr=None, text=True,
+        env=dict(os.environ),
+    )
+    line = proc.stdout.readline()
+    if not line:
+        raise RuntimeError(
+            f"node {node_id} exited before announcing its port "
+            f"(rc {proc.poll()})"
+        )
+    info = json.loads(line)
+    assert info["event"] == "listening", info
+    return proc, (info["host"], info["port"])
+
+
+def smoke_chaos_net():
+    """CI fast path (``python bench.py --smoke-chaos-net``): the socket
+    transport's failure envelope over REAL TCP to real node-agent
+    subprocesses (docs/serving.md "Networked fleet"). Two windows:
+
+      A. Network chaos absorbed in place: a 2-node fleet of real GPT-2
+         replicas under a seeded client-side schedule covering all four
+         socket seams — one garbled frame (frame.corrupt: the node
+         counts-and-drops, the lost op falls through), one peer RST
+         mid-conversation (conn.reset: reconnect-with-resume re-attaches
+         the session), one black-holed frame (net.partition: only the
+         reply timeout notices), one send stall (conn.stall). Every
+         request completes exactly once with bitwise greedy parity
+         against a clean single-engine run, with ZERO re-routes burned.
+      B. Node failover: one node SIGKILLed with requests in flight; the
+         client's reconnect budget exhausts, the replica flips failed,
+         and the router evicts + re-routes within the max_reroutes
+         budget — exactly-once delivery, bitwise parity, no hangs.
+
+    Prints one JSON line and exits non-zero on any failed check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+    from deepspeed_tpu.serving import FleetRouter, SocketReplica
+    from deepspeed_tpu.serving.worker import build_engine_from_spec
+    from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+    extras = {}
+
+    # ---- window A: the four socket seams vs retry/reconnect -----------
+    model_kw = {
+        "vocab_size": 64, "n_positions": 32, "n_embd": 16, "n_layer": 1,
+        "n_head": 2, "use_flash": False,
+    }
+    engine_block = {
+        "max_batch_slots": 2, "max_seq_len": 24, "prefill_len": 8,
+        "sampling": {"greedy": True},
+    }
+    spec = {"model": model_kw, "init_seed": 0,
+            "config": {"inference": engine_block}}
+    rng = np.random.default_rng(11)
+    prompts = [[int(t) for t in rng.integers(0, 64, 6)] for _ in range(6)]
+
+    single = build_engine_from_spec(spec)
+    reference = single.generate(prompts, max_new_tokens=5)
+    single.close()
+
+    proc_a, addr_a = _launch_node("na", spec)
+    proc_b, addr_b = _launch_node("nb", spec)
+    # every client->node send on replica na:r0 traverses all four armed
+    # sites (the hello is raw, uncounted); submits contribute traversals
+    # but HOW MANY land on na:r0 is placement's call (a reconnect blip
+    # steers traffic to nb), so the drive loop below keeps snapshot RPCs
+    # flowing until the later sites reach their firing traversal
+    faults = FaultInjector(
+        [FaultSpec("frame.corrupt", after=2, times=1, seed=0),
+         FaultSpec("conn.reset", after=4, times=1, seed=0),
+         FaultSpec("net.partition", after=6, times=1, seed=0),
+         FaultSpec("conn.stall", after=8, times=1,
+                   args={"duration_ms": 150}, seed=0)],
+        seed=0,
+    )
+    reg = MetricsRegistry()
+    ra = SocketReplica(
+        "na:r0", addr_a, remote_name="r0", rpc_timeout=1.5,
+        rpc_retries=2, rpc_backoff_secs=0.05,
+        reconnect_backoff_secs=0.05, registry=reg, fault_injector=faults,
+    )
+    rb = SocketReplica(
+        "nb:r0", addr_b, remote_name="r0", rpc_timeout=1.5, registry=reg,
+    )
+    # failure threshold ABOVE the armed fault count: window A pins the
+    # transport absorbing chaos in place (fall-through + retry +
+    # reconnect), not the breaker path (--smoke-chaos-fleet owns that)
+    router = FleetRouter(
+        [ra, rb], registry=reg, monitor_interval=0.01,
+        telemetry_refresh_secs=3600.0, breaker_failure_threshold=5,
+        breaker_backoff_secs=0.25,
+    ).start()
+    try:
+        t0 = time.monotonic()
+        reqs = [
+            router.submit(p, tenant=f"tenant-{i % 2}", max_new_tokens=5)
+            for i, p in enumerate(prompts)
+        ]
+        # deterministically drive the faulted seam while the fleet is
+        # decoding: placement is load-aware, so the submits alone may
+        # leave na:r0 short of the later sites' firing traversals —
+        # snapshot RPCs are real frames over the real socket and the
+        # retry/reconnect machinery absorbs whichever fault they eat
+        sites = ("frame.corrupt", "conn.reset", "net.partition",
+                 "conn.stall")
+        drive_deadline = time.monotonic() + 60.0
+        while (
+            any(faults.injected.get(s, 0) < 1 for s in sites)
+            and time.monotonic() < drive_deadline
+        ):
+            try:
+                ra.load_snapshot()
+            except Exception:
+                pass  # this snapshot ate a fault; the next poll re-drives
+            time.sleep(0.02)
+        outs = [r.result(120.0) for r in reqs]
+        window_a = time.monotonic() - t0
+        assert outs == reference, "divergence under socket chaos"
+        assert all(r.finish_reason == "max_new_tokens" for r in reqs)
+        for site in ("frame.corrupt", "conn.reset", "net.partition",
+                     "conn.stall"):
+            assert faults.injected.get(site) == 1, (site, faults.injected)
+        snap = reg.snapshot()
+        assert snap["fleet/requests_completed"] == 6, snap
+        assert snap["fleet/requests_rerouted"] == 0, (
+            "chaos was absorbed by re-routes instead of the transport"
+        )
+        assert snap["fleet/net_reconnects"] >= 1, (
+            "the injected RST never exercised reconnect-with-resume"
+        )
+        assert window_a < 90.0, f"window A took {window_a:.1f}s"
+        extras["chaos_sites_fired"] = 4
+        extras["net_reconnects"] = int(snap["fleet/net_reconnects"])
+        extras["window_a_secs"] = round(window_a, 2)
+    finally:
+        router.shutdown()
+        for proc in (proc_a, proc_b):
+            proc.kill()
+            proc.wait(30)
+
+    # ---- window B: node failover within the re-route budget -----------
+    stub_spec = {"stub": {"delay_secs": 1.0}}
+    proc_c, addr_c = _launch_node("nc", stub_spec)
+    proc_d, addr_d = _launch_node("nd", stub_spec)
+    reg = MetricsRegistry()
+    rc = SocketReplica(
+        "nc:r0", addr_c, remote_name="r0", rpc_timeout=1.0,
+        reconnect_attempts=2, reconnect_backoff_secs=0.05, registry=reg,
+    )
+    rd = SocketReplica(
+        "nd:r0", addr_d, remote_name="r0", rpc_timeout=1.0, registry=reg,
+    )
+    router = FleetRouter(
+        [rc, rd], registry=reg, placement="round_robin",
+        monitor_interval=0.01, telemetry_refresh_secs=3600.0,
+        breaker_failure_threshold=1, breaker_backoff_secs=0.3,
+    ).start()
+    try:
+        t0 = time.monotonic()
+        # round-robin: requests 0/2 land on nc, 1/3 on nd; the stub's 1s
+        # completion delay keeps nc's pair IN FLIGHT when the node dies
+        reqs = [router.submit([30 + i], max_new_tokens=3)
+                for i in range(4)]
+        proc_c.kill()
+        outs = [r.result(120.0) for r in reqs]
+        failover = time.monotonic() - t0
+        for i, out in enumerate(outs):
+            base = 30 + i
+            assert out == [(base + j + 1) % 1000 for j in range(3)], (
+                i, out,
+            )
+        assert all(r.reroutes <= router.max_reroutes for r in reqs)
+        assert any(r.reroutes >= 1 for r in reqs), (
+            "the killed node's requests never re-routed"
+        )
+        snap = reg.snapshot()
+        assert snap["fleet/requests_completed"] == 4, snap
+        assert snap["fleet/requests_rerouted"] >= 1, snap
+        assert "nc:r0" in router.evicted_ids, (
+            "the dead node's replica was never evicted"
+        )
+        assert failover < 60.0, f"failover took {failover:.1f}s"
+        extras["failover_reroutes"] = int(snap["fleet/requests_rerouted"])
+        extras["failover_secs"] = round(failover, 2)
+    finally:
+        router.shutdown()
+        for proc in (proc_c, proc_d):
+            proc.kill()
+            proc.wait(30)
+
+    print(json.dumps({
+        "metric": "smoke_chaos_net",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": extras,
+    }))
+
+
+def smoke_door():
+    """CI fast path (``python bench.py --smoke-door``): one streamed
+    request through the HTTP/SSE front door over a real tiny GPT-2
+    fleet (docs/serving.md "Networked fleet") — the first SSE token
+    event must arrive BEFORE generation completes (pinned by asserting
+    the first received chunk carries a token event but no done event,
+    with the remaining stream arriving afterwards), every token is its
+    own event, the done payload is bitwise-identical to engine.generate,
+    and an abandoned stream's slot frees via cancel instead of decoding
+    to its budget. Prints one JSON line; exits non-zero on any failed
+    check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import socket as socketlib
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.serving import FleetRouter, HTTPDoor, InProcessReplica
+
+    cfg = GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False,
+    )
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(3)
+    ids0 = jnp.asarray(rng.integers(0, 128, (1, 8)), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids0, ids0,
+    )["params"]
+    engine_block = {
+        "max_batch_slots": 2, "max_seq_len": 64, "prefill_len": 16,
+        "sampling": {"greedy": True},
+    }
+
+    def engine_factory():
+        return deepspeed_tpu.init_inference(
+            model=model, model_parameters=params,
+            config={"inference": dict(engine_block)},
+        )
+
+    prompt = [int(t) for t in rng.integers(0, 128, 9)]
+    n_tokens = 40
+    single = engine_factory()
+    reference = single.generate([prompt], max_new_tokens=n_tokens)[0]
+    single.close()
+
+    replica = InProcessReplica("0", engine_factory)
+    router = FleetRouter([replica], monitor_interval=0.005).start()
+    door = HTTPDoor(router)
+    host, port = door.start()
+    extras = {}
+    try:
+        # ---- the streaming pin ----------------------------------------
+        sock = socketlib.create_connection((host, port))
+        sock.settimeout(60.0)
+        body = json.dumps({
+            "prompt": prompt, "max_new_tokens": n_tokens, "stream": True,
+        }).encode()
+        sock.sendall(
+            b"POST /v1/generate HTTP/1.1\r\nHost: door\r\n"
+            b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+        )
+        buf = b""
+        while b"event: token" not in buf:
+            buf += sock.recv(4096)
+        t_first = time.monotonic()
+        # the acceptance pin: at first-token time the terminal event has
+        # not been sent — 39 decode steps still separate us from done
+        assert b"event: done" not in buf, (
+            "the whole generation arrived with the first event: "
+            "streaming is not incremental"
+        )
+        while b"event: done" not in buf:
+            chunk = sock.recv(4096)
+            assert chunk, "stream ended without a done event"
+            buf += chunk
+        t_done = time.monotonic()
+        sock.close()
+        assert t_done > t_first
+        tokens = [
+            json.loads(line[6:])
+            for line in buf.split(b"\n")
+            if line.startswith(b"data: ") and b'"t"' in line
+        ]
+        dones = [
+            json.loads(line[6:])
+            for line in buf.split(b"\n")
+            if line.startswith(b"data: ") and b"finish_reason" in line
+        ]
+        assert len(tokens) == n_tokens, (
+            f"{len(tokens)} token events for {n_tokens} tokens — "
+            "not one event per token"
+        )
+        assert [t["i"] for t in tokens] == list(range(n_tokens))
+        assert [t["t"] for t in tokens] == reference, (
+            "streamed tokens diverged from engine.generate"
+        )
+        assert dones and dones[0]["tokens"] == reference
+        assert dones[0]["finish_reason"] == "max_new_tokens"
+        snap = router.metrics.snapshot()
+        assert snap["door/stream_ttft_ms/count"] == 1
+        assert snap["door/open_streams"] == 0
+        extras["tokens_streamed"] = n_tokens
+        extras["stream_ms"] = round((t_done - t_first) * 1e3, 1)
+        extras["ttft_ms"] = round(snap["door/stream_ttft_ms/sum"], 1)
+
+        # ---- abandoned stream frees its slot --------------------------
+        sock = socketlib.create_connection((host, port))
+        sock.settimeout(60.0)
+        body = json.dumps({
+            "prompt": prompt, "max_new_tokens": n_tokens, "stream": True,
+        }).encode()
+        sock.sendall(
+            b"POST /v1/generate HTTP/1.1\r\nHost: door\r\n"
+            b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+        )
+        buf = b""
+        while b"event: token" not in buf:
+            buf += sock.recv(4096)
+        sock.close()  # walk away mid-generation
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if replica.load_snapshot()["active_slots"] == 0:
+                break
+            time.sleep(0.005)
+        snap_r = replica.load_snapshot()
+        assert snap_r["active_slots"] == 0, "abandoned slot never freed"
+        # cancelled, not completed: the scheduler's completion counter
+        # moved only for the FIRST (finished) request
+        assert snap_r["requests_completed"] == 1, snap_r
+        snap = router.metrics.snapshot()
+        assert snap["door/client_disconnects"] == 1
+        extras["disconnect_cancels"] = 1
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+    print(json.dumps({
+        "metric": "smoke_door",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": extras,
+    }))
+
+
 def smoke_lora():
     """CI fast path (``python bench.py --smoke-lora``): the multi-tenant
     LoRA vertical slice end to end on CPU (docs/adapters.md) — a tiny
@@ -2511,6 +2874,12 @@ def main():
         return
     if "--smoke-chaos-fleet" in sys.argv:
         smoke_chaos_fleet()
+        return
+    if "--smoke-chaos-net" in sys.argv:
+        smoke_chaos_net()
+        return
+    if "--smoke-door" in sys.argv:
+        smoke_door()
         return
     if "--smoke-chaos" in sys.argv:
         smoke_chaos()

@@ -114,6 +114,7 @@ def init_inference(
 def init_fleet(
     engine_factory=None,
     worker_spec=None,
+    nodes=None,
     config=None,
     registry=None,
     start=True,
@@ -122,14 +123,18 @@ def init_fleet(
     docs/serving.md): a ``FleetRouter`` spreading requests over N
     inference-engine replicas with per-tenant rate limits, pluggable
     placement (least-loaded / prefix-affinity), and rolling-restart
-    lifecycle. Pass ``engine_factory`` (in-process replicas) or
-    ``worker_spec`` (one engine per worker subprocess); the ``"serving"``
-    config block sizes the fleet."""
+    lifecycle. Pass ``engine_factory`` (in-process replicas),
+    ``worker_spec`` (one engine per worker subprocess), or ``nodes``
+    (the socket backend's fleet map — one ``SocketReplica`` per
+    (node, replica) pair against already-running node agents,
+    docs/serving.md "Networked fleet"); the ``"serving"`` config block
+    sizes the fleet."""
     from .serving import init_fleet as _init_fleet
 
     return _init_fleet(
         engine_factory=engine_factory,
         worker_spec=worker_spec,
+        nodes=nodes,
         config=config,
         registry=registry,
         start=start,

@@ -664,6 +664,42 @@ class DeepSpeedConfig:
             bo_dict, C.SERVING_BROWNOUT_MAX_NEW_TOKENS,
             C.SERVING_BROWNOUT_MAX_NEW_TOKENS_DEFAULT,
         )
+        sock_dict = get_dict_param(srv_dict, C.SERVING_SOCKET)
+        self.serving_socket_lease_secs = get_scalar_param(
+            sock_dict, C.SERVING_SOCKET_LEASE_SECS,
+            C.SERVING_SOCKET_LEASE_SECS_DEFAULT,
+        )
+        self.serving_socket_reconnect_attempts = get_scalar_param(
+            sock_dict, C.SERVING_SOCKET_RECONNECT_ATTEMPTS,
+            C.SERVING_SOCKET_RECONNECT_ATTEMPTS_DEFAULT,
+        )
+        self.serving_socket_reconnect_backoff_secs = get_scalar_param(
+            sock_dict, C.SERVING_SOCKET_RECONNECT_BACKOFF_SECS,
+            C.SERVING_SOCKET_RECONNECT_BACKOFF_SECS_DEFAULT,
+        )
+        self.serving_socket_connect_timeout_secs = get_scalar_param(
+            sock_dict, C.SERVING_SOCKET_CONNECT_TIMEOUT_SECS,
+            C.SERVING_SOCKET_CONNECT_TIMEOUT_SECS_DEFAULT,
+        )
+        self.serving_socket_connect_retries = get_scalar_param(
+            sock_dict, C.SERVING_SOCKET_CONNECT_RETRIES,
+            C.SERVING_SOCKET_CONNECT_RETRIES_DEFAULT,
+        )
+        http_dict = get_dict_param(srv_dict, C.SERVING_HTTP)
+        self.serving_http_host = get_scalar_param(
+            http_dict, C.SERVING_HTTP_HOST, C.SERVING_HTTP_HOST_DEFAULT
+        )
+        self.serving_http_port = get_scalar_param(
+            http_dict, C.SERVING_HTTP_PORT, C.SERVING_HTTP_PORT_DEFAULT
+        )
+        self.serving_http_max_buffer_bytes = get_scalar_param(
+            http_dict, C.SERVING_HTTP_MAX_BUFFER_BYTES,
+            C.SERVING_HTTP_MAX_BUFFER_BYTES_DEFAULT,
+        )
+        self.serving_http_overrun_policy = get_scalar_param(
+            http_dict, C.SERVING_HTTP_OVERRUN_POLICY,
+            C.SERVING_HTTP_OVERRUN_POLICY_DEFAULT,
+        )
 
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
@@ -1777,6 +1813,94 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"{bo}.{C.SERVING_BROWNOUT_MAX_NEW_TOKENS} must be an "
                 f"integer >= 1, got {floor!r}"
+            )
+        sk = f"{C.SERVING}.{C.SERVING_SOCKET}"
+        sock_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING), C.SERVING_SOCKET
+        )
+        valid_sock = {
+            C.SERVING_SOCKET_LEASE_SECS,
+            C.SERVING_SOCKET_RECONNECT_ATTEMPTS,
+            C.SERVING_SOCKET_RECONNECT_BACKOFF_SECS,
+            C.SERVING_SOCKET_CONNECT_TIMEOUT_SECS,
+            C.SERVING_SOCKET_CONNECT_RETRIES,
+        }
+        unknown = set(sock_dict) - valid_sock
+        if unknown:
+            # a typo'd lease_secs would silently mean "default lease"
+            raise DeepSpeedConfigError(
+                f"{sk}: unknown keys {sorted(unknown)}; valid: "
+                f"{sorted(valid_sock)}"
+            )
+        for key, value in (
+            (C.SERVING_SOCKET_LEASE_SECS, self.serving_socket_lease_secs),
+            (C.SERVING_SOCKET_RECONNECT_BACKOFF_SECS,
+             self.serving_socket_reconnect_backoff_secs),
+            (C.SERVING_SOCKET_CONNECT_TIMEOUT_SECS,
+             self.serving_socket_connect_timeout_secs),
+        ):
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or value <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{sk}.{key} must be a number > 0, got {value!r}"
+                )
+        for key, value, floor_v in (
+            (C.SERVING_SOCKET_RECONNECT_ATTEMPTS,
+             self.serving_socket_reconnect_attempts, 0),
+            (C.SERVING_SOCKET_CONNECT_RETRIES,
+             self.serving_socket_connect_retries, 1),
+        ):
+            if not isinstance(value, int) or isinstance(value, bool) or (
+                value < floor_v
+            ):
+                raise DeepSpeedConfigError(
+                    f"{sk}.{key} must be an integer >= {floor_v}, got "
+                    f"{value!r}"
+                )
+        ht = f"{C.SERVING}.{C.SERVING_HTTP}"
+        http_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING), C.SERVING_HTTP
+        )
+        valid_http = {
+            C.SERVING_HTTP_HOST, C.SERVING_HTTP_PORT,
+            C.SERVING_HTTP_MAX_BUFFER_BYTES, C.SERVING_HTTP_OVERRUN_POLICY,
+        }
+        unknown = set(http_dict) - valid_http
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"{ht}: unknown keys {sorted(unknown)}; valid: "
+                f"{sorted(valid_http)}"
+            )
+        if not isinstance(self.serving_http_host, str):
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.SERVING_HTTP_HOST} must be a string, got "
+                f"{self.serving_http_host!r}"
+            )
+        port = self.serving_http_port
+        if not isinstance(port, int) or isinstance(port, bool) or (
+            not 0 <= port <= 65535
+        ):
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.SERVING_HTTP_PORT} must be an integer in "
+                f"[0, 65535] (0 = ephemeral), got {port!r}"
+            )
+        buf = self.serving_http_max_buffer_bytes
+        if not isinstance(buf, int) or isinstance(buf, bool) or buf < 1024:
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.SERVING_HTTP_MAX_BUFFER_BYTES} must be an "
+                f"integer >= 1024 (one SSE event must fit), got {buf!r}"
+            )
+        if (
+            self.serving_http_overrun_policy
+            not in C.SERVING_HTTP_VALID_OVERRUN_POLICIES
+        ):
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.SERVING_HTTP_OVERRUN_POLICY} must be one of "
+                f"{C.SERVING_HTTP_VALID_OVERRUN_POLICIES}, got "
+                f"{self.serving_http_overrun_policy!r}"
             )
 
     def _do_warning_check(self):

@@ -675,7 +675,7 @@ SERVING_REPLICAS_DEFAULT = 1
 # the router down).
 SERVING_BACKEND = "backend"
 SERVING_BACKEND_DEFAULT = "in_process"
-SERVING_VALID_BACKENDS = ("in_process", "subprocess")
+SERVING_VALID_BACKENDS = ("in_process", "subprocess", "socket")
 # Placement policy: "least_loaded" scores queue depth + slot occupancy,
 # "prefix_affinity" routes identical templated prompt prefixes to the
 # replica that served them (the hook a cross-request prefix cache plugs
@@ -754,6 +754,37 @@ SERVING_BROWNOUT_QUEUE_RATIO = "queue_ratio"
 SERVING_BROWNOUT_QUEUE_RATIO_DEFAULT = None
 SERVING_BROWNOUT_MAX_NEW_TOKENS = "max_new_tokens"
 SERVING_BROWNOUT_MAX_NEW_TOKENS_DEFAULT = 16
+# Socket replica transport (serving/transport.py + node.py,
+# docs/serving.md "Networked fleet"): heartbeat lease window (a
+# connection without a pong for lease_secs is torn down and
+# reconnected), reconnect-with-resume budget + backoff, and the dial
+# timeout/retry for the initial connect (a dropped accept costs a
+# retry, not a replica).
+SERVING_SOCKET = "socket"
+SERVING_SOCKET_LEASE_SECS = "lease_secs"
+SERVING_SOCKET_LEASE_SECS_DEFAULT = 10.0
+SERVING_SOCKET_RECONNECT_ATTEMPTS = "reconnect_attempts"
+SERVING_SOCKET_RECONNECT_ATTEMPTS_DEFAULT = 3
+SERVING_SOCKET_RECONNECT_BACKOFF_SECS = "reconnect_backoff_secs"
+SERVING_SOCKET_RECONNECT_BACKOFF_SECS_DEFAULT = 0.1
+SERVING_SOCKET_CONNECT_TIMEOUT_SECS = "connect_timeout_secs"
+SERVING_SOCKET_CONNECT_TIMEOUT_SECS_DEFAULT = 10.0
+SERVING_SOCKET_CONNECT_RETRIES = "connect_retries"
+SERVING_SOCKET_CONNECT_RETRIES_DEFAULT = 3
+# HTTP/SSE front door (serving/http.py): bind address, the per-stream
+# write-buffer bound, and the slow-client overrun policy ("drop" closes
+# the stream and cancels the request — the slot frees like a
+# disconnect; "block" backpressures the stream on the client's drain).
+SERVING_HTTP = "http"
+SERVING_HTTP_HOST = "host"
+SERVING_HTTP_HOST_DEFAULT = "127.0.0.1"
+SERVING_HTTP_PORT = "port"
+SERVING_HTTP_PORT_DEFAULT = 0
+SERVING_HTTP_MAX_BUFFER_BYTES = "max_buffer_bytes"
+SERVING_HTTP_MAX_BUFFER_BYTES_DEFAULT = 65536
+SERVING_HTTP_OVERRUN_POLICY = "overrun_policy"
+SERVING_HTTP_OVERRUN_POLICY_DEFAULT = "drop"
+SERVING_HTTP_VALID_OVERRUN_POLICIES = ("drop", "block")
 
 #############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,

@@ -148,10 +148,12 @@ class InferenceRequest:
         return self._done.is_set()
 
     def cancel(self):
-        """Withdraw a still-queued request: it finishes with reason
+        """Withdraw this request: still-queued it finishes with reason
         ``"cancelled"`` the next time the scheduler reaches it instead of
-        occupying a slot (a request already decoding runs to
-        completion — its slot state lives on device)."""
+        occupying a slot; already DECODING its slot (and its KV pages)
+        are reclaimed at the next step boundary — an abandoned stream
+        (HTTP client disconnect, serving/http.py) frees its capacity
+        within one decode step instead of generating for nobody."""
         self._cancelled = True
 
     def result(self, timeout=None):
@@ -595,15 +597,21 @@ class ContinuousBatchingScheduler:
     def _expire_deadlines(self):
         """Finish every request past its deadline — in flight (the slot
         is reclaimed) AND still queued (the waiter gets its "deadline"
-        answer now, not when a slot eventually frees). Runs at each step
-        boundary, so expiry lands within one decode step."""
+        answer now, not when a slot eventually frees) — and reap
+        in-flight CANCELLED requests the same way. Runs at each step
+        boundary, so both land within one decode step."""
         now = time.monotonic()
         for slot, req in enumerate(self._slots):
-            if (
-                req is not None
-                and req.deadline is not None
-                and now >= req.deadline
-            ):
+            if req is None:
+                continue
+            if req._cancelled:
+                # an in-flight cancel (client disconnect) reclaims the
+                # slot and its KV pages within one decode step — decode
+                # work for an abandoned waiter is pure waste
+                self._free_slot(slot)
+                req._finish(_FINISH_CANCELLED)
+                continue
+            if req.deadline is not None and now >= req.deadline:
                 self._free_slot(slot)
                 self._deadline_misses.inc()
                 req._finish(_FINISH_DEADLINE)

@@ -101,6 +101,32 @@ KNOWN_FAULT_SITES = {
         "load_snapshot returns the previous call's frozen values — the "
         "router scores placements (and zombie detection) on stale load"
     ),
+    # -- socket seams (serving/transport.py + node.py, docs/serving.md
+    # "Networked fleet") — the failure modes only REAL sockets have -----
+    "net.partition": (
+        "silently drops one frame at the socket send seam (the network "
+        "black-holes it; the connection looks alive) — the op never "
+        "arrives and only a reply timeout or lease expiry notices"
+    ),
+    "conn.reset": (
+        "hard-closes the socket at the armed seam and raises "
+        "ConnectionResetError — the peer RST mid-conversation; the "
+        "client's reconnect-with-resume path absorbs it"
+    ),
+    "conn.stall": (
+        "sleeps args.duration_ms at the socket send seam (congested or "
+        "half-open link) — RPCs slow down while the connection lives"
+    ),
+    "accept.drop": (
+        "the node agent accepts a connection and immediately closes it "
+        "(overloaded listener / SYN-flood guard) — the client's connect "
+        "retry absorbs it"
+    ),
+    "frame.corrupt": (
+        "garbles one frame at the armed socket seam beyond JSON repair — "
+        "the receiver counts fleet/net_frames_corrupt and drops it; "
+        "idempotent-RPC retry re-asks"
+    ),
 }
 
 _RAISES = {
@@ -111,6 +137,7 @@ _RAISES = {
     "decode.step": RuntimeError,
     "replica.flap": RuntimeError,
     "router.place": RuntimeError,
+    "conn.reset": ConnectionResetError,
 }
 
 STALL_DURATION_MS_DEFAULT = 250.0

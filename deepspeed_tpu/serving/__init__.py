@@ -8,11 +8,18 @@ scheduler in deepspeed_tpu/inference/. Four layers:
                  (RateLimited / FleetOverloaded, machine-readable
                  ``reason`` codes).
   replica.py   — the uniform submit/health/drain/restart surface:
-                 InProcessReplica (N engines, one process) and
+                 InProcessReplica (N engines, one process),
                  SubprocessReplica (one engine per worker process,
-                 newline-JSON RPC over pipes).
+                 newline-JSON RPC over pipes), and SocketReplica
+                 (transport.py — the same RPC over TCP to a node agent
+                 on another host).
   worker.py    — the subprocess engine host
                  (``python -m deepspeed_tpu.serving.worker``).
+  node.py      — the multi-replica TCP node agent
+                 (``python -m deepspeed_tpu.serving.node``).
+  http.py      — the HTTP/SSE front door (HTTPDoor / serve_http):
+                 token streaming at TTFT, typed-rejection status codes,
+                 disconnect/backpressure handling.
   router.py    — FleetRouter: pluggable placement (least-loaded /
                  round-robin / prefix-affinity), rolling restarts under
                  a capacity floor, failed-replica eviction + re-route,
@@ -36,12 +43,16 @@ from .breaker import (
     BREAKER_OPEN,
     CircuitBreaker,
 )
+from .http import HTTPDoor, serve_http
 from .replica import (
+    RPC_PROTOCOL_VERSION,
     InProcessReplica,
     RemoteRequest,
+    ReplicaProtocolError,
     ReplicaRPCError,
     SubprocessReplica,
 )
+from .transport import SocketReplica
 from .router import (
     PLACEMENT_POLICIES,
     AdapterAffinity,
@@ -78,8 +89,8 @@ def _resolve_config(config):
     return DeepSpeedConfig(None, param_dict=raw, world_size=1)
 
 
-def init_fleet(engine_factory=None, worker_spec=None, config=None,
-               registry=None, start=True):
+def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
+               config=None, registry=None, start=True):
     """Build (and by default start) a :class:`FleetRouter` from the
     config's ``"serving"`` block (docs/serving.md).
 
@@ -94,27 +105,39 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
     ``worker_spec``
         the worker.py init spec — used for the ``subprocess`` backend;
         each replica spawns one worker process from it.
+    ``nodes``
+        the ``socket`` backend's fleet map (docs/serving.md "Networked
+        fleet"): ``{node_name: {"address": "host:port", "replicas":
+        ["r0", ...]}}`` — one :class:`SocketReplica` per (node, replica)
+        pair, named ``"{node}:{replica}"``. Each node must already be
+        serving (``python -m deepspeed_tpu.serving.node``); the
+        ``serving.socket`` block tunes leases and reconnects, and
+        ``serving.replicas`` is ignored (the map IS the fleet).
 
     The router's fleet/* streams export through the config's
     ``"telemetry"`` block when enabled (same sinks as the engines), or
     live on a private registry otherwise.
     """
     cfg = _resolve_config(config)
-    if (engine_factory is None) == (worker_spec is None):
+    sources = [s for s in (engine_factory, worker_spec, nodes)
+               if s is not None]
+    if len(sources) != 1:
         raise ValueError(
-            "pass exactly one of engine_factory (in_process backend) or "
-            "worker_spec (subprocess backend)"
+            "pass exactly one of engine_factory (in_process backend), "
+            "worker_spec (subprocess backend), or nodes (socket backend)"
         )
     backend = cfg.serving_backend
-    if engine_factory is not None and backend == "subprocess":
+    expected_by_backend = {
+        "in_process": engine_factory, "subprocess": worker_spec,
+        "socket": nodes,
+    }
+    if expected_by_backend.get(backend) is None:
+        wanted = {"in_process": "engine_factory",
+                  "subprocess": "worker_spec",
+                  "socket": "nodes"}[backend]
         raise ValueError(
-            'serving.backend is "subprocess" but an engine_factory was '
-            "passed; provide worker_spec instead"
-        )
-    if worker_spec is not None and backend == "in_process":
-        raise ValueError(
-            'serving.backend is "in_process" but a worker_spec was '
-            "passed; provide engine_factory instead"
+            f"serving.backend is {backend!r} but {wanted} was not "
+            "passed (and another replica source was)"
         )
 
     telemetry = None
@@ -128,6 +151,15 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
             registry = telemetry.registry
         else:
             telemetry = None
+    if registry is None:
+        # one registry for the whole fleet: the socket replicas count
+        # their fleet/net_* streams on whatever registry they're handed,
+        # and the router's metrics must see them — a None here would
+        # silo each transport's reconnects/corrupt-frames on a private
+        # registry nobody can read
+        from ..telemetry.registry import MetricsRegistry
+
+        registry = MetricsRegistry()
 
     # fleet request tracer (telemetry/tracing.py): telemetry's when one
     # was built, a standalone from the config otherwise (callers passing
@@ -160,7 +192,7 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
             )
             for i in range(cfg.serving_replicas)
         ]
-    else:
+    elif worker_spec is not None:
         replicas = [
             SubprocessReplica(
                 str(i), worker_spec,
@@ -171,6 +203,33 @@ def init_fleet(engine_factory=None, worker_spec=None, config=None,
             )
             for i in range(cfg.serving_replicas)
         ]
+    else:
+        replicas = []
+        for node_name, block in nodes.items():
+            address = block["address"]
+            for rname in block.get("replicas") or ():
+                replicas.append(SocketReplica(
+                    f"{node_name}:{rname}", address, remote_name=rname,
+                    rpc_timeout=cfg.serving_rpc_timeout_secs,
+                    rpc_retries=cfg.serving_rpc_retries,
+                    rpc_backoff_secs=cfg.serving_rpc_backoff_secs,
+                    connect_timeout=cfg.serving_socket_connect_timeout_secs,
+                    connect_retries=cfg.serving_socket_connect_retries,
+                    lease_secs=cfg.serving_socket_lease_secs,
+                    reconnect_attempts=(
+                        cfg.serving_socket_reconnect_attempts
+                    ),
+                    reconnect_backoff_secs=(
+                        cfg.serving_socket_reconnect_backoff_secs
+                    ),
+                    registry=registry,
+                    fault_injector=faults,
+                ))
+        if not replicas:
+            raise ValueError(
+                "the socket backend's nodes map names no replicas "
+                '(expected {node: {"address": ..., "replicas": [...]}})'
+            )
 
     router = FleetRouter(
         replicas,
@@ -214,15 +273,20 @@ __all__ = [
     "FleetOverloaded",
     "FleetRequest",
     "FleetRouter",
+    "HTTPDoor",
     "InProcessReplica",
     "LeastLoaded",
     "PLACEMENT_POLICIES",
     "PrefixAffinity",
+    "RPC_PROTOCOL_VERSION",
     "RateLimited",
     "RemoteRequest",
+    "ReplicaProtocolError",
     "ReplicaRPCError",
     "RoundRobin",
+    "SocketReplica",
     "SubprocessReplica",
     "TokenBucket",
     "init_fleet",
+    "serve_http",
 ]

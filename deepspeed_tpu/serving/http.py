@@ -1,0 +1,637 @@
+"""HTTP/SSE front door: the fleet's internet-facing edge.
+
+``HTTPDoor`` puts an asyncio HTTP server (stdlib only — no framework
+import at serving time) in front of a :class:`FleetRouter`, streaming
+each token as the scheduler finishes it (docs/serving.md "Networked
+fleet"). The contract the door enforces:
+
+  * **Streaming is genuinely incremental.** The first SSE ``token``
+    event flushes at TTFT — when the replica's prefill samples the first
+    token — not when the generation completes; every later token follows
+    within one poll interval of its decode step.
+  * **Typed rejections map to status codes.** The serving tier's
+    machine-readable ``reason`` codes (inference/scheduler.py REJECT_*)
+    become HTTP statuses — clients branch on the status, never on prose:
+
+        reason        status
+        rate_limit    429  (Retry-After: 1)
+        overload      503  (Retry-After: 1)
+        draining      503
+        capacity      503
+        deadline      504
+        ValueError    400  (malformed request — never retried)
+
+  * **An abandoned stream frees its slot.** A client disconnect cancels
+    the fleet request (``FleetRouter.cancel``): the replica scheduler
+    reclaims the KV slot at the next step boundary — within one decode
+    step — instead of generating for nobody (``door/client_disconnects``).
+  * **Slow clients cannot hold the fleet.** Each connection's write
+    buffer is bounded at ``max_buffer_bytes``; a client draining slower
+    than its tokens arrive hits the ``overrun_policy``: ``"drop"``
+    (default) cancels the request and closes the stream
+    (``fleet/net_slow_client_drops``) — the slot frees like a
+    disconnect; ``"block"`` awaits the drain, trading this stream's
+    latency (and its slot's occupancy) for completeness.
+
+API::
+
+    POST /v1/generate        {"prompt": [ints], "max_new_tokens": 32,
+                              "stream": true, "temperature": 0.0,
+                              "deadline_secs": 5.0, "tenant": "free",
+                              "priority": 1, "adapter": "tenant-a"}
+      stream=true  -> text/event-stream:
+                        event: token   data: {"i": K, "t": T}
+                        event: done    data: {"tokens": [...],
+                                              "finish_reason": "...",
+                                              "usage": {...}}
+      stream=false -> one application/json body at completion
+    GET /healthz             fleet liveness + routable-capacity summary
+
+Deadlines propagate end to end: ``deadline_secs`` rides the router
+submit (charging re-routes), the socket transport's frame header
+(transport.py), and the replica scheduler's admission gate — the door
+adds nothing but the plumbing. ``door/*`` streams (open_streams,
+stream_ttft_ms, client_disconnects, requests) ride the router's
+registry and export through the same sinks (docs/observability.md).
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+from ..inference.scheduler import (
+    REJECT_CAPACITY,
+    REJECT_DEADLINE,
+    REJECT_DRAINING,
+    REJECT_OVERLOAD,
+    REJECT_RATE_LIMIT,
+    RequestRejected,
+)
+from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS, count_suppressed
+from ..utils.logging import logger
+
+STATUS_BY_REASON = {
+    REJECT_RATE_LIMIT: 429,
+    REJECT_OVERLOAD: 503,
+    REJECT_DRAINING: 503,
+    REJECT_CAPACITY: 503,
+    REJECT_DEADLINE: 504,
+}
+# statuses a client should back off and retry on
+_RETRYABLE = (429, 503)
+
+_REASONS_PHRASE = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    405: "Method Not Allowed", 413: "Payload Too Large",
+    429: "Too Many Requests", 499: "Client Closed Request",
+    500: "Internal Server Error", 502: "Bad Gateway",
+    503: "Service Unavailable", 504: "Gateway Timeout",
+}
+
+# request bodies past this are hostile, not prompts
+BODY_MAX_BYTES = 4 << 20
+
+# same for a header block: a client streaming header lines forever must
+# hit a ceiling, not grow the door's memory one line at a time
+HEADERS_MAX_BYTES = 64 << 10
+
+OVERRUN_POLICIES = ("drop", "block")
+
+
+class _RequestTooLarge(Exception):
+    """Body or header block past the door's ceilings — answered 413 (a
+    client must see the non-retryable status, not a bare socket close
+    it would mistake for a network fault and retry)."""
+
+
+def _sse(event, payload):
+    return (
+        f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode("utf-8")
+    )
+
+
+class HTTPDoor:
+    """One door per router. ``start()`` spins the asyncio loop on a
+    daemon thread and returns ``(host, port)`` (an ephemeral port 0
+    resolves here); ``shutdown()`` closes the listener, cancels every
+    open stream's fleet request, and joins the loop."""
+
+    def __init__(self, router, host="127.0.0.1", port=0, *,
+                 max_buffer_bytes=65536, overrun_policy="drop",
+                 poll_interval=0.002, registry=None):
+        if overrun_policy not in OVERRUN_POLICIES:
+            raise ValueError(
+                f"unknown overrun_policy {overrun_policy!r}; valid: "
+                f"{OVERRUN_POLICIES}"
+            )
+        self.router = router
+        self._host = str(host)
+        self._port = int(port)
+        self.max_buffer_bytes = int(max_buffer_bytes)
+        self.overrun_policy = overrun_policy
+        self._poll = float(poll_interval)
+        reg = registry if registry is not None else router.metrics
+        self._m_requests = reg.counter(
+            "door/requests", help="HTTP requests accepted by the door"
+        )
+        self._m_open = reg.gauge(
+            "door/open_streams", help="SSE streams currently open"
+        )
+        self._m_ttft = reg.histogram(
+            "door/stream_ttft_ms", buckets=DEFAULT_TIME_BUCKETS_MS,
+            help="door-observed time to first streamed token event",
+        )
+        self._m_disconnects = reg.counter(
+            "door/client_disconnects",
+            help="streams abandoned by the client before completion "
+                 "(their fleet requests cancel; slots free within one "
+                 "decode step)",
+        )
+        self._m_slow_drops = reg.counter(
+            "fleet/net_slow_client_drops",
+            help="streams dropped by the overrun policy: the client "
+                 "drained slower than its tokens arrived",
+        )
+        self._loop = None
+        self._server = None
+        self._thread = None
+        self._started = threading.Event()
+        self._start_error = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, timeout=10.0):
+        if self._thread is not None:
+            return self._host, self._port
+        self._thread = threading.Thread(
+            target=self._run_loop, name="ds-http-door", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout):
+            raise RuntimeError("HTTP door failed to start in time")
+        if self._start_error is not None:
+            raise self._start_error
+        return self._host, self._port
+
+    def _run_loop(self):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        try:
+            self._server = loop.run_until_complete(
+                asyncio.start_server(
+                    self._handle_conn, self._host, self._port
+                )
+            )
+            sockname = self._server.sockets[0].getsockname()
+            self._host, self._port = sockname[0], sockname[1]
+        except Exception as e:  # bind failure: surface on start()
+            self._start_error = e
+            self._started.set()
+            return
+        self._started.set()
+        logger.info(
+            "HTTP door serving on %s:%d (buffer %d bytes, overrun=%s)",
+            self._host, self._port, self.max_buffer_bytes,
+            self.overrun_policy,
+        )
+        try:
+            loop.run_forever()
+        finally:
+            self._server.close()
+            loop.run_until_complete(self._server.wait_closed())
+            loop.close()
+
+    def shutdown(self, timeout=10.0):
+        loop = self._loop
+        if loop is None:
+            return
+
+        async def _drain():
+            # stop accepting, then cancel every live connection task —
+            # each open stream's CancelledError handler cancels its
+            # fleet request, so replicas stop decoding for connections
+            # the door is tearing down — and only then stop the loop
+            self._server.close()
+            current = asyncio.current_task()
+            tasks = [t for t in asyncio.all_tasks() if t is not current]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            asyncio.get_event_loop().stop()
+
+        asyncio.run_coroutine_threadsafe(_drain(), loop)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self._loop = None
+
+    @property
+    def address(self):
+        return self._host, self._port
+
+    # -- HTTP plumbing --------------------------------------------------
+    async def _handle_conn(self, reader, writer):
+        try:
+            try:
+                request = await self._read_request(reader)
+            except ValueError as e:
+                # malformed framing (a garbage Content-Length) is a
+                # CLIENT error: the documented 400, not a 500 that
+                # pollutes the server-fault diagnostics
+                await self._respond_json(writer, 400, {"error": str(e)})
+                return
+            except _RequestTooLarge as e:
+                await self._respond_json(writer, 413, {"error": str(e)})
+                return
+            if request is None:
+                return
+            method, target, headers, body = request
+            self._m_requests.inc()
+            if method == "GET" and target == "/healthz":
+                await self._respond_json(writer, 200, self._health())
+            elif method == "POST" and target == "/v1/generate":
+                await self._generate(reader, writer, headers, body)
+            elif target in ("/healthz", "/v1/generate"):
+                await self._respond_json(
+                    writer, 405, {"error": f"{method} not allowed here"}
+                )
+            else:
+                await self._respond_json(
+                    writer, 404, {"error": f"no route {target!r}"}
+                )
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # the client went away mid-parse; nothing to answer
+        except Exception as e:
+            count_suppressed("serving.door_conn", e)
+            try:
+                await self._respond_json(
+                    writer, 500, {"error": f"internal error: {e}"}
+                )
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader):
+        """Minimal HTTP/1.1 request parse: request line, headers, and a
+        Content-Length body. Returns (method, target, headers, body) or
+        None for an empty connection."""
+        line = await reader.readline()
+        if not line.strip():
+            return None
+        try:
+            method, target, _version = line.decode("latin-1").split(None, 2)
+        except ValueError:
+            raise ConnectionError("malformed request line") from None
+        headers = {}
+        header_bytes = 0
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            header_bytes += len(line)
+            if header_bytes > HEADERS_MAX_BYTES:
+                raise _RequestTooLarge(
+                    f"header block past {HEADERS_MAX_BYTES} bytes refused"
+                )
+            name, _, value = line.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        try:
+            length = int(headers.get("content-length", 0) or 0)
+        except ValueError:
+            raise ValueError("malformed Content-Length header") from None
+        if length < 0:
+            raise ValueError("malformed Content-Length header")
+        if length > BODY_MAX_BYTES:
+            raise _RequestTooLarge(f"body of {length} bytes refused")
+        body = await reader.readexactly(length) if length else b""
+        return method.upper(), target, headers, body
+
+    async def _respond_json(self, writer, status, payload,
+                            extra_headers=()):
+        body = json.dumps(payload).encode("utf-8")
+        phrase = _REASONS_PHRASE.get(status, "")
+        head = [
+            f"HTTP/1.1 {status} {phrase}",
+            "Content-Type: application/json",
+            f"Content-Length: {len(body)}",
+            "Connection: close",
+        ]
+        if status in _RETRYABLE:
+            head.append("Retry-After: 1")
+        head.extend(extra_headers)
+        writer.write(
+            ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
+        )
+        await writer.drain()
+
+    def _health(self):
+        snap = self.router.metrics.snapshot()
+        return {
+            "ok": True,
+            "replicas_total": snap.get("fleet/replicas_total", 0),
+            "replicas_available": snap.get("fleet/replicas_available", 0),
+            "queue_depth": snap.get("fleet/queue_depth", 0),
+            "open_streams": snap.get("door/open_streams", 0),
+        }
+
+    # -- /v1/generate ---------------------------------------------------
+    @staticmethod
+    def _parse_generate(body):
+        try:
+            payload = json.loads(body.decode("utf-8") or "{}")
+        except (ValueError, UnicodeDecodeError):
+            raise ValueError("body is not valid JSON") from None
+        if not isinstance(payload, dict):
+            raise ValueError("body must be a JSON object")
+        prompt = payload.get("prompt")
+        if (
+            not isinstance(prompt, list) or not prompt
+            # bool is an int subclass: JSON true/false would silently
+            # become token ids 1/0 without the explicit exclusion
+            or not all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in prompt
+            )
+        ):
+            raise ValueError(
+                '"prompt" must be a non-empty list of token ids '
+                "(tokenization happens client-side)"
+            )
+        kwargs = {}
+        for key in ("max_new_tokens", "temperature", "deadline_secs",
+                    "adapter"):
+            if payload.get(key) is not None:
+                kwargs[key] = payload[key]
+        return (
+            prompt,
+            str(payload.get("tenant", "default")),
+            int(payload.get("priority", 0)),
+            bool(payload.get("stream", True)),
+            kwargs,
+        )
+
+    async def _generate(self, reader, writer, headers, body):
+        del headers
+        loop = asyncio.get_event_loop()
+        try:
+            prompt, tenant, priority, stream, kwargs = (
+                self._parse_generate(body)
+            )
+        except ValueError as e:
+            await self._respond_json(writer, 400, {"error": str(e)})
+            return
+        t_recv = time.monotonic()
+        try:
+            # submit can block on a replica's bounded admission queue:
+            # keep the event loop (and every other stream) out of it
+            fleet_req = await loop.run_in_executor(
+                None,
+                lambda: self.router.submit(
+                    prompt, tenant=tenant, priority=priority, **kwargs
+                ),
+            )
+        except RequestRejected as e:
+            status = STATUS_BY_REASON.get(e.reason, 503)
+            await self._respond_json(
+                writer, status, {"error": str(e), "reason": e.reason}
+            )
+            return
+        except (ValueError, TypeError) as e:
+            await self._respond_json(writer, 400, {"error": str(e)})
+            return
+        greedy = not kwargs.get("temperature")
+        if stream:
+            await self._stream_response(
+                writer, reader, fleet_req, t_recv, greedy=greedy
+            )
+        else:
+            await self._unary_response(writer, reader, fleet_req)
+
+    async def _unary_response(self, writer, reader, fleet_req):
+        # same hangup watch as the stream path: an abandoned unary
+        # request must free its slot within one decode step too, not
+        # decode its whole budget for nobody
+        hangup = asyncio.ensure_future(reader.read(64))
+        try:
+            while not fleet_req.done:
+                if hangup.done():
+                    try:
+                        stray = hangup.result()
+                    except (ConnectionError, OSError):
+                        stray = b""  # a reset read side IS a hangup
+                    if stray:
+                        hangup = asyncio.ensure_future(reader.read(64))
+                    else:
+                        self._m_disconnects.inc()
+                        self.router.cancel(fleet_req)
+                        logger.info(
+                            "door: client abandoned unary request "
+                            "(fleet request %s); slot cancelled",
+                            fleet_req.request_id,
+                        )
+                        return
+                await asyncio.sleep(self._poll)
+        except asyncio.CancelledError:
+            self.router.cancel(fleet_req)
+            raise
+        finally:
+            hangup.cancel()
+        if fleet_req.finish_reason in ("error", "cancelled"):
+            await self._respond_json(writer, 502, {
+                "error": "the fleet could not finish the request "
+                         f"(reason {fleet_req.finish_reason!r} after "
+                         f"{fleet_req.reroutes} re-route(s))",
+            })
+            return
+        await self._respond_json(writer, 200, self._done_payload(fleet_req))
+
+    @staticmethod
+    def _done_payload(fleet_req):
+        return {
+            "tokens": list(fleet_req.tokens),
+            "finish_reason": fleet_req.finish_reason,
+            "usage": {
+                "prompt_tokens": len(fleet_req.prompt_tokens),
+                "completion_tokens": len(fleet_req.tokens),
+            },
+        }
+
+    async def _stream_response(self, writer, reader, fleet_req, t_recv,
+                               greedy=True):
+        """The SSE loop: poll the replica-side handle and flush each new
+        token the moment the scheduler finishes it. The three exits:
+        done (terminal event), client disconnect (cancel — the slot
+        frees within one decode step), buffer overrun under the drop
+        policy (cancel, same path)."""
+        transport = writer.transport
+        try:
+            transport.set_write_buffer_limits(high=self.max_buffer_bytes)
+        except (AttributeError, RuntimeError):  # pragma: no cover
+            pass
+        writer.write((
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/event-stream\r\n"
+            "Cache-Control: no-store\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode("latin-1"))
+        await writer.drain()
+        # half-closed detection: the read side going EOF is the only
+        # sign an SSE client hung up (it never sends again after the
+        # request) — poll it as a task instead of blocking on it
+        hangup = asyncio.ensure_future(reader.read(64))
+        self._m_open.inc(1)
+        sent = 0
+        first_at = None
+        last_inner = None
+        try:
+            while True:
+                if hangup.done():
+                    try:
+                        stray = hangup.result()
+                    except (ConnectionError, OSError):
+                        stray = b""  # a reset read side IS a hangup
+                    if stray:
+                        # inbound BYTES are not a hangup (a trailing
+                        # CRLF after the body, an eagerly-pipelined
+                        # request on this Connection: close socket):
+                        # ignore them and keep watching — only EOF
+                        # means the client went away
+                        hangup = asyncio.ensure_future(reader.read(64))
+                    else:
+                        self._m_disconnects.inc()
+                        self.router.cancel(fleet_req)
+                        logger.info(
+                            "door: client abandoned stream (fleet "
+                            "request %s); slot cancelled",
+                            fleet_req.request_id,
+                        )
+                        return
+                done = fleet_req.done
+                # the CURRENT inner handle: a re-route swaps it (tokens
+                # restart — greedy decode re-derives the same prefix)
+                inner = self.router.inner_handle(fleet_req)
+                if (
+                    not greedy and sent > 0
+                    and inner is not None and last_inner is not None
+                    and inner is not last_inner
+                ):
+                    # a mid-stream re-route under SAMPLING re-draws the
+                    # sequence: the new replica's tokens share no prefix
+                    # with what already streamed, so splicing at `sent`
+                    # would deliver a stream no generation produced.
+                    # Fail the stream honestly; the client restarts.
+                    self.router.cancel(fleet_req)
+                    writer.write(_sse("error", {
+                        "error": "re-routed mid-stream with sampling; "
+                                 "the streamed prefix cannot be resumed "
+                                 "— retry the request",
+                        "finish_reason": "rerouted_sampling",
+                    }))
+                    try:
+                        await writer.drain()
+                    except (ConnectionError, OSError):
+                        pass
+                    return
+                if inner is not None:
+                    last_inner = inner
+                tokens = (
+                    list(fleet_req.tokens) if inner is None
+                    else list(inner.tokens)
+                )
+                while sent < len(tokens):
+                    if first_at is None:
+                        first_at = time.monotonic()
+                        self._m_ttft.observe((first_at - t_recv) * 1e3)
+                    writer.write(_sse(
+                        "token", {"i": sent, "t": int(tokens[sent])}
+                    ))
+                    sent += 1
+                    if not await self._flush_stream(writer, fleet_req):
+                        return
+                if done:
+                    if fleet_req.finish_reason in ("error", "cancelled"):
+                        writer.write(_sse("error", {
+                            "error": "the fleet could not finish the "
+                                     "request",
+                            "finish_reason": fleet_req.finish_reason,
+                        }))
+                    else:
+                        writer.write(_sse(
+                            "done", self._done_payload(fleet_req)
+                        ))
+                    try:
+                        await writer.drain()
+                    except (ConnectionError, OSError):
+                        pass
+                    return
+                await asyncio.sleep(self._poll)
+        except asyncio.CancelledError:
+            # door shutdown with this stream open: free the slot — the
+            # fleet must not keep decoding for a connection the door is
+            # tearing down
+            self.router.cancel(fleet_req)
+            raise
+        finally:
+            self._m_open.inc(-1)
+            hangup.cancel()
+
+    async def _flush_stream(self, writer, fleet_req):
+        """Apply the slow-client policy after each event write. Returns
+        False when the stream ended (overrun drop or a dead client) —
+        the request is already cancelled then."""
+        transport = writer.transport
+        try:
+            pending = transport.get_write_buffer_size()
+        except (AttributeError, RuntimeError):  # pragma: no cover
+            pending = 0
+        if pending <= self.max_buffer_bytes:
+            return True
+        if self.overrun_policy == "block":
+            # backpressure the emit loop: this stream waits for its
+            # client (its slot stays busy — the documented trade)
+            try:
+                await writer.drain()
+            except (ConnectionError, OSError):
+                self._m_disconnects.inc()
+                self.router.cancel(fleet_req)
+                return False
+            return True
+        self._m_slow_drops.inc()
+        self.router.cancel(fleet_req)
+        logger.warning(
+            "door: dropping slow client (write buffer %d > %d bytes); "
+            "fleet request %s cancelled", pending, self.max_buffer_bytes,
+            fleet_req.request_id,
+        )
+        try:
+            writer.write(_sse("error", {
+                "error": "stream dropped: client reading too slowly",
+                "finish_reason": "slow_client",
+            }))
+        except Exception:
+            pass
+        return False
+
+
+def serve_http(router, config=None, **overrides):
+    """Config-driven door construction (the ``serving.http`` block,
+    docs/serving.md): build + start an :class:`HTTPDoor` for ``router``
+    from a validated DeepSpeedConfig (or ``None`` for defaults), with
+    keyword overrides winning. Returns the started door."""
+    kwargs = {}
+    if config is not None:
+        kwargs = {
+            "host": config.serving_http_host,
+            "port": config.serving_http_port,
+            "max_buffer_bytes": config.serving_http_max_buffer_bytes,
+            "overrun_policy": config.serving_http_overrun_policy,
+        }
+    kwargs.update(overrides)
+    door = HTTPDoor(router, **kwargs)
+    door.start()
+    return door

@@ -1,0 +1,634 @@
+"""Node agent: N replicas on one host behind a TCP listener.
+
+``python -m deepspeed_tpu.serving.node --spec '<json>' [--host H]
+[--port P]`` hosts one engine per named replica and serves the replica
+RPC (worker.py's frame schema, transport.py's framing) to SocketReplica
+clients — the multi-host form of the serving tier: a router on another
+machine drives these replicas exactly like local ones.
+
+The node spec::
+
+    {
+      "node_id": "n0",
+      "replicas": {"r0": {engine spec}, "r1": {engine spec}},
+      "lease_secs": 10.0,          // half-open connection guard
+      "resume_grace_secs": 10.0,   // disconnected-session retention
+      "config": {...}              // node-side chaos (accept.drop) etc.
+    }
+
+Each ``{engine spec}`` is worker.py's init spec (``{"model": ...,
+"init_seed": ..., "config": ...}`` — or ``{"stub": ...}`` for the
+jax-free protocol-testing engine). Engines build at node start, BEFORE
+the listener opens: a connecting client never races an initializing
+model. Request ids carry the ``{node_id}/{replica}`` prefix, so ids
+stay globally unique across hosts.
+
+## Sessions and resume
+
+A connection's first frame must be a ``hello`` naming the client token
+and target replica. Sessions key on ``(client, replica)``: the session
+— not the connection — owns the in-flight request table and an event
+outbox. Events (first_token / token / finished / replies) append to the
+outbox and flush to the live connection; with no connection they wait.
+A reconnecting client (same token) re-binds the session: the node
+answers ``welcome`` with the session's in-flight rpc ids (the client
+fail-finishes anything missing for re-route) and flushes the outbox —
+nothing is lost, nothing re-runs. A session with no connection past
+``resume_grace_secs`` is reaped: its in-flight requests cancel (slots
+free within one decode step) and the next hello starts fresh.
+
+Chaos: the spec config's ``resilience.fault_injection`` block arms the
+node-side injector; ``accept.drop`` fires in the accept loop (the
+overloaded-listener failure mode — the client's connect retry absorbs
+it).
+"""
+
+import argparse
+import collections
+import json
+import socket
+import struct
+import sys
+import threading
+import time
+
+from ..inference.scheduler import RequestRejected
+from ..resilience.faults import build_fault_injector_from_dict
+from ..telemetry.registry import count_suppressed
+from ..utils.logging import logger
+from .replica import RPC_PROTOCOL_VERSION
+from .transport import (
+    FrameError,
+    corrupt_frame,  # noqa: F401  (re-exported for chaos tooling)
+    decode_frame,
+    encode_frame,
+    read_frame_line,
+)
+from .worker import build_engine_from_spec, poll_tracked_requests
+
+# a session's outbox past this is a client that stopped reading events
+# faster than its requests generate them — reap it (the disconnect path)
+# rather than grow node memory without bound
+OUTBOX_MAX_EVENTS = 65536
+
+
+class _Session:
+    """One client's lease on one hosted replica: the in-flight request
+    table plus the event outbox that survives reconnects."""
+
+    __slots__ = ("client", "replica_name", "engine", "tracked", "outbox",
+                 "conn", "last_seen", "lock", "dead")
+
+    def __init__(self, client, replica_name, engine):
+        self.client = client
+        self.replica_name = replica_name
+        self.engine = engine
+        self.tracked = {}  # rpc_id -> (request, announced, tokens_sent)
+        self.outbox = collections.deque()
+        self.conn = None   # the bound socket (exactly 0 or 1)
+        self.last_seen = time.monotonic()
+        self.lock = threading.Lock()
+        self.dead = False
+
+    def emit(self, msg):
+        """Queue one event and flush what the live connection will take.
+        With no connection the outbox holds it for the resume; a write
+        failure unbinds (the reaper owns the session's fate)."""
+        with self.lock:
+            self.outbox.append(msg)
+            self._flush_locked()
+
+    def flush(self):
+        with self.lock:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        conn = self.conn
+        if conn is None:
+            return
+        while self.outbox:
+            data = encode_frame(self.outbox[0])
+            try:
+                conn.sendall(data)
+            except OSError as e:
+                count_suppressed("serving.node_event_write", e)
+                self.conn = None  # unbind; the event stays queued
+                return
+            self.outbox.popleft()
+
+    def bind(self, conn):
+        """Adopt ``conn`` as the session's live connection, closing any
+        predecessor (latest hello wins), and flush the backlog."""
+        with self.lock:
+            old, self.conn = self.conn, conn
+            self.last_seen = time.monotonic()
+        if old is not None and old is not conn:
+            try:
+                old.close()
+            except OSError:
+                pass
+        self.flush()
+
+    def unbind(self, conn):
+        with self.lock:
+            if self.conn is conn:
+                self.conn = None
+                self.last_seen = time.monotonic()
+
+
+class NodeServer:
+    """The agent: engines + listener + watcher/reaper threads.
+
+    ``engine_builder`` maps an engine spec dict to an engine exposing
+    the InferenceEngine surface (default: worker.py's
+    ``build_engine_from_spec``, which also understands the jax-free
+    ``{"stub": ...}`` form) — injectable so tests host stub engines
+    in-process without a subprocess spawn."""
+
+    def __init__(self, spec, host="127.0.0.1", port=0, *,
+                 engine_builder=None, poll_interval=0.002):
+        spec = dict(spec)
+        self.node_id = str(spec.get("node_id", "node"))
+        replica_specs = spec.get("replicas") or {}
+        if not replica_specs:
+            raise ValueError("node spec needs a non-empty 'replicas' map")
+        self._replica_specs = {
+            str(name): dict(rspec) for name, rspec in replica_specs.items()
+        }
+        self.lease_secs = float(spec.get("lease_secs", 10.0))
+        self.resume_grace_secs = float(spec.get("resume_grace_secs", 10.0))
+        self._host = str(host)
+        self._port = int(port)
+        self._build = engine_builder or build_engine_from_spec
+        self._poll = float(poll_interval)
+        fi = (
+            (spec.get("config") or {}).get("resilience") or {}
+        ).get("fault_injection") or {}
+        self._faults = build_fault_injector_from_dict(fi)
+        self.engines = {}
+        self._sessions = {}  # (client, replica_name) -> _Session
+        self._sessions_lock = threading.Lock()
+        self._listener = None
+        self._threads = []
+        self._stop = threading.Event()
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        """Build every engine, open the listener, start the service
+        threads. Returns ``(host, port)`` — port resolves the ephemeral
+        0."""
+        for name, rspec in self._replica_specs.items():
+            engine = self._build(rspec)
+            # node-prefixed request ids: two hosts must never mint
+            # colliding ids into fleet telemetry
+            sched = getattr(engine, "scheduler", None)
+            set_prefix = getattr(sched, "set_id_prefix", None)
+            if set_prefix is not None:
+                set_prefix(f"{self.node_id}/{name}")
+            engine.serve_forever()
+            self.engines[name] = engine
+        self._listener = socket.create_server(
+            (self._host, self._port), reuse_port=False
+        )
+        self._listener.settimeout(0.2)
+        self._host, self._port = self._listener.getsockname()[:2]
+        for target, name in (
+            (self._accept_loop, "accept"),
+            (self._watch_loop, "watch"),
+            (self._reap_loop, "reap"),
+        ):
+            t = threading.Thread(
+                target=target, name=f"ds-node-{self.node_id}-{name}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        logger.info(
+            "node %s: serving %d replica(s) on %s:%d",
+            self.node_id, len(self.engines), self._host, self._port,
+        )
+        return self._host, self._port
+
+    @property
+    def address(self):
+        return self._host, self._port
+
+    def shutdown(self, grace=5.0):
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._sessions_lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for session in sessions:
+            self._reap_session(session, "node shutdown")
+        for t in self._threads:
+            t.join(grace)
+        self._threads = []
+        for engine in self.engines.values():
+            try:
+                engine.close()
+            except Exception as e:
+                count_suppressed("serving.node_engine_close", e)
+        self.engines = {}
+
+    def run_forever(self):
+        self._stop.wait()
+
+    # -- accept / per-connection protocol -------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed: shutting down
+            # fault site accept.drop: the overloaded-listener /
+            # SYN-flood-guard failure mode — accept, then slam the door;
+            # the client's connect retry absorbs it
+            if self._faults.fire("accept.drop") is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            conn.settimeout(None)
+            # bound SENDS only (SO_SNDTIMEO, not settimeout — reads must
+            # block indefinitely between a quiet client's heartbeats): a
+            # zero-window client would otherwise park sendall inside
+            # session.lock forever, wedging the shared watch/reap loops
+            # — and with them every session on the node. A timed-out
+            # send raises OSError, the flush unbinds, the event stays
+            # queued, and the reaper owns the session's fate. Kept TIGHT
+            # (well under the lease): the shared watch loop stalls for
+            # at most this long on one wedged client before unbinding
+            # it, and a healthy peer acks a frame orders of magnitude
+            # faster.
+            try:
+                secs = max(min(self.lease_secs, 2.0), 0.5)
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                    struct.pack("ll", int(secs),
+                                int((secs % 1.0) * 1e6)),
+                )
+            except (OSError, ValueError):  # pragma: no cover - platform
+                pass
+            threading.Thread(
+                target=self._serve_conn, args=(conn, addr),
+                name=f"ds-node-{self.node_id}-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn, addr):
+        session = None
+        rfile = conn.makefile("rb")
+        try:
+            session = self._handshake(conn, rfile, addr)
+            if session is None:
+                return
+            for line in iter(lambda: read_frame_line(rfile), b""):
+                try:
+                    msg = decode_frame(line)
+                except FrameError as e:
+                    # one garbled frame costs exactly its op: count it,
+                    # resync at the next newline, let the client's
+                    # idempotent-RPC retry re-ask
+                    logger.warning(
+                        "node %s: dropped corrupt frame from %s (%s)",
+                        self.node_id, session.client, e,
+                    )
+                    count_suppressed("serving.net_frame_corrupt", e)
+                    continue
+                with session.lock:
+                    session.last_seen = time.monotonic()
+                if msg.get("op") == "bye":
+                    # an explicit goodbye: no resume is coming — reap now
+                    # instead of waiting out the grace window
+                    self._drop_session(session, "client said bye")
+                    return
+                self._handle_op(session, msg)
+        except (OSError, FrameError, ValueError) as e:
+            count_suppressed("serving.node_conn_error", e)
+        finally:
+            if session is not None:
+                session.unbind(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handshake(self, conn, rfile, addr):
+        line = read_frame_line(rfile)
+        if not line:
+            return None
+        try:
+            hello = decode_frame(line)
+        except FrameError as e:
+            count_suppressed("serving.net_frame_corrupt", e)
+            return None
+        if hello.get("op") != "hello":
+            logger.warning(
+                "node %s: first frame from %s is %r, not hello; closing",
+                self.node_id, addr, hello.get("op"),
+            )
+            return None
+        name = str(hello.get("replica"))
+        client = str(hello.get("client"))
+        engine = self.engines.get(name)
+        if engine is None:
+            conn.sendall(encode_frame({
+                "event": "error",
+                "error": f"node {self.node_id} hosts no replica {name!r} "
+                         f"(valid: {sorted(self.engines)})",
+            }))
+            return None
+        key = (client, name)
+        with self._sessions_lock:
+            session = self._sessions.get(key)
+            if session is None or session.dead:
+                session = _Session(client, name, engine)
+                self._sessions[key] = session
+        with session.lock:
+            # the authoritative "node remembers these" list: in-flight
+            # requests PLUS anything that finished while the client was
+            # away — its ``finished`` event still sits in the outbox, and
+            # the resume flush will deliver it; omitting those ids would
+            # make the client fail-finish a completed answer for re-route
+            # (burning a duplicate generation) one frame before the
+            # buffered result arrives
+            resumed = sorted(
+                set(session.tracked)
+                | {ev["id"] for ev in session.outbox
+                   if ev.get("event") == "finished"}
+            )
+        # welcome FIRST (node identity + protocol + the authoritative
+        # in-flight list the client reconciles against), then ready;
+        # both carry the version — the handshake's node half
+        conn.sendall(encode_frame({
+            "event": "welcome", "proto": RPC_PROTOCOL_VERSION,
+            "node": self.node_id, "replica": name, "inflight": resumed,
+        }))
+        conn.sendall(encode_frame({
+            "event": "ready", "proto": RPC_PROTOCOL_VERSION,
+        }))
+        session.bind(conn)
+        if resumed:
+            logger.info(
+                "node %s: client %s resumed session on %s with %d "
+                "in-flight request(s)", self.node_id, client, name,
+                len(resumed),
+            )
+        return session
+
+    # -- ops -------------------------------------------------------------
+    def _handle_op(self, session, msg):
+        op = msg.get("op")
+        # fault site replica.hang (the worker op loop's site, node form):
+        # every RPC on this connection waits out the stall while the
+        # process stays alive — the unresponsive-replica failure mode
+        self._faults.maybe_stall("replica.hang")
+        if op == "ping":
+            session.emit({"event": "pong"})
+        elif op == "submit":
+            self._op_submit(session, msg)
+        elif op == "cancel":
+            with session.lock:
+                entry = session.tracked.get(msg.get("id"))
+            if entry is not None:
+                cancel = getattr(entry[0], "cancel", None)
+                if cancel is not None:
+                    cancel()
+        elif op == "snapshot":
+            session.emit({
+                "event": "reply", "id": msg["id"],
+                "snapshot": session.engine.load_snapshot(),
+            })
+        elif op == "load_adapter":
+            self._op_adapter(
+                session, msg,
+                lambda: session.engine.load_adapter(
+                    msg["name"], load_dir=msg.get("load_dir"),
+                    tag=msg.get("tag"),
+                ),
+            )
+        elif op == "unload_adapter":
+            self._op_adapter(
+                session, msg,
+                lambda: session.engine.unload_adapter(msg["name"]),
+            )
+        elif op == "brownout":
+            hook = getattr(session.engine, "set_brownout", None)
+            if hook is not None:
+                hook(bool(msg.get("on")))
+        elif op == "drain":
+            session.engine.scheduler.drain()
+        else:
+            logger.warning(
+                "node %s: unknown op %r from client %s",
+                self.node_id, op, session.client,
+            )
+            count_suppressed("serving.node_unknown_op")
+
+    def _op_submit(self, session, msg):
+        rpc_id = msg["id"]
+        kwargs = dict(msg.get("kwargs") or {})
+        # the deadline rode the frame HEADER (transport.py
+        # _frame_submit): re-derive the engine deadline from it, so the
+        # budget the engine enforces is the one the wire carried
+        dl_ms = msg.get("dl_ms")
+        if dl_ms is not None:
+            kwargs["deadline_secs"] = max(float(dl_ms) / 1e3, 1e-3)
+        # same contract as the worker: never block the op path on queue
+        # room — a full queue rejects NOW and the router falls through
+        kwargs.setdefault("timeout", 0.0)
+        try:
+            req = session.engine.submit(
+                msg["prompt"],
+                max_new_tokens=msg.get("max_new_tokens", 32),
+                **kwargs,
+            )
+        except RequestRejected as e:
+            session.emit({
+                "event": "reply", "id": rpc_id,
+                "error": str(e), "reason": e.reason,
+            })
+            return
+        except (ValueError, TypeError) as e:
+            session.emit({
+                "event": "reply", "id": rpc_id, "error": str(e),
+                "error_type": type(e).__name__,
+            })
+            return
+        with session.lock:
+            session.tracked[rpc_id] = (req, False, 0)
+        session.emit({"event": "reply", "id": rpc_id})
+
+    def _op_adapter(self, session, msg, fn):
+        """Adapter ops run OFF the connection thread: a load_adapter is
+        tens of seconds of read + verify + device-put, and running it
+        inline would starve the read loop's pong replies past
+        lease_secs — the client would tear the connection down and the
+        op could never complete. Replies match by rpc id, so the caller
+        doesn't care which thread answers."""
+        def run():
+            try:
+                idx = fn()
+            except Exception as e:
+                session.emit({
+                    "event": "reply", "id": msg["id"], "error": str(e),
+                })
+                return
+            session.emit({
+                "event": "reply", "id": msg["id"], "index": int(idx),
+            })
+
+        threading.Thread(
+            target=run, name=f"ds-node-{self.node_id}-adapter",
+            daemon=True,
+        ).start()
+
+    # -- request watching (worker.py's poller, per session) --------------
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            with self._sessions_lock:
+                sessions = list(self._sessions.values())
+            for session in sessions:
+                if session.dead:
+                    continue
+                poll_tracked_requests(
+                    session.tracked, session.lock, session.emit
+                )
+            self._stop.wait(self._poll)
+
+    # -- session reaping --------------------------------------------------
+    def _reap_loop(self):
+        interval = max(
+            min(self.resume_grace_secs, self.lease_secs) / 4.0, 0.01
+        )
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            with self._sessions_lock:
+                sessions = list(self._sessions.items())
+            for key, session in sessions:
+                with session.lock:
+                    conn = session.conn
+                    idle = now - session.last_seen
+                    backlog = len(session.outbox)
+                if conn is None and idle > self.resume_grace_secs:
+                    self._drop_session(
+                        session,
+                        "no reconnect within the "
+                        f"{self.resume_grace_secs:.1f}s resume grace",
+                    )
+                elif backlog > OUTBOX_MAX_EVENTS:
+                    self._drop_session(
+                        session,
+                        f"event backlog {backlog} past the "
+                        f"{OUTBOX_MAX_EVENTS} ceiling (client stopped "
+                        "reading)",
+                    )
+                elif conn is not None and idle > 2.0 * self.lease_secs:
+                    # half-open guard: a bound connection that went
+                    # silent past two leases is a peer that vanished
+                    # without an RST — kill it; the session keeps its
+                    # resume grace
+                    logger.warning(
+                        "node %s: closing silent connection for client "
+                        "%s (%.1fs without a frame)",
+                        self.node_id, session.client, idle,
+                    )
+                    count_suppressed("serving.node_halfopen_close")
+                    session.unbind(conn)
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
+    def _drop_session(self, session, reason):
+        with self._sessions_lock:
+            self._sessions.pop((session.client, session.replica_name), None)
+        self._reap_session(session, reason)
+
+    def _reap_session(self, session, reason):
+        """Cancel everything the session still tracks (slots free within
+        one decode step) and mark it dead. The client, if it ever
+        returns, gets a fresh session whose welcome lists nothing — its
+        reconcile fail-finishes the orphans for re-route, so the answer
+        is re-derived exactly once elsewhere."""
+        with session.lock:
+            session.dead = True
+            orphans = list(session.tracked.values())
+            session.tracked.clear()
+            conn, session.conn = session.conn, None
+        if orphans:
+            logger.warning(
+                "node %s: reaping session %s/%s with %d in-flight "
+                "request(s): %s", self.node_id, session.client,
+                session.replica_name, len(orphans), reason,
+            )
+            count_suppressed("serving.node_session_reaped")
+        for req, _announced, _sent in orphans:
+            cancel = getattr(req, "cancel", None)
+            if cancel is not None:
+                cancel()
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu serving node agent (docs/serving.md "
+                    "'Networked fleet')"
+    )
+    parser.add_argument(
+        "--spec", help="node spec as inline JSON", default=None
+    )
+    parser.add_argument(
+        "--spec-file", help="node spec as a JSON file path", default=None
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0,
+                        help="0 = ephemeral (announced on stdout)")
+    args = parser.parse_args(argv)
+    if (args.spec is None) == (args.spec_file is None):
+        parser.error("pass exactly one of --spec / --spec-file")
+    if args.spec is not None:
+        spec = json.loads(args.spec)
+    else:
+        with open(args.spec_file) as f:
+            spec = json.load(f)
+    # the launcher contract: stdout carries EXACTLY one JSON line
+    # announcing where the node listens (ephemeral ports resolve here).
+    # Same fd discipline as worker.main: dup a private handle for the
+    # announcement, then point fd 1 at stderr so loggers, stray prints,
+    # and jax warnings cannot corrupt the launcher's readline.
+    import os
+
+    announce = os.fdopen(os.dup(sys.stdout.fileno()), "w", buffering=1)
+    os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
+    node = NodeServer(spec, host=args.host, port=args.port)
+    host, port = node.start()
+    announce.write(json.dumps({
+        "event": "listening", "node": node.node_id,
+        "host": host, "port": port,
+        "replicas": sorted(node.engines),
+        "proto": RPC_PROTOCOL_VERSION,
+    }) + "\n")
+    try:
+        node.run_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

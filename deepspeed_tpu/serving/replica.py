@@ -2,7 +2,8 @@
 
 The fleet router (router.py) never touches an ``InferenceEngine``
 directly — it speaks to :class:`InProcessReplica` /
-:class:`SubprocessReplica`, both exposing the same contract:
+:class:`SubprocessReplica` / :class:`SocketReplica` (transport.py), all
+exposing the same contract:
 
     submit(prompt, **kw) -> request handle   (.done/.tokens/.finish_reason
                                               /.first_token_at/.result())
@@ -17,11 +18,20 @@ N replicas in one process, zero-copy, sharing the host's devices.
 speaking newline-JSON RPC over stdin/stdout, so a replica that segfaults
 or OOMs cannot take the router (or its sibling replicas) down — the
 process exit IS the failure signal, and the router re-routes.
+``SocketReplica`` (serving/transport.py) speaks the SAME newline-JSON
+protocol over TCP to a node agent (serving/node.py) hosting N replicas
+on another host — the multi-host form of the same contract.
+
+The remote transports share :class:`RpcReplicaBase`: rpc-id bookkeeping,
+reply waiting with late-reply discard, idempotent-control-op retry,
+lost-completion reconciliation, and the protocol-version handshake — the
+pipe and the socket differ only in how bytes move.
 
 Failure semantics: ``failed`` is True only when the replica died WITHOUT
 being asked (decode driver past its restart budget in-process; unexpected
-process exit for subprocess). A drained or shut-down replica is not
-routable but not failed — eviction is for corpses, not for lifecycle.
+process exit for subprocess; a dead, reconnect-exhausted connection for
+sockets). A drained or shut-down replica is not routable but not failed —
+eviction is for corpses, not for lifecycle.
 """
 
 import json
@@ -41,12 +51,21 @@ from ..telemetry.registry import count_suppressed
 from ..utils.logging import logger
 
 _FINISH_ERROR = "error"
+_FINISH_CANCELLED = "cancelled"
+
+# The replica RPC's wire protocol version (pipes AND sockets — one
+# protocol, two transports). Bumped on any frame-schema change; both
+# ends announce theirs at the handshake (the worker's ``ready`` event,
+# the node's ``welcome`` frame) and a mismatch fail-fasts with a typed
+# :class:`ReplicaProtocolError` naming both versions instead of counting
+# undecodable frames until a circuit breaker opens.
+RPC_PROTOCOL_VERSION = 1
 
 
 class ReplicaRPCError(RequestRejected):
-    """The replica's TRANSPORT failed — a dead/closed pipe, a corrupted
-    or missing ack, an RPC timeout — as opposed to the engine answering
-    with a real rejection. Subclasses RequestRejected (reason
+    """The replica's TRANSPORT failed — a dead/closed pipe or socket, a
+    corrupted or missing ack, an RPC timeout — as opposed to the engine
+    answering with a real rejection. Subclasses RequestRejected (reason
     ``"draining"``) so every existing fall-through keeps working, while
     the router's circuit breakers can count exactly these as replica
     failures (docs/serving.md "Circuit breakers")."""
@@ -55,13 +74,22 @@ class ReplicaRPCError(RequestRejected):
         super().__init__(message, reason=reason)
 
 
+class ReplicaProtocolError(ReplicaRPCError):
+    """Protocol-version mismatch caught at the handshake: the two ends
+    speak different frame schemas, so every subsequent line would be
+    noise. Raised ONCE, naming both versions — never diagnosed one
+    undecodable frame at a time."""
+
+
 class ReplicaBase:
     """Shared lifecycle helpers; subclasses implement the transport.
 
     ``fault_injector`` (resilience/faults.py) arms the serving-tier
     chaos sites on this replica: ``snapshot.stale`` here in the shared
     :meth:`load_snapshot`, ``replica.flap`` at the subclasses' start(),
-    and the ``rpc.*`` pipe sites in the subprocess transport."""
+    the ``rpc.*`` pipe sites in the subprocess transport, and the
+    ``net.*``/``conn.*``/``frame.corrupt`` socket sites in the socket
+    transport."""
 
     def __init__(self, replica_id, fault_injector=None):
         self.replica_id = str(replica_id)
@@ -162,6 +190,14 @@ class InProcessReplica(ReplicaBase):
             )
         return engine.submit(prompt_tokens, **kwargs)
 
+    def cancel_request(self, handle):
+        """Withdraw ``handle`` (an InferenceRequest): queued it never
+        takes a slot; decoding its slot frees within one decode step —
+        the HTTP door's client-disconnect path (docs/serving.md)."""
+        cancel = getattr(handle, "cancel", None)
+        if cancel is not None:
+            cancel()
+
     def _snapshot_now(self):
         engine = self.engine
         if engine is None:
@@ -234,12 +270,14 @@ class InProcessReplica(ReplicaBase):
 
 
 # ---------------------------------------------------------------------------
-# subprocess backend: newline-JSON RPC over the worker's stdin/stdout
+# remote backends: the shared newline-JSON RPC state machine
 # ---------------------------------------------------------------------------
 class RemoteRequest:
     """Parent-side handle mirroring InferenceRequest's result surface for
-    a request running inside a worker process. Completed by the replica's
-    reader thread when the worker reports ``finished``."""
+    a request running inside a worker process or on a remote node.
+    Completed by the replica's reader thread when the remote side reports
+    ``finished``; ``token`` events stream tokens in incrementally (the
+    HTTP door's SSE source for remote replicas)."""
 
     def __init__(self, rpc_id, prompt_tokens, max_new_tokens):
         self.rpc_id = rpc_id
@@ -266,25 +304,38 @@ class RemoteRequest:
             )
         return self.tokens
 
+    def _append_token(self, index, token):
+        """One streamed token. ``index`` is the token's absolute position
+        so re-emits after a reconnect-with-resume (transport.py) are
+        idempotent: duplicates and already-seen prefixes are dropped, a
+        gap waits for the authoritative ``finished`` list."""
+        if token is None:
+            return
+        if index is None or int(index) == len(self.tokens):
+            self.tokens.append(int(token))
+
     def _finish(self, tokens, reason):
         self.tokens = list(tokens)
         self.finish_reason = reason
         self._done.set()
 
 
-class SubprocessReplica(ReplicaBase):
-    """One engine per worker process (serving/worker.py), talked to over
-    newline-JSON on the worker's stdin/stdout (stderr passes through for
-    logs). ``worker_spec`` is the JSON the worker builds its model and
-    engine from — see worker.py's module docstring for the schema."""
+class RpcReplicaBase(ReplicaBase):
+    """The transport-agnostic half of a remote replica: rpc-id minting,
+    reply waiting with late-reply discard, idempotent-control-op retry
+    with backoff, the submit/adapter/snapshot ops, lost-completion
+    reconciliation, and the protocol handshake check. Subclasses provide
+    the byte movement:
 
-    def __init__(self, replica_id, worker_spec, *, python=None,
-                 start_timeout=120.0, rpc_timeout=10.0, rpc_retries=2,
+        _send(msg)           one JSON-safe dict to the remote side
+        _transport_alive()   is the pipe/socket still usable?
+
+    and feed inbound messages to :meth:`_dispatch` from their reader
+    thread, calling :meth:`_on_transport_eof` when the stream ends."""
+
+    def __init__(self, replica_id, *, rpc_timeout=10.0, rpc_retries=2,
                  rpc_backoff_secs=0.05, fault_injector=None):
         super().__init__(replica_id, fault_injector=fault_injector)
-        self.worker_spec = dict(worker_spec)
-        self._python = python or sys.executable
-        self._start_timeout = float(start_timeout)
         self._rpc_timeout = float(rpc_timeout)
         # idempotent control ops (snapshot / drain / adapter management)
         # retry transient transport failures with exponential backoff;
@@ -298,8 +349,6 @@ class SubprocessReplica(ReplicaBase):
         # (retries+1) x timeout — one hung worker must not stall every
         # placement pass for the full retry budget
         self._unresponsive_until = 0.0
-        self._proc = None
-        self._reader = None
         self._write_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._rpc_ids = iter(range(1, 1 << 62)).__next__
@@ -308,107 +357,70 @@ class SubprocessReplica(ReplicaBase):
         self._expected = set()   # rpc_ids with a live reply waiter
         self._reply_cond = threading.Condition()
         self._ready = threading.Event()
+        # the remote side's protocol version, captured at the handshake
+        # (None until it announces; pre-handshake peers read as v0)
+        self._remote_proto = None
         self._shutdown_requested = False
 
-    def start(self):
-        if self._proc is not None and self._proc.poll() is None:
-            return self
-        # fault site: crash-on-(re)start (see InProcessReplica.start)
-        self.faults.maybe_raise("replica.flap")
-        self._shutdown_requested = False
+    # -- transport hooks -------------------------------------------------
+    def _send(self, msg):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def _transport_alive(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def _transport_recovering(self):
+        """True while the transport is down but may still heal on its
+        own (the socket transport's reconnect-with-resume window): the
+        replica then reads UNRESPONSIVE — steered around, zombie-watched
+        — instead of failed-and-evicted. Pipes never recover."""
+        return False
+
+    def _transport_dead_exc(self, detail):
+        """The exception for an op against a dead transport. A REQUESTED
+        shutdown/drain classifies as an ordinary ``"draining"`` rejection
+        (the router's breakers treat it as an answered door, resetting
+        the failure streak); anything else is :class:`ReplicaRPCError` —
+        breaker food."""
+        if self._shutdown_requested:
+            return RequestRejected(
+                f"replica {self.replica_id} is shut down ({detail})",
+                reason=REJECT_DRAINING,
+            )
+        return ReplicaRPCError(f"replica {self.replica_id} {detail}")
+
+    def _reset_rpc_state(self):
+        """Called at (re)start: stale RPC state from a previous
+        incarnation must not leak into (or slowly grow across)
+        restarts."""
         self._ready.clear()
-        # stale RPC state from a previous incarnation must not leak into
-        # (or slowly grow across) restarts
+        self._remote_proto = None
         with self._reply_cond:
             self._replies.clear()
             self._expected.clear()
         with self._state_lock:
             self._outstanding.clear()
         self._unresponsive_until = 0.0
-        # the worker inherits the parent's environment verbatim: forcing
-        # a platform here would silently downgrade accelerator fleets
-        # (tests/bench export JAX_PLATFORMS=cpu themselves)
-        self._proc = subprocess.Popen(
-            [self._python, "-m", "deepspeed_tpu.serving.worker"],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=None,
-            text=True, env=dict(os.environ),
-        )
-        self._reader = threading.Thread(
-            target=self._read_loop, args=(self._proc,),
-            name=f"ds-replica-{self.replica_id}-reader", daemon=True,
-        )
-        self._reader.start()
-        # the spec carries this replica's id so the worker's scheduler
-        # mints replica-prefixed request ids (and its spans say which
-        # replica served them)
-        self._send({
-            "op": "init",
-            "spec": dict(self.worker_spec, replica_id=self.replica_id),
-        })
-        if not self._ready.wait(self._start_timeout):
+
+    def _check_protocol(self):
+        """Handshake gate: raise a typed error naming BOTH versions when
+        the remote side speaks a different frame schema. A peer that
+        never announced a version is v0 — the pre-handshake protocol."""
+        remote = 0 if self._remote_proto is None else int(self._remote_proto)
+        if remote != RPC_PROTOCOL_VERSION:
             self.shutdown()
-            raise RuntimeError(
-                f"replica {self.replica_id} worker did not become ready "
-                f"within {self._start_timeout}s"
+            raise ReplicaProtocolError(
+                f"replica {self.replica_id}: RPC protocol version "
+                f"mismatch — this router speaks v{RPC_PROTOCOL_VERSION}, "
+                f"the remote side answered v{remote}; upgrade the older "
+                f"side before routing traffic through it"
             )
-        return self
 
-    # -- transport ------------------------------------------------------
-    def _send(self, msg):
-        proc = self._proc
-        if proc is None or proc.poll() is not None:
-            raise ReplicaRPCError(
-                f"replica {self.replica_id} worker process is not running"
-            )
-        line = json.dumps(msg)
-        # fault site rpc.send: drop / corrupt / delay this line before it
-        # reaches the worker (a dropped op simply never gets its reply —
-        # exactly what a torn pipe write looks like from here)
-        line = self.faults.mangle_line("rpc.send", line)
-        if line is None:
-            return
-        with self._write_lock:
-            try:
-                proc.stdin.write(line + "\n")
-                proc.stdin.flush()
-            except (BrokenPipeError, OSError, ValueError):
-                raise ReplicaRPCError(
-                    f"replica {self.replica_id} worker pipe is closed"
-                ) from None
-
-    def _read_loop(self, proc):
-        for line in proc.stdout:
-            line = line.strip()
-            if not line:
-                continue
-            # fault site rpc.recv: the worker's event is dropped,
-            # garbled, or delivered late
-            line = self.faults.mangle_line("rpc.recv", line)
-            if line is None:
-                continue
-            try:
-                msg = json.loads(line)
-            except ValueError as e:
-                logger.warning(
-                    "replica %s: undecodable worker line %r",
-                    self.replica_id, line[:200],
-                )
-                count_suppressed("serving.rpc_undecodable_line", e)
-                continue
-            self._dispatch(msg)
-        # EOF: the worker is gone — fail everything still outstanding so
-        # the router's monitor re-routes instead of waiting forever
-        with self._state_lock:
-            orphans = list(self._outstanding.values())
-            self._outstanding.clear()
-        for req in orphans:
-            req._finish(req.tokens, _FINISH_ERROR)
-        with self._reply_cond:
-            self._reply_cond.notify_all()
-
+    # -- inbound ---------------------------------------------------------
     def _dispatch(self, msg):
         event = msg.get("event")
         if event == "ready":
+            self._remote_proto = msg.get("proto", 0)
             self._ready.set()
         elif event == "reply":
             with self._reply_cond:
@@ -423,6 +435,15 @@ class SubprocessReplica(ReplicaBase):
                 req = self._outstanding.get(msg["id"])
             if req is not None and req.first_token_at is None:
                 req.first_token_at = time.monotonic()
+        elif event == "token":
+            # incremental token stream (worker watch loop / node watcher):
+            # what the HTTP door's SSE path reads between TTFT and finish
+            with self._state_lock:
+                req = self._outstanding.get(msg["id"])
+            if req is not None:
+                if req.first_token_at is None:
+                    req.first_token_at = time.monotonic()
+                req._append_token(msg.get("i"), msg.get("t"))
         elif event == "finished":
             with self._state_lock:
                 req = self._outstanding.pop(msg["id"], None)
@@ -431,29 +452,67 @@ class SubprocessReplica(ReplicaBase):
                     req.first_token_at = time.monotonic()
                 req.trace_spans = msg.get("spans") or []
                 req._finish(msg.get("tokens", []), msg.get("reason"))
-        else:
+        elif not self._dispatch_extra(msg):
             logger.warning(
-                "replica %s: unknown worker event %r",
+                "replica %s: unknown remote event %r",
                 self.replica_id, event,
             )
             count_suppressed("serving.rpc_unknown_event")
 
+    def _dispatch_extra(self, msg):
+        """Subclass hook for transport-level events (pong, welcome, ...);
+        return True when the message was handled."""
+        del msg
+        return False
+
+    def _on_transport_eof(self, graceful):
+        """The inbound stream ended: fail everything still outstanding so
+        the router's monitor re-routes instead of waiting forever. A
+        GRACEFUL end (requested shutdown/drain) finishes orphans
+        ``"cancelled"`` quietly; a killed transport finishes them
+        ``"error"`` and counts the event — clean shutdowns must not read
+        like crashes in the diagnostics (or feed breaker streaks via the
+        woken waiters, which classify through
+        :meth:`_transport_dead_exc`)."""
+        with self._state_lock:
+            orphans = list(self._outstanding.values())
+            self._outstanding.clear()
+        if orphans and not graceful:
+            # diagnostics BEFORE the finishes below wake any waiters: a
+            # caller observing a request fail must already see the death
+            # counted, not race the counter on another thread
+            logger.warning(
+                "replica %s: transport died with %d request(s) in flight; "
+                "failing them for re-route", self.replica_id, len(orphans),
+            )
+            count_suppressed("serving.transport_died_inflight")
+        for req in orphans:
+            req._finish(req.tokens, _FINISH_CANCELLED if graceful
+                        else _FINISH_ERROR)
+        with self._reply_cond:
+            self._reply_cond.notify_all()
+
+    # -- outbound --------------------------------------------------------
     def _await_reply(self, rpc_id, timeout, make_exc):
         """Wait for ``rpc_id``'s reply; raises ``make_exc()`` on timeout
-        or worker death. The waiter registers in ``_expected`` around the
-        wait so a reply landing AFTER the timeout is dropped by the
-        reader instead of leaking in ``_replies`` forever."""
+        or transport death (a graceful shutdown races classify as
+        ``"draining"`` instead — see :meth:`_transport_dead_exc`). The
+        waiter registers in ``_expected`` around the wait so a reply
+        landing AFTER the timeout is dropped by the reader instead of
+        leaking in ``_replies`` forever."""
         deadline = time.monotonic() + timeout
         with self._reply_cond:
             try:
                 while rpc_id not in self._replies:
                     remaining = deadline - time.monotonic()
-                    proc = self._proc
-                    if (
-                        remaining <= 0
-                        or proc is None
-                        or proc.poll() is not None
-                    ):
+                    if remaining <= 0 or not self._transport_alive():
+                        if (
+                            self._shutdown_requested
+                            and not self._transport_alive()
+                        ):
+                            raise self._transport_dead_exc(
+                                "shut down mid-call"
+                            )
                         raise make_exc()
                     self._reply_cond.wait(min(remaining, 0.1))
                 return self._replies.pop(rpc_id)
@@ -493,9 +552,8 @@ class SubprocessReplica(ReplicaBase):
             try:
                 return self._call(msg, timeout=timeout)
             except (TimeoutError, ReplicaRPCError) as e:
-                proc = self._proc
                 if attempt >= self._rpc_retries or (
-                    proc is None or proc.poll() is not None
+                    not self._transport_alive()
                 ):
                     raise
                 # swallowed-and-retried: never silently (docs/resilience.md)
@@ -510,6 +568,14 @@ class SubprocessReplica(ReplicaBase):
                 attempt += 1
 
     # -- serving --------------------------------------------------------
+    def _frame_submit(self, msg, kwargs):
+        """Transport hook: final shaping of the submit frame. The socket
+        transport lifts ``deadline_secs`` out of the app kwargs into the
+        frame header (``dl_ms``) so the deadline rides the TRANSPORT and
+        the node re-derives the engine deadline from it."""
+        del kwargs
+        return msg
+
     def submit(self, prompt_tokens, max_new_tokens=32, **kwargs):
         rpc_id = self._rpc_ids()
         req = RemoteRequest(rpc_id, prompt_tokens, max_new_tokens)
@@ -518,12 +584,13 @@ class SubprocessReplica(ReplicaBase):
         with self._reply_cond:
             self._expected.add(rpc_id)
         try:
-            self._send({
+            msg = {
                 "op": "submit", "id": rpc_id,
                 "prompt": [int(t) for t in prompt_tokens],
                 "max_new_tokens": int(max_new_tokens),
                 "kwargs": kwargs,
-            })
+            }
+            self._send(self._frame_submit(msg, kwargs))
             reply = self._await_reply(
                 rpc_id, self._rpc_timeout,
                 lambda: ReplicaRPCError(
@@ -552,16 +619,27 @@ class SubprocessReplica(ReplicaBase):
             raise ValueError(reply["error"])
         return req
 
+    def cancel_request(self, handle):
+        """Best-effort remote cancel (the HTTP door's client-disconnect
+        path): the remote scheduler reclaims the slot within one decode
+        step and its ``finished`` event completes the handle. A dead
+        transport is ignored — its requests fail-finish at EOF anyway."""
+        try:
+            self._send({"op": "cancel", "id": handle.rpc_id})
+        except RequestRejected as e:
+            count_suppressed("serving.cancel_rpc", e)
+
     def load_adapter(self, name, load_dir=None, tag=None, timeout=60.0,
                      **kwargs):
-        """Install a LoRA adapter on the worker. Only checkpoint-backed
-        loads cross the process boundary (``load_dir``/``tag`` — adapter
-        trees are weights, not JSON; commit them with the training
-        engine's save_checkpoint and load by directory). A generous
-        timeout: the worker reads + verifies + device-puts the rows."""
+        """Install a LoRA adapter on the remote engine. Only
+        checkpoint-backed loads cross the process boundary
+        (``load_dir``/``tag`` — adapter trees are weights, not JSON;
+        commit them with the training engine's save_checkpoint and load
+        by directory). A generous timeout: the remote side reads +
+        verifies + device-puts the rows."""
         if kwargs:
             raise ValueError(
-                "subprocess replicas load adapters from checkpoint "
+                "remote replicas load adapters from checkpoint "
                 f"directories only (load_dir=...); got {sorted(kwargs)}"
             )
         if load_dir is None:
@@ -584,7 +662,11 @@ class SubprocessReplica(ReplicaBase):
         return int(reply["index"])
 
     def _snapshot_now(self):
-        if self._proc is None or self._proc.poll() is not None:
+        if not self._transport_alive():
+            if self._transport_recovering():
+                snap = _dead_snapshot(failed=False)
+                snap["unresponsive"] = True
+                return snap
             return _dead_snapshot(failed=not self._shutdown_requested)
         if time.monotonic() < self._unresponsive_until:
             snap = _dead_snapshot(failed=False)
@@ -593,9 +675,8 @@ class SubprocessReplica(ReplicaBase):
         try:
             reply = self._call_retrying({"op": "snapshot"})
         except (TimeoutError, RequestRejected):
-            proc = self._proc
-            if proc is not None and proc.poll() is None:
-                # the process is ALIVE but not answering past the retry
+            if self._transport_alive():
+                # the transport is UP but not answering past the retry
                 # budget: an unresponsive replica, not a corpse — the
                 # router steers traffic away and lets zombie detection
                 # (docs/serving.md) decide on a restart, instead of
@@ -608,7 +689,13 @@ class SubprocessReplica(ReplicaBase):
                 snap = _dead_snapshot(failed=False)
                 snap["unresponsive"] = True
                 return snap
-            # genuinely exited between the poll() check and the RPC —
+            if self._transport_recovering():
+                # the connection dropped mid-RPC but reconnect-with-
+                # resume is still in play: steer around, don't evict
+                snap = _dead_snapshot(failed=False)
+                snap["unresponsive"] = True
+                return snap
+            # genuinely died between the aliveness check and the RPC —
             # a dead replica IS a dead snapshot
             return _dead_snapshot(failed=not self._shutdown_requested)
         self._unresponsive_until = 0.0
@@ -619,12 +706,13 @@ class SubprocessReplica(ReplicaBase):
         return snap
 
     def _reconcile_orphans(self, snap):
-        """A worker reporting fully idle while this parent still holds
-        outstanding requests older than the RPC timeout means their
-        ``finished`` events were LOST on the pipe (dropped line, reader
-        hiccup). Fail-finish them so the router re-routes: the worker's
-        answer never reached any caller, so re-deriving it elsewhere
-        keeps exactly-once delivery."""
+        """A remote side reporting fully idle while this parent still
+        holds outstanding requests older than the RPC timeout means their
+        ``finished`` events were LOST in transit (dropped line, reader
+        hiccup, a reconnect the node no longer remembers them across).
+        Fail-finish them so the router re-routes: the remote answer never
+        reached any caller, so re-deriving it elsewhere keeps
+        exactly-once delivery."""
         if not (
             snap.get("alive")
             and snap.get("queue_depth") == 0
@@ -639,7 +727,7 @@ class SubprocessReplica(ReplicaBase):
                     orphans.append(self._outstanding.pop(rpc_id))
         for req in orphans:
             logger.warning(
-                "replica %s: request %s finished on the worker but its "
+                "replica %s: request %s finished remotely but its "
                 "completion event never arrived; failing it for re-route",
                 self.replica_id, req.rpc_id,
             )
@@ -648,8 +736,8 @@ class SubprocessReplica(ReplicaBase):
 
     def set_brownout(self, on):
         """Fire-and-forget brownout toggle (docs/serving.md); a dead
-        pipe is ignored — a replica that cannot hear the toggle is not
-        serving traffic either."""
+        transport is ignored — a replica that cannot hear the toggle is
+        not serving traffic either."""
         try:
             self._send({"op": "brownout", "on": bool(on)})
         except RequestRejected as e:
@@ -660,12 +748,127 @@ class SubprocessReplica(ReplicaBase):
         try:
             self._send({"op": "drain"})
         except RequestRejected as e:
-            # _send only fails on a dead process or a broken pipe —
-            # neither heals within this worker incarnation, so a retry
-            # buys nothing: the replica is drained by definition, but
-            # never silently (docs/resilience.md "no silent swallows")
+            # _send only fails on a dead transport — which does not heal
+            # within this incarnation, so a retry buys nothing: the
+            # replica is drained by definition, but never silently
+            # (docs/resilience.md "no silent swallows")
             count_suppressed("serving.drain_rpc", e)
 
+    def shutdown(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# subprocess backend: newline-JSON RPC over the worker's stdin/stdout
+# ---------------------------------------------------------------------------
+class SubprocessReplica(RpcReplicaBase):
+    """One engine per worker process (serving/worker.py), talked to over
+    newline-JSON on the worker's stdin/stdout (stderr passes through for
+    logs). ``worker_spec`` is the JSON the worker builds its model and
+    engine from — see worker.py's module docstring for the schema."""
+
+    def __init__(self, replica_id, worker_spec, *, python=None,
+                 start_timeout=120.0, rpc_timeout=10.0, rpc_retries=2,
+                 rpc_backoff_secs=0.05, fault_injector=None):
+        super().__init__(
+            replica_id, rpc_timeout=rpc_timeout, rpc_retries=rpc_retries,
+            rpc_backoff_secs=rpc_backoff_secs, fault_injector=fault_injector,
+        )
+        self.worker_spec = dict(worker_spec)
+        self._python = python or sys.executable
+        self._start_timeout = float(start_timeout)
+        self._proc = None
+        self._reader = None
+
+    def start(self):
+        if self._proc is not None and self._proc.poll() is None:
+            return self
+        # fault site: crash-on-(re)start (see InProcessReplica.start)
+        self.faults.maybe_raise("replica.flap")
+        self._shutdown_requested = False
+        self._reset_rpc_state()
+        # the worker inherits the parent's environment verbatim: forcing
+        # a platform here would silently downgrade accelerator fleets
+        # (tests/bench export JAX_PLATFORMS=cpu themselves)
+        self._proc = subprocess.Popen(
+            [self._python, "-m", "deepspeed_tpu.serving.worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=None,
+            text=True, env=dict(os.environ),
+        )
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(self._proc,),
+            name=f"ds-replica-{self.replica_id}-reader", daemon=True,
+        )
+        self._reader.start()
+        # the spec carries this replica's id so the worker's scheduler
+        # mints replica-prefixed request ids (and its spans say which
+        # replica served them); ``proto`` is this side's handshake half
+        self._send({
+            "op": "init", "proto": RPC_PROTOCOL_VERSION,
+            "spec": dict(self.worker_spec, replica_id=self.replica_id),
+        })
+        if not self._ready.wait(self._start_timeout):
+            self.shutdown()
+            raise RuntimeError(
+                f"replica {self.replica_id} worker did not become ready "
+                f"within {self._start_timeout}s"
+            )
+        # fail-fast on a version skew, with both versions named — never
+        # one undecodable line at a time until the breaker opens
+        self._check_protocol()
+        return self
+
+    # -- transport ------------------------------------------------------
+    def _transport_alive(self):
+        proc = self._proc
+        return proc is not None and proc.poll() is None
+
+    def _send(self, msg):
+        proc = self._proc
+        if proc is None or proc.poll() is not None:
+            raise self._transport_dead_exc("worker process is not running")
+        line = json.dumps(msg)
+        # fault site rpc.send: drop / corrupt / delay this line before it
+        # reaches the worker (a dropped op simply never gets its reply —
+        # exactly what a torn pipe write looks like from here)
+        line = self.faults.mangle_line("rpc.send", line)
+        if line is None:
+            return
+        with self._write_lock:
+            try:
+                proc.stdin.write(line + "\n")
+                proc.stdin.flush()
+            except (BrokenPipeError, OSError, ValueError):
+                raise self._transport_dead_exc(
+                    "worker pipe is closed"
+                ) from None
+
+    def _read_loop(self, proc):
+        for line in proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            # fault site rpc.recv: the worker's event is dropped,
+            # garbled, or delivered late
+            line = self.faults.mangle_line("rpc.recv", line)
+            if line is None:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError as e:
+                logger.warning(
+                    "replica %s: undecodable worker line %r",
+                    self.replica_id, line[:200],
+                )
+                count_suppressed("serving.rpc_undecodable_line", e)
+                continue
+            self._dispatch(msg)
+        # EOF: a REQUESTED shutdown/drain reads as a clean goodbye (the
+        # orphan sweep below stays quiet and nothing feeds a breaker
+        # streak); an unrequested EOF is a killed pipe — fail loudly
+        self._on_transport_eof(graceful=self._shutdown_requested)
+
+    # -- lifecycle ------------------------------------------------------
     def restart(self):
         self.shutdown()
         return self.start()

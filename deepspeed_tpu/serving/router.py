@@ -781,6 +781,45 @@ class FleetRouter:
         self._routed.inc()
         return fleet_req
 
+    def cancel(self, fleet_req):
+        """Withdraw an outstanding fleet request (the HTTP door's
+        client-disconnect path, serving/http.py): its replica-side slot
+        frees within one decode step and the request finishes
+        ``"cancelled"``. Popped from the outstanding table FIRST so the
+        monitor's sweep can never mistake the cancelled inner for a
+        replica death and re-route it. Returns True when this call
+        withdrew it; False when it already finished (or was never
+        outstanding) — the answer was (or will be) delivered normally."""
+        with self._lock:
+            entry = self._outstanding.pop(fleet_req.request_id, None)
+        if entry is None:
+            return False
+        _fr, inner, rid = entry
+        replica = self._replicas.get(rid)
+        do_cancel = getattr(replica, "cancel_request", None)
+        if do_cancel is not None:
+            try:
+                do_cancel(inner)
+            except Exception as e:
+                # the replica may be mid-death; its EOF sweep reaps the
+                # inner request either way — never fail the withdrawal
+                count_suppressed("serving.cancel_request", e)
+        self._trace_finish_root(
+            fleet_req, _FINISH_CANCELLED, inner=inner, rid=rid
+        )
+        fleet_req._finish(inner.tokens, _FINISH_CANCELLED)
+        return True
+
+    def inner_handle(self, fleet_req):
+        """The replica-side handle currently serving ``fleet_req`` (None
+        once finished or not yet placed). Its ``tokens`` list grows as
+        the scheduler finishes each token — the HTTP door's incremental
+        SSE source; a re-route swaps the handle, so streaming callers
+        re-read per poll instead of caching it."""
+        with self._lock:
+            entry = self._outstanding.get(fleet_req.request_id)
+        return entry[1] if entry is not None else None
+
     def _trace_reject(self, reason, tenant):
         """Router-door rejection breadcrumb for the flight recorder."""
         if self.tracer.enabled:
@@ -1212,7 +1251,17 @@ class FleetRouter:
             else:
                 # "error"/"cancelled": the replica died under it (crash
                 # past restart budget, eviction, worker exit) — re-place
-                # on a live replica, or fail the fleet request loudly
+                # on a live replica, or fail the fleet request loudly.
+                # But FIRST re-check the table: this sweep iterates a
+                # pre-pop snapshot, and a concurrent cancel() (HTTP
+                # client disconnect) may have withdrawn the entry after
+                # the snapshot was taken — rerouting it now would decode
+                # a full generation for nobody and double-finish the
+                # fleet request
+                with self._lock:
+                    still = self._outstanding.get(req_id)
+                if still is None or still[1] is not inner:
+                    continue
                 self._reroute(req_id, fleet_req, inner)
 
     def _reroute(self, req_id, fleet_req, inner=None):
@@ -1268,7 +1317,20 @@ class FleetRouter:
             )
         self._rerouted.inc()
         with self._lock:
-            self._outstanding[req_id] = (fleet_req, inner, rid)
+            # a cancel() can land between placement and this re-insert:
+            # the fleet request is already finished "cancelled" then, so
+            # withdraw the fresh inner instead of decoding for nobody
+            stale = fleet_req.done
+            if not stale:
+                self._outstanding[req_id] = (fleet_req, inner, rid)
+        if stale:
+            replica = self._replicas.get(rid)
+            do_cancel = getattr(replica, "cancel_request", None)
+            if do_cancel is not None:
+                try:
+                    do_cancel(inner)
+                except Exception as e:
+                    count_suppressed("serving.cancel_request", e)
 
     # -- telemetry ------------------------------------------------------
     def refresh_telemetry(self):

@@ -5,12 +5,16 @@
 (stderr is left alone for logging), giving a SubprocessReplica real
 isolation: a worker that segfaults or OOMs takes only its own engine.
 
-Protocol (one JSON object per line):
+Protocol (one JSON object per line; shared with the TCP node agent in
+serving/node.py — RPC_PROTOCOL_VERSION in serving/replica.py names the
+frame schema both transports speak):
 
   parent -> worker
-    {"op": "init", "spec": {...}}            build the engine (see below)
+    {"op": "init", "proto": V, "spec": {...}} build the engine (see below)
     {"op": "submit", "id": N, "prompt": [...],
      "max_new_tokens": M, "kwargs": {...}}   admit one request
+    {"op": "cancel", "id": N}                withdraw request N (its slot
+                                             frees within one decode step)
     {"op": "snapshot", "id": N}              router-facing load snapshot
     {"op": "load_adapter", "id": N,
      "name": "...", "load_dir": "...",
@@ -22,11 +26,20 @@ Protocol (one JSON object per line):
     {"op": "shutdown"}                       close the engine and exit
 
   worker -> parent
-    {"event": "ready"}                       init finished, serving
+    {"event": "ready", "proto": V}           init finished, serving; V is
+                                             the worker's protocol version
+                                             (the handshake's other half —
+                                             a mismatch fail-fasts in the
+                                             parent with a typed error)
     {"event": "reply", "id": N, ...}         op ack (submit/snapshot);
                                              carries "error" + "reason"
                                              when the op was rejected
     {"event": "first_token", "id": N}        request N produced its TTFT
+    {"event": "token", "id": N,
+     "i": K, "t": T}                         request N's K-th generated
+                                             token, streamed as the
+                                             scheduler finishes it (the
+                                             HTTP door's SSE source)
     {"event": "finished", "id": N,
      "tokens": [...], "reason": "...",
      "spans": [...]}                         request N's terminal answer;
@@ -59,6 +72,50 @@ import time
 
 from ..inference.scheduler import RequestRejected
 from ..resilience.faults import NULL_INJECTOR
+from .replica import RPC_PROTOCOL_VERSION
+
+
+def poll_tracked_requests(tracked_map, lock, emit):
+    """One pass over a ``{rpc_id: (request, first_token_announced,
+    tokens_sent)}`` table: announce first tokens, stream each
+    newly-decoded token the moment the scheduler finishes it (so the
+    parent's handle — and the HTTP door's SSE stream behind it — grows
+    incrementally instead of materializing at completion; ``i`` carries
+    the absolute index so re-emits after a resume are idempotent), and
+    pop + ship ``finished`` for done requests. Shared by the worker's
+    stdin/stdout protocol and the node agent's per-session sockets
+    (node.py) — one poller, two transports, no drift."""
+    with lock:
+        tracked = list(tracked_map.items())
+    for rpc_id, (req, announced, sent) in tracked:
+        if not announced and req.first_token_at is not None:
+            announced = True
+            emit({"event": "first_token", "id": rpc_id})
+        tokens = list(req.tokens)
+        for i in range(sent, len(tokens)):
+            emit({
+                "event": "token", "id": rpc_id, "i": i, "t": int(tokens[i]),
+            })
+        sent = max(sent, len(tokens))
+        with lock:
+            if rpc_id in tracked_map:
+                tracked_map[rpc_id] = (req, announced, sent)
+        if req.done:
+            with lock:
+                tracked_map.pop(rpc_id, None)
+            msg = {
+                "event": "finished", "id": rpc_id,
+                "tokens": [int(t) for t in req.tokens],
+                "reason": req.finish_reason,
+            }
+            # ship the request's sampled trace spans home with the
+            # answer: the parent replica hands them to the router's
+            # tracer, joining the remote spans to the fleet request's
+            # trace in ONE file
+            spans = getattr(req, "trace_spans", None)
+            if spans:
+                msg["spans"] = spans
+            emit(msg)
 
 
 class WorkerServer:
@@ -90,30 +147,9 @@ class WorkerServer:
     # driver thread; this poller turns completion into pipe events) ----
     def _watch_loop(self):
         while not self._stop.is_set():
-            with self._state_lock:
-                tracked = list(self._tracked.items())
-            for rpc_id, (req, announced) in tracked:
-                if not announced and req.first_token_at is not None:
-                    with self._state_lock:
-                        if rpc_id in self._tracked:
-                            self._tracked[rpc_id] = (req, True)
-                    self._emit({"event": "first_token", "id": rpc_id})
-                if req.done:
-                    with self._state_lock:
-                        self._tracked.pop(rpc_id, None)
-                    msg = {
-                        "event": "finished", "id": rpc_id,
-                        "tokens": [int(t) for t in req.tokens],
-                        "reason": req.finish_reason,
-                    }
-                    # ship the request's sampled trace spans home with
-                    # the answer: the parent replica hands them to the
-                    # router's tracer, joining this worker's spans to
-                    # the fleet request's trace in ONE file
-                    spans = getattr(req, "trace_spans", None)
-                    if spans:
-                        msg["spans"] = spans
-                    self._emit(msg)
+            poll_tracked_requests(
+                self._tracked, self._state_lock, self._emit
+            )
             self._stop.wait(self._poll)
 
     # -- ops -----------------------------------------------------------
@@ -133,7 +169,10 @@ class WorkerServer:
         threading.Thread(
             target=self._watch_loop, name="ds-worker-watch", daemon=True
         ).start()
-        self._emit({"event": "ready"})
+        # the handshake's worker half: announce which frame schema this
+        # worker speaks; the parent fail-fasts on a mismatch with a typed
+        # error naming both versions (replica.py _check_protocol)
+        self._emit({"event": "ready", "proto": RPC_PROTOCOL_VERSION})
 
     def _op_submit(self, msg):
         rpc_id = msg["id"]
@@ -166,8 +205,22 @@ class WorkerServer:
             })
             return
         with self._state_lock:
-            self._tracked[rpc_id] = (req, False)
+            # (request, first_token_announced, tokens_streamed)
+            self._tracked[rpc_id] = (req, False, 0)
         self._emit({"event": "reply", "id": rpc_id})
+
+    def _op_cancel(self, msg):
+        """Withdraw request ``id`` (the HTTP door's client-disconnect
+        path relayed over the RPC): its slot frees within one decode
+        step and the watch loop ships the ``cancelled`` finish. Unknown
+        ids are a no-op — the request may have finished (and untracked)
+        while the cancel frame was in flight."""
+        with self._state_lock:
+            entry = self._tracked.get(msg.get("id"))
+        if entry is not None:
+            cancel = getattr(entry[0], "cancel", None)
+            if cancel is not None:
+                cancel()
 
     def _op_snapshot(self, msg):
         self._emit({
@@ -218,6 +271,8 @@ class WorkerServer:
                     self._op_init(msg)
                 elif op == "submit":
                     self._op_submit(msg)
+                elif op == "cancel":
+                    self._op_cancel(msg)
                 elif op == "snapshot":
                     self._op_snapshot(msg)
                 elif op == "load_adapter":
@@ -273,7 +328,18 @@ class _StubRequest:
     def done(self):
         return self._done.is_set()
 
+    def cancel(self):
+        """The InferenceRequest cancel surface: finish now with reason
+        ``"cancelled"`` (tokens so far are the partial answer) — even in
+        hang mode, so the RPC cancel path is testable against a wedged
+        stub."""
+        if not self._done.is_set():
+            self.finish_reason = "cancelled"
+            self._done.set()
+
     def _finish(self):
+        if self._done.is_set():
+            return  # cancelled first: the timer's answer is discarded
         self.tokens = self._pending
         self.first_token_at = time.monotonic()
         self.finish_reason = "max_new_tokens"
@@ -356,6 +422,10 @@ class StubWorkerEngine:
 
     def load_snapshot(self):
         with self._lock:
+            # prune finished husks: a CANCELLED request left the slot the
+            # moment it finished, even though its completion timer (which
+            # normally reaps it) has not fired yet
+            self._active = [r for r in self._active if not r.done]
             active = len(self._active)
             completed, tokens = self._completed, self._tokens_out
         return {

@@ -166,6 +166,16 @@ SERVING_METRICS = (
     ("counter", "fleet/zombie_restarts", "replicas drained-then-restarted by zombie detection (active slots with frozen completion counters, or a live-but-unresponsive worker)"),
     ("gauge", "fleet/brownout", "1 while the fleet queue fill sits in the brownout band (sheddable requests degrade instead of queueing toward the shed cliff)"),
     ("counter", "fleet/requests_browned_out", "priority > 0 submissions admitted with max_new_tokens clamped to the brownout floor"),
+    # networked fleet (docs/serving.md "Networked fleet"): the socket
+    # transport's failure envelope + the HTTP/SSE door's stream health
+    ("counter", "fleet/net_reconnects", "socket-transport reconnect-with-resume successes: a dropped connection re-attached to the node's in-flight session instead of burning a re-route"),
+    ("counter", "fleet/net_lease_expiries", "socket connections torn down after a silent heartbeat-lease window (the half-open-link detector)"),
+    ("counter", "fleet/net_frames_corrupt", "received socket frames dropped for failing the length check or JSON decode (idempotent-RPC retry re-asks; submits fall through placement)"),
+    ("counter", "fleet/net_slow_client_drops", "HTTP streams dropped by the overrun policy: the client drained slower than its tokens arrived, so the request cancelled and the slot freed"),
+    ("counter", "door/requests", "HTTP requests accepted by the front door"),
+    ("gauge", "door/open_streams", "SSE token streams currently open on the door"),
+    ("histogram", "door/stream_ttft_ms", "door-observed time to first streamed token event (request receipt to the first SSE token flush)"),
+    ("counter", "door/client_disconnects", "streams abandoned by the client before completion; their fleet requests cancel and the replica slot frees within one decode step"),
 )
 
 

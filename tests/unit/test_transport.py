@@ -1,0 +1,526 @@
+"""Socket replica transport tests (deepspeed_tpu/serving/transport.py +
+node.py, docs/serving.md "Networked fleet"): the frame codec's
+corruption detection, the replica RPC end to end over a REAL loopback
+listener, idempotent-RPC retry and late-reply discard mirroring the
+pipe-based pins, reconnect-with-resume under injected resets, lease /
+failover semantics, the protocol-version handshake on both transports,
+and the graceful-EOF satellite for the subprocess backend.
+
+Everything here is jax-free: the node hosts worker.py's StubWorkerEngine
+(answers are a pure function of the prompt, so exactly-once is
+assertable bitwise) and listens on an ephemeral loopback port."""
+
+import time
+
+import pytest
+
+from deepspeed_tpu.inference.scheduler import RequestRejected
+from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+from deepspeed_tpu.serving import (
+    FleetRouter,
+    ReplicaProtocolError,
+    ReplicaRPCError,
+    SocketReplica,
+    SubprocessReplica,
+)
+from deepspeed_tpu.serving.node import NodeServer
+from deepspeed_tpu.serving.transport import (
+    FrameError,
+    corrupt_frame,
+    decode_frame,
+    encode_frame,
+)
+from deepspeed_tpu.telemetry.registry import suppressed_errors_snapshot
+
+
+def _expected_answer(prompt, max_new):
+    """StubWorkerEngine's deterministic answer (worker.py)."""
+    base = prompt[-1] if prompt else 0
+    return [(base + i + 1) % 1000 for i in range(max_new)]
+
+
+def _node(replicas=("r0",), *, delay=0.02, hang=False, config=None,
+          node_id="n0", lease_secs=5.0, resume_grace_secs=5.0):
+    spec = {
+        "node_id": node_id,
+        "replicas": {
+            name: {"stub": {"delay_secs": delay, "hang": hang}}
+            for name in replicas
+        },
+        "lease_secs": lease_secs,
+        "resume_grace_secs": resume_grace_secs,
+    }
+    if config is not None:
+        spec["config"] = config
+    return NodeServer(spec)
+
+
+def _replica(node, name="r0", *, rid=None, faults=None, rpc_timeout=2.0,
+             rpc_retries=1, reconnect_attempts=3, **kw):
+    host, port = node.address
+    return SocketReplica(
+        rid or f"{node.node_id}:{name}", (host, port), remote_name=name,
+        rpc_timeout=rpc_timeout, rpc_retries=rpc_retries,
+        rpc_backoff_secs=0.01, reconnect_backoff_secs=0.02,
+        reconnect_attempts=reconnect_attempts, fault_injector=faults, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# frame codec
+# ---------------------------------------------------------------------------
+def test_frame_codec_roundtrip_and_bare_json():
+    msg = {"op": "submit", "id": 3, "prompt": [1, 2], "kwargs": {}}
+    assert decode_frame(encode_frame(msg)) == msg
+    # the pipe protocol's bare newline-JSON frames stay valid
+    assert decode_frame(b'{"event": "ready"}\n') == {"event": "ready"}
+
+
+@pytest.mark.parametrize("line", [
+    b"",                                # empty
+    b"12 {\"a\": 1}",                   # declared 12, payload is 8 bytes
+    b"notjson at all",                  # neither form
+    b"999999999999 {}",                 # length past the ceiling
+    b"7 [1,2,3]",                       # JSON but not an object
+])
+def test_frame_codec_rejects_torn_and_garbled(line):
+    with pytest.raises(FrameError):
+        decode_frame(line)
+
+
+def test_corrupt_frame_mutation_is_undecodable_single_line():
+    data = corrupt_frame(encode_frame({"op": "snapshot", "id": 1}))
+    assert data.endswith(b"\n") and data.count(b"\n") == 1
+    with pytest.raises(FrameError):
+        decode_frame(data)
+
+
+# ---------------------------------------------------------------------------
+# end to end over a real loopback listener
+# ---------------------------------------------------------------------------
+def test_socket_replica_end_to_end_stub():
+    node = _node(("r0", "r1"))
+    node.start()
+    replica = _replica(node, "r0")
+    try:
+        replica.start()
+        assert replica.node_id == "n0"
+        snap = replica.load_snapshot()
+        assert snap["alive"] and not snap["failed"]
+        reqs = [replica.submit([10 + i], max_new_tokens=3)
+                for i in range(4)]
+        for i, req in enumerate(reqs):
+            assert req.result(30.0) == _expected_answer([10 + i], 3)
+            assert req.finish_reason == "max_new_tokens"
+            assert req.first_token_at is not None
+        replica.drain()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if replica.load_snapshot().get("stopped"):
+                break
+            time.sleep(0.01)
+        with pytest.raises(RequestRejected) as exc_info:
+            # the drained stub rejects at its door: the typed reason
+            # rides the REPLY (a healthy answer, not a transport error)
+            replica.submit([1], max_new_tokens=2)
+        assert not isinstance(exc_info.value, ReplicaRPCError)
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+def test_socket_submit_rejection_reason_crosses_the_wire():
+    node = _node()
+    node.start()
+    replica = _replica(node)
+    try:
+        replica.start()
+        replica.drain()
+        time.sleep(0.05)
+        with pytest.raises(RequestRejected) as exc_info:
+            replica.submit([5], max_new_tokens=2)
+        assert exc_info.value.reason == "draining"
+        assert not isinstance(exc_info.value, ReplicaRPCError)
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+def test_deadline_rides_the_frame_header():
+    """_frame_submit lifts deadline_secs out of the kwargs into dl_ms;
+    the node re-derives the engine deadline from the header — the wire
+    carries the budget, not an opaque kwarg."""
+    seen = {}
+
+    def recording_builder(spec):
+        from deepspeed_tpu.serving.worker import build_engine_from_spec
+
+        engine = build_engine_from_spec(spec)
+        orig = engine.submit
+
+        def submit(prompt, **kw):
+            seen.update(kw)
+            kw.pop("deadline_secs", None)  # the stub takes no deadline
+            return orig(prompt, **kw)
+
+        engine.submit = submit
+        return engine
+
+    node = NodeServer(
+        {"node_id": "n0", "replicas": {"r0": {"stub": {}}}},
+        engine_builder=recording_builder,
+    )
+    node.start()
+    replica = _replica(node)
+    try:
+        replica.start()
+        req = replica.submit([3], max_new_tokens=2, deadline_secs=30.0)
+        assert req.result(10.0) == _expected_answer([3], 2)
+        assert "deadline_secs" in seen
+        # the node saw the re-derived remaining budget, not the raw kwarg
+        assert 0 < seen["deadline_secs"] <= 30.0
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos sites over the real socket (the pipe pins' socket mirrors)
+# ---------------------------------------------------------------------------
+def test_idempotent_rpc_retry_absorbs_corrupt_frame():
+    """frame.corrupt garbles one snapshot op on the wire: the node
+    counts-and-drops it, the client's reply timeout fires, and the
+    idempotent retry re-asks — the caller never notices."""
+    # client _send traversals: hello is raw, so the first snapshot op is
+    # traversal 1
+    faults = FaultInjector(
+        [FaultSpec("frame.corrupt", times=1, seed=0)], seed=0
+    )
+    node = _node()
+    node.start()
+    replica = _replica(node, faults=faults, rpc_timeout=0.3, rpc_retries=2)
+    try:
+        replica.start()
+        snap = replica.load_snapshot()
+        assert snap["alive"] and not snap.get("unresponsive")
+        assert replica.rpc_retries_used >= 1
+        assert faults.injected["frame.corrupt"] == 1
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+def test_partitioned_frame_lost_not_duplicated():
+    """net.partition black-holes one submit frame (the connection looks
+    alive): the submit times out with a typed transport error — and the
+    op provably never reached the node, so a router falling through to
+    another replica cannot double-generate."""
+    faults = FaultInjector(
+        [FaultSpec("net.partition", times=1, seed=0)], seed=0
+    )
+    node = _node(delay=0.0)
+    node.start()
+    replica = _replica(node, faults=faults, rpc_timeout=0.3, rpc_retries=0)
+    try:
+        replica.start()
+        with pytest.raises(ReplicaRPCError):
+            replica.submit([5], max_new_tokens=2)  # the ack never comes
+        assert faults.injected["net.partition"] == 1
+        # nothing leaked: no reply waiters, no outstanding request, and
+        # the node never admitted anything (the frame died on the wire)
+        with replica._reply_cond:
+            assert replica._replies == {} and replica._expected == set()
+        assert replica._outstanding == {}
+        assert node.engines["r0"].load_snapshot()["active_slots"] == 0
+        # the transport is fine; the next submit sails through
+        req = replica.submit([7], max_new_tokens=2)
+        assert req.result(10.0) == _expected_answer([7], 2)
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+def test_late_reply_after_timeout_discarded_over_socket():
+    """The pipe-based late-reply pin against a real listener: a node-side
+    op stall (replica.hang) delays the snapshot ack past the client
+    timeout; the landing reply is dropped by the reader — it neither
+    leaks in _replies nor matches a later rpc_id."""
+    node = _node(config={"resilience": {"fault_injection": {
+        "enabled": True,
+        # node op traversals: the first snapshot op below is 1
+        "faults": [{"site": "replica.hang", "times": 1,
+                    "args": {"duration_ms": 700}}],
+    }}})
+    node.start()
+    replica = _replica(node, rpc_timeout=0.2, rpc_retries=0)
+    try:
+        replica.start()
+        snap = replica.load_snapshot()  # times out -> unresponsive verdict
+        assert snap.get("unresponsive") is True
+        assert snap["failed"] is False
+        time.sleep(1.0)  # the stalled ack lands (and is discarded)
+        with replica._reply_cond:
+            assert replica._replies == {}
+            assert replica._expected == set()
+        snap = replica.load_snapshot()
+        assert snap["alive"] and not snap.get("unresponsive")
+        req = replica.submit([3], max_new_tokens=2)
+        assert req.result(30.0) == _expected_answer([3], 2)
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+def test_reconnect_with_resume_completes_inflight_without_reroute():
+    """The tentpole's resume pin: a peer RST mid-generation reconnects
+    and re-attaches to the node's in-flight session — the request
+    completes on the ORIGINAL node (zero re-routes burned), the
+    reconnect is counted, and the replica never reads failed."""
+    # sends: (1) the post-start snapshot, (2) submit, (3) the snapshot
+    # that eats the injected RST while the stub still generates
+    faults = FaultInjector(
+        [FaultSpec("conn.reset", after=2, times=1, seed=0)], seed=0
+    )
+    node = _node(delay=0.6)
+    node.start()
+    replica = _replica(node, faults=faults)
+    try:
+        replica.start()
+        assert replica.load_snapshot()["alive"]
+        req = replica.submit([7], max_new_tokens=4)
+        replica.load_snapshot()  # hits the armed RST, drops the socket
+        assert faults.injected["conn.reset"] == 1
+        out = req.result(30.0)
+        assert out == _expected_answer([7], 4)
+        assert req.finish_reason == "max_new_tokens"
+        assert replica._net_reconnects.value >= 1
+        assert replica.failed is False and replica.alive
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+def test_reconnect_exhausted_fails_replica_and_inflight():
+    """A node that truly died: the reconnect budget exhausts, the
+    replica flips failed (eviction/breaker food — never before), and
+    every in-flight request fail-finishes for re-route."""
+    node = _node(hang=True)
+    node.start()
+    replica = _replica(node, reconnect_attempts=2)
+    replica.start()
+    try:
+        req = replica.submit([5], max_new_tokens=2)  # hangs on the node
+        assert not replica.failed
+        node.shutdown()
+        # poll for BOTH: the reader marks the replica failed before its
+        # EOF sweep finishes the orphans — observing one does not yet
+        # imply the other on a loaded box
+        deadline = time.monotonic() + 15.0
+        while (
+            not (replica.failed and req.done)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert replica.failed is True
+        assert replica.alive is False
+        assert req.done and req.finish_reason == "error"
+        snap = replica.load_snapshot()
+        assert snap["failed"] is True and snap["alive"] is False
+    finally:
+        replica.shutdown()
+
+
+def test_accept_drop_absorbed_by_connect_retry():
+    """accept.drop: the node accepts then slams the door once; the
+    client's connect retry absorbs it and start() succeeds."""
+    node = _node(config={"resilience": {"fault_injection": {
+        "enabled": True,
+        "faults": [{"site": "accept.drop", "times": 1}],
+    }}})
+    node.start()
+    replica = _replica(node)
+    try:
+        replica.start()
+        assert node._faults.injected["accept.drop"] == 1
+        req = replica.submit([9], max_new_tokens=2)
+        assert req.result(10.0) == _expected_answer([9], 2)
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+def test_session_reaped_past_resume_grace_requests_reroutable():
+    """A client gone past resume_grace_secs loses its node session: the
+    in-flight requests cancel (slots free), and the returning client's
+    welcome lists nothing — its reconcile fail-finishes the orphans for
+    re-route (exactly-once: the node cancelled them, so the answer is
+    re-derived exactly once elsewhere)."""
+    node = _node(delay=30.0, resume_grace_secs=0.3, lease_secs=0.2)
+    node.start()
+    replica = _replica(node)
+    try:
+        replica.start()
+        req = replica.submit([5], max_new_tokens=2)
+        assert not req.done
+        # kill the connection WITHOUT shutdown (an unplanned vanish) and
+        # block the reconnect path long enough for the grace to lapse
+        replica._hb_stop.set()
+        # well past the 0.3s grace: the reap must win even when a loaded
+        # CI box starves the reaper thread for a few hundred ms — a
+        # reconnect that lands first re-binds the OLD session and the
+        # orphan never fail-finishes
+        replica._reconnect_backoff = 1.5
+        replica._abort_connection("test: simulated client vanish")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with node._sessions_lock:
+                if not node._sessions:
+                    break
+            time.sleep(0.02)
+        with node._sessions_lock:
+            assert not node._sessions, "session outlived its grace"
+        # the engine slot frees at the next step boundary after the
+        # reap's cancel — poll for it: on a loaded box the stub driver
+        # can lag behind the reaper by more than one scheduler pass
+        slot_deadline = time.monotonic() + 10.0
+        while (
+            node.engines["r0"].load_snapshot()["active_slots"] != 0
+            and time.monotonic() < slot_deadline
+        ):
+            time.sleep(0.02)
+        assert node.engines["r0"].load_snapshot()["active_slots"] == 0
+        # the client reconnects into a FRESH session; the welcome's
+        # empty inflight list fail-finishes the orphan for re-route
+        assert req.result(15.0) is not None or True
+        assert req.finish_reason == "error"
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+def test_socket_fleet_router_integration_exactly_once():
+    """Two single-replica nodes behind a FleetRouter: a black-holed
+    submit on one replica feeds its breaker and falls through to the
+    other node — every request answered exactly once, bitwise."""
+    node_a, node_b = _node(node_id="na"), _node(node_id="nb")
+    node_a.start()
+    node_b.start()
+    # sends on replica A: start-refresh snapshot (1), candidates
+    # snapshot (2), first submit (3)
+    faults = FaultInjector(
+        [FaultSpec("net.partition", after=2, times=1, seed=0)], seed=0
+    )
+    ra = _replica(node_a, rid="na:r0", faults=faults, rpc_timeout=0.5)
+    rb = _replica(node_b, rid="nb:r0", rpc_timeout=0.5)
+    router = FleetRouter(
+        [ra, rb], monitor_interval=0.005, telemetry_refresh_secs=3600.0,
+        breaker_failure_threshold=1, breaker_backoff_secs=0.25,
+    ).start()
+    try:
+        reqs = [router.submit([20 + i], max_new_tokens=3)
+                for i in range(4)]
+        for i, req in enumerate(reqs):
+            assert req.result(60.0) == _expected_answer([20 + i], 3)
+            assert req.finish_reason == "max_new_tokens"
+        assert faults.injected["net.partition"] == 1
+        snap = router.metrics.snapshot()
+        assert snap["fleet/breaker_opens"] >= 1
+        assert snap["fleet/requests_rerouted"] == 0
+        assert snap["fleet/requests_completed"] == 4
+    finally:
+        router.shutdown()
+        node_a.shutdown()
+        node_b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# protocol-version handshake (both transports)
+# ---------------------------------------------------------------------------
+def test_socket_protocol_mismatch_fail_fasts_with_both_versions(
+        monkeypatch):
+    import deepspeed_tpu.serving.node as node_mod
+
+    monkeypatch.setattr(node_mod, "RPC_PROTOCOL_VERSION", 99)
+    node = _node()
+    node.start()
+    replica = _replica(node)
+    try:
+        with pytest.raises(ReplicaProtocolError) as exc_info:
+            replica.start()
+        msg = str(exc_info.value)
+        assert "v1" in msg and "v99" in msg
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+def test_subprocess_protocol_mismatch_fail_fasts_typed(monkeypatch):
+    """Satellite pin: a version-skewed WORKER fail-fasts at start() with
+    a typed error naming both versions — never one undecodable line at a
+    time until the breaker opens. (The parent's version is patched; the
+    real worker subprocess answers the genuine v1.)"""
+    import deepspeed_tpu.serving.replica as replica_mod
+
+    monkeypatch.setattr(replica_mod, "RPC_PROTOCOL_VERSION", 2)
+    replica = SubprocessReplica(
+        "skewed", {"stub": {}}, start_timeout=90.0, rpc_timeout=2.0,
+    )
+    with pytest.raises(ReplicaProtocolError) as exc_info:
+        replica.start()
+    msg = str(exc_info.value)
+    assert "v2" in msg and "v1" in msg
+    assert replica.alive is False
+
+
+# ---------------------------------------------------------------------------
+# graceful-EOF satellite (subprocess backend)
+# ---------------------------------------------------------------------------
+def test_requested_shutdown_reads_graceful_not_breaker_food():
+    """Satellite pin: a REQUESTED shutdown's pipe EOF finishes orphans
+    "cancelled" quietly — it neither logs a died-in-flight warning nor
+    feeds the transport-death diagnostics that breaker streaks ride."""
+    replica = SubprocessReplica(
+        "clean", {"stub": {"hang": True}}, start_timeout=90.0,
+        rpc_timeout=2.0,
+    )
+    replica.start()
+    req = replica.submit([5], max_new_tokens=2)  # never finishes
+    before = suppressed_errors_snapshot().get(
+        "internal/suppressed_errors/serving.transport_died_inflight", 0
+    )
+    replica.shutdown()
+    assert req.done and req.finish_reason == "cancelled"
+    after = suppressed_errors_snapshot().get(
+        "internal/suppressed_errors/serving.transport_died_inflight", 0
+    )
+    assert after == before, "clean shutdown counted as a transport death"
+    # and the replica reads shut-down, not failed
+    assert replica.failed is False
+    snap = replica.load_snapshot()
+    assert snap["alive"] is False and snap["failed"] is False
+
+
+def test_unrequested_worker_death_still_counts_and_fails():
+    """The inverse guard: a worker killed WITHOUT being asked keeps the
+    loud path — orphans fail-finish "error" and the death is counted."""
+    replica = SubprocessReplica(
+        "killed", {"stub": {"hang": True}}, start_timeout=90.0,
+        rpc_timeout=2.0,
+    )
+    replica.start()
+    req = replica.submit([5], max_new_tokens=2)
+    before = suppressed_errors_snapshot().get(
+        "internal/suppressed_errors/serving.transport_died_inflight", 0
+    )
+    replica._proc.kill()
+    # generous: a loaded CI box can take a while to deliver the EOF
+    deadline = time.monotonic() + 30.0
+    while not req.done and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert req.done and req.finish_reason == "error"
+    after = suppressed_errors_snapshot().get(
+        "internal/suppressed_errors/serving.transport_died_inflight", 0
+    )
+    assert after == before + 1
+    assert replica.failed is True
+    replica.shutdown()
