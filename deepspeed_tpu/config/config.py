@@ -700,6 +700,71 @@ class DeepSpeedConfig:
             http_dict, C.SERVING_HTTP_OVERRUN_POLICY,
             C.SERVING_HTTP_OVERRUN_POLICY_DEFAULT,
         )
+        # the bearer secret is held on an underscored attribute so
+        # config.print's attribute walk (which skips "_" names) can
+        # never log it; readers go through the property below
+        self._serving_http_auth_token = get_scalar_param(
+            http_dict, C.SERVING_HTTP_AUTH_TOKEN,
+            C.SERVING_HTTP_AUTH_TOKEN_DEFAULT,
+        )
+        slo_dict = get_dict_param(srv_dict, C.SERVING_SLO)
+        self.serving_slo_ttft_p99_ms = get_scalar_param(
+            slo_dict, C.SERVING_SLO_TTFT_P99_MS,
+            C.SERVING_SLO_TTFT_P99_MS_DEFAULT,
+        )
+        self.serving_slo_token_p99_ms = get_scalar_param(
+            slo_dict, C.SERVING_SLO_TOKEN_P99_MS,
+            C.SERVING_SLO_TOKEN_P99_MS_DEFAULT,
+        )
+        self.serving_slo_eval_window_secs = get_scalar_param(
+            slo_dict, C.SERVING_SLO_EVAL_WINDOW_SECS,
+            C.SERVING_SLO_EVAL_WINDOW_SECS_DEFAULT,
+        )
+        asc_dict = get_dict_param(srv_dict, C.SERVING_AUTOSCALE)
+        self.serving_autoscale_enabled = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_ENABLED,
+            C.SERVING_AUTOSCALE_ENABLED_DEFAULT,
+        )
+        self.serving_autoscale_min_replicas = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_MIN_REPLICAS,
+            C.SERVING_AUTOSCALE_MIN_REPLICAS_DEFAULT,
+        )
+        self.serving_autoscale_max_replicas = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_MAX_REPLICAS,
+            C.SERVING_AUTOSCALE_MAX_REPLICAS_DEFAULT,
+        )
+        self.serving_autoscale_cooldown_secs = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_COOLDOWN_SECS,
+            C.SERVING_AUTOSCALE_COOLDOWN_SECS_DEFAULT,
+        )
+        self.serving_autoscale_hysteresis_secs = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_HYSTERESIS_SECS,
+            C.SERVING_AUTOSCALE_HYSTERESIS_SECS_DEFAULT,
+        )
+        self.serving_autoscale_flap_budget = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_FLAP_BUDGET,
+            C.SERVING_AUTOSCALE_FLAP_BUDGET_DEFAULT,
+        )
+        self.serving_autoscale_flap_window_secs = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_FLAP_WINDOW_SECS,
+            C.SERVING_AUTOSCALE_FLAP_WINDOW_SECS_DEFAULT,
+        )
+        self.serving_autoscale_up_utilization = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_UP_UTILIZATION,
+            C.SERVING_AUTOSCALE_UP_UTILIZATION_DEFAULT,
+        )
+        self.serving_autoscale_down_utilization = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_DOWN_UTILIZATION,
+            C.SERVING_AUTOSCALE_DOWN_UTILIZATION_DEFAULT,
+        )
+        self.serving_autoscale_interval_secs = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_INTERVAL_SECS,
+            C.SERVING_AUTOSCALE_INTERVAL_SECS_DEFAULT,
+        )
+        self.serving_autoscale_drain_timeout_secs = get_scalar_param(
+            asc_dict, C.SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS,
+            C.SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS_DEFAULT,
+        )
 
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
@@ -1867,6 +1932,7 @@ class DeepSpeedConfig:
         valid_http = {
             C.SERVING_HTTP_HOST, C.SERVING_HTTP_PORT,
             C.SERVING_HTTP_MAX_BUFFER_BYTES, C.SERVING_HTTP_OVERRUN_POLICY,
+            C.SERVING_HTTP_AUTH_TOKEN,
         }
         unknown = set(http_dict) - valid_http
         if unknown:
@@ -1902,6 +1968,157 @@ class DeepSpeedConfig:
                 f"{C.SERVING_HTTP_VALID_OVERRUN_POLICIES}, got "
                 f"{self.serving_http_overrun_policy!r}"
             )
+        token = self._serving_http_auth_token
+        if token is not None and (
+            not isinstance(token, str) or not token
+        ):
+            # the VALUE is deliberately absent from this message — a
+            # config error must not leak the secret into logs either
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.SERVING_HTTP_AUTH_TOKEN} must be a non-empty "
+                f"string or null (null = open door)"
+            )
+        sl = f"{C.SERVING}.{C.SERVING_SLO}"
+        slo_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING), C.SERVING_SLO
+        )
+        valid_slo = {
+            C.SERVING_SLO_TTFT_P99_MS, C.SERVING_SLO_TOKEN_P99_MS,
+            C.SERVING_SLO_EVAL_WINDOW_SECS,
+        }
+        unknown = set(slo_dict) - valid_slo
+        if unknown:
+            # a typo'd ttft_p99_ms would silently mean "no TTFT SLO"
+            raise DeepSpeedConfigError(
+                f"{sl}: unknown keys {sorted(unknown)}; valid: "
+                f"{sorted(valid_slo)}"
+            )
+        for key, value in (
+            (C.SERVING_SLO_TTFT_P99_MS, self.serving_slo_ttft_p99_ms),
+            (C.SERVING_SLO_TOKEN_P99_MS, self.serving_slo_token_p99_ms),
+        ):
+            if value is not None and (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or value <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{sl}.{key} must be a number > 0 or null (null = "
+                    f"no target on that axis), got {value!r}"
+                )
+        window = self.serving_slo_eval_window_secs
+        if (
+            not isinstance(window, (int, float))
+            or isinstance(window, bool)
+            or window <= 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{sl}.{C.SERVING_SLO_EVAL_WINDOW_SECS} must be a number "
+                f"> 0, got {window!r}"
+            )
+        asc = f"{C.SERVING}.{C.SERVING_AUTOSCALE}"
+        asc_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING), C.SERVING_AUTOSCALE
+        )
+        valid_asc = {
+            C.SERVING_AUTOSCALE_ENABLED, C.SERVING_AUTOSCALE_MIN_REPLICAS,
+            C.SERVING_AUTOSCALE_MAX_REPLICAS,
+            C.SERVING_AUTOSCALE_COOLDOWN_SECS,
+            C.SERVING_AUTOSCALE_HYSTERESIS_SECS,
+            C.SERVING_AUTOSCALE_FLAP_BUDGET,
+            C.SERVING_AUTOSCALE_FLAP_WINDOW_SECS,
+            C.SERVING_AUTOSCALE_UP_UTILIZATION,
+            C.SERVING_AUTOSCALE_DOWN_UTILIZATION,
+            C.SERVING_AUTOSCALE_INTERVAL_SECS,
+            C.SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS,
+        }
+        unknown = set(asc_dict) - valid_asc
+        if unknown:
+            # a typo'd max_replicas must not silently mean its default
+            raise DeepSpeedConfigError(
+                f"{asc}: unknown keys {sorted(unknown)}; valid: "
+                f"{sorted(valid_asc)}"
+            )
+        if not isinstance(self.serving_autoscale_enabled, bool):
+            raise DeepSpeedConfigError(
+                f"{asc}.{C.SERVING_AUTOSCALE_ENABLED} must be a boolean, "
+                f"got {self.serving_autoscale_enabled!r}"
+            )
+        mn = self.serving_autoscale_min_replicas
+        mx = self.serving_autoscale_max_replicas
+        for key, value in (
+            (C.SERVING_AUTOSCALE_MIN_REPLICAS, mn),
+            (C.SERVING_AUTOSCALE_MAX_REPLICAS, mx),
+        ):
+            if not isinstance(value, int) or isinstance(value, bool) or (
+                value < 1
+            ):
+                raise DeepSpeedConfigError(
+                    f"{asc}.{key} must be an integer >= 1, got {value!r}"
+                )
+        if mx < mn:
+            raise DeepSpeedConfigError(
+                f"{asc}.{C.SERVING_AUTOSCALE_MAX_REPLICAS} ({mx!r}) must "
+                f"be >= {C.SERVING_AUTOSCALE_MIN_REPLICAS} ({mn!r})"
+            )
+        for key, value in (
+            (C.SERVING_AUTOSCALE_COOLDOWN_SECS,
+             self.serving_autoscale_cooldown_secs),
+            (C.SERVING_AUTOSCALE_FLAP_WINDOW_SECS,
+             self.serving_autoscale_flap_window_secs),
+            (C.SERVING_AUTOSCALE_INTERVAL_SECS,
+             self.serving_autoscale_interval_secs),
+            (C.SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS,
+             self.serving_autoscale_drain_timeout_secs),
+        ):
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or value <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{asc}.{key} must be a number > 0, got {value!r}"
+                )
+        hyst = self.serving_autoscale_hysteresis_secs
+        if (
+            not isinstance(hyst, (int, float))
+            or isinstance(hyst, bool)
+            or hyst < 0
+        ):
+            raise DeepSpeedConfigError(
+                f"{asc}.{C.SERVING_AUTOSCALE_HYSTERESIS_SECS} must be a "
+                f"number >= 0, got {hyst!r}"
+            )
+        flap = self.serving_autoscale_flap_budget
+        if not isinstance(flap, int) or isinstance(flap, bool) or flap < 0:
+            raise DeepSpeedConfigError(
+                f"{asc}.{C.SERVING_AUTOSCALE_FLAP_BUDGET} must be an "
+                f"integer >= 0 (0 = no direction reversals allowed "
+                f"inside the window), got {flap!r}"
+            )
+        up = self.serving_autoscale_up_utilization
+        down = self.serving_autoscale_down_utilization
+        for key, value in (
+            (C.SERVING_AUTOSCALE_UP_UTILIZATION, up),
+            (C.SERVING_AUTOSCALE_DOWN_UTILIZATION, down),
+        ):
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or not 0 < value <= 1
+            ):
+                raise DeepSpeedConfigError(
+                    f"{asc}.{key} must be a number in (0, 1], got "
+                    f"{value!r}"
+                )
+        if down >= up:
+            # an inverted pair would oscillate on every tick: scale-down
+            # headroom would begin inside the scale-up region
+            raise DeepSpeedConfigError(
+                f"{asc}.{C.SERVING_AUTOSCALE_DOWN_UTILIZATION} ({down!r}) "
+                f"must be below {C.SERVING_AUTOSCALE_UP_UTILIZATION} "
+                f"({up!r}) — the bands must not overlap"
+            )
 
     def _do_warning_check(self):
         if self.zero_enabled and not (self.fp16_enabled or self.bf16_enabled):
@@ -1927,6 +2144,14 @@ class DeepSpeedConfig:
             logger.warning(
                 "max_grad_norm is deprecated; use gradient_clipping instead"
             )
+
+    # ------------------------------------------------------------------
+    @property
+    def serving_http_auth_token(self):
+        """The door's bearer secret (``serving.http.auth_token``) —
+        stored on an underscored attribute so :meth:`print`'s attribute
+        walk (which skips ``_`` names) can never log it."""
+        return self._serving_http_auth_token
 
     # ------------------------------------------------------------------
     def print(self, name="DeepSpeedConfig"):

@@ -785,6 +785,53 @@ SERVING_HTTP_MAX_BUFFER_BYTES_DEFAULT = 65536
 SERVING_HTTP_OVERRUN_POLICY = "overrun_policy"
 SERVING_HTTP_OVERRUN_POLICY_DEFAULT = "drop"
 SERVING_HTTP_VALID_OVERRUN_POLICIES = ("drop", "block")
+# bearer secret for the door (docs/serving.md): every route except the
+# /healthz and /readyz probes demands `Authorization: Bearer <token>`;
+# None = open door. The resolved value is NEVER logged (config.print
+# redacts it).
+SERVING_HTTP_AUTH_TOKEN = "auth_token"
+SERVING_HTTP_AUTH_TOKEN_DEFAULT = None
+
+# "slo": the latency targets the fleet promises (docs/serving.md "SLO
+# autoscaling") — p99 TTFT and per-token-latency ceilings in ms (None =
+# no target on that axis) plus the sliding window the error budget
+# (fleet/slo_error_budget_remaining) evaluates over.
+SERVING_SLO = "slo"
+SERVING_SLO_TTFT_P99_MS = "ttft_p99_ms"
+SERVING_SLO_TTFT_P99_MS_DEFAULT = None
+SERVING_SLO_TOKEN_P99_MS = "token_p99_ms"
+SERVING_SLO_TOKEN_P99_MS_DEFAULT = None
+SERVING_SLO_EVAL_WINDOW_SECS = "eval_window_secs"
+SERVING_SLO_EVAL_WINDOW_SECS_DEFAULT = 60.0
+
+# "autoscale": elastic replica capacity driven by the predictive cost
+# model (serving/autoscaler.py) — scale up BEFORE the brownout cliff,
+# drain-then-retire on sustained headroom, re-provision capacity chaos
+# takes away; clamped by min/max replicas, a scale cooldown, and a
+# direction-reversal flap budget. Disabled = zero-overhead passthrough.
+SERVING_AUTOSCALE = "autoscale"
+SERVING_AUTOSCALE_ENABLED = "enabled"
+SERVING_AUTOSCALE_ENABLED_DEFAULT = False
+SERVING_AUTOSCALE_MIN_REPLICAS = "min_replicas"
+SERVING_AUTOSCALE_MIN_REPLICAS_DEFAULT = 1
+SERVING_AUTOSCALE_MAX_REPLICAS = "max_replicas"
+SERVING_AUTOSCALE_MAX_REPLICAS_DEFAULT = 4
+SERVING_AUTOSCALE_COOLDOWN_SECS = "cooldown_secs"
+SERVING_AUTOSCALE_COOLDOWN_SECS_DEFAULT = 30.0
+SERVING_AUTOSCALE_HYSTERESIS_SECS = "hysteresis_secs"
+SERVING_AUTOSCALE_HYSTERESIS_SECS_DEFAULT = 60.0
+SERVING_AUTOSCALE_FLAP_BUDGET = "flap_budget"
+SERVING_AUTOSCALE_FLAP_BUDGET_DEFAULT = 4
+SERVING_AUTOSCALE_FLAP_WINDOW_SECS = "flap_window_secs"
+SERVING_AUTOSCALE_FLAP_WINDOW_SECS_DEFAULT = 600.0
+SERVING_AUTOSCALE_UP_UTILIZATION = "scale_up_utilization"
+SERVING_AUTOSCALE_UP_UTILIZATION_DEFAULT = 0.85
+SERVING_AUTOSCALE_DOWN_UTILIZATION = "scale_down_utilization"
+SERVING_AUTOSCALE_DOWN_UTILIZATION_DEFAULT = 0.30
+SERVING_AUTOSCALE_INTERVAL_SECS = "interval_secs"
+SERVING_AUTOSCALE_INTERVAL_SECS_DEFAULT = 1.0
+SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS = "drain_timeout_secs"
+SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS_DEFAULT = 30.0
 
 #############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,

@@ -42,7 +42,7 @@ import threading
 import time
 import uuid
 
-from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS
+from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS, histogram_quantile
 from ..telemetry.tracing import NOOP_TRACER, TraceContext
 from ..utils.logging import logger
 from .paging import PoolExhausted
@@ -369,6 +369,18 @@ class ContinuousBatchingScheduler:
             ),
             "mean_decode_ms": (
                 self._token_latency_ms.sum / decode_n if decode_n else 0.0
+            ),
+            # per-phase tails for the fleet autoscaler's cost model
+            # (serving/autoscaler.py): the PR-9 span breakdown's
+            # histogram view, interpolated host-side so prediction needs
+            # no extra RPC
+            "p99_prefill_ms": (
+                histogram_quantile(self._prefill_ms, 0.99)
+                if self._prefill_ms.count else 0.0
+            ),
+            "mean_queue_wait_ms": (
+                self._queue_wait_ms.sum / self._queue_wait_ms.count
+                if self._queue_wait_ms.count else 0.0
             ),
             "requests_shed": self._shed.value,
             "restarts_used": self.restarts_used,

@@ -23,7 +23,11 @@ scheduler in deepspeed_tpu/inference/. Four layers:
   router.py    — FleetRouter: pluggable placement (least-loaded /
                  round-robin / prefix-affinity), rolling restarts under
                  a capacity floor, failed-replica eviction + re-route,
-                 fleet/* telemetry.
+                 elastic add/remove replica, fleet/* telemetry.
+  autoscaler.py— the SLO-driven predictive autoscaler: a per-phase cost
+                 model predicts SLO-unmeetable load and changes replica
+                 capacity BEFORE the brownout/shed cliff (scale-up,
+                 drain-then-retire scale-down, chaos re-provisioning).
 
 ``init_fleet`` is the config-driven front door, the fleet analog of
 ``deepspeed_tpu.init_inference``.
@@ -42,6 +46,19 @@ from .breaker import (
     BREAKER_HALF_OPEN,
     BREAKER_OPEN,
     CircuitBreaker,
+)
+from .autoscaler import (
+    AUTOSCALE_DOWN,
+    AUTOSCALE_HOLD,
+    AUTOSCALE_REPROVISION,
+    AUTOSCALE_UP,
+    Autoscaler,
+    AutoscalerPolicy,
+    InProcessReplicaProvider,
+    PhaseCostModel,
+    SLOTargets,
+    SocketNodeProvider,
+    SubprocessReplicaProvider,
 )
 from .http import HTTPDoor, serve_http
 from .replica import (
@@ -181,6 +198,62 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
 
     faults = build_fault_injector(cfg, registry=registry)
 
+    # SLO autoscaler (autoscaler.py, docs/serving.md "SLO autoscaling"):
+    # built only when the block arms it — the disabled path constructs
+    # NOTHING (no threads, no cost model, no per-tick work)
+    autoscaler = None
+    if cfg.serving_autoscale_enabled:
+        if engine_factory is not None:
+            provider = InProcessReplicaProvider(
+                engine_factory,
+                tracer=tracer if tracer.enabled else None,
+                fault_injector=faults,
+            )
+        elif worker_spec is not None:
+            provider = SubprocessReplicaProvider(
+                worker_spec,
+                rpc_timeout=cfg.serving_rpc_timeout_secs,
+                rpc_retries=cfg.serving_rpc_retries,
+                rpc_backoff_secs=cfg.serving_rpc_backoff_secs,
+                fault_injector=faults,
+            )
+        else:
+            provider = SocketNodeProvider(
+                nodes,
+                rpc_timeout=cfg.serving_rpc_timeout_secs,
+                rpc_retries=cfg.serving_rpc_retries,
+                rpc_backoff_secs=cfg.serving_rpc_backoff_secs,
+                connect_timeout=cfg.serving_socket_connect_timeout_secs,
+                connect_retries=cfg.serving_socket_connect_retries,
+                lease_secs=cfg.serving_socket_lease_secs,
+                reconnect_attempts=cfg.serving_socket_reconnect_attempts,
+                reconnect_backoff_secs=(
+                    cfg.serving_socket_reconnect_backoff_secs
+                ),
+                registry=registry,
+                fault_injector=faults,
+            )
+        autoscaler = Autoscaler(
+            provider,
+            slo=SLOTargets(
+                ttft_p99_ms=cfg.serving_slo_ttft_p99_ms,
+                token_p99_ms=cfg.serving_slo_token_p99_ms,
+                eval_window_secs=cfg.serving_slo_eval_window_secs,
+            ),
+            min_replicas=cfg.serving_autoscale_min_replicas,
+            max_replicas=cfg.serving_autoscale_max_replicas,
+            cooldown_secs=cfg.serving_autoscale_cooldown_secs,
+            hysteresis_secs=cfg.serving_autoscale_hysteresis_secs,
+            flap_budget=cfg.serving_autoscale_flap_budget,
+            flap_window_secs=cfg.serving_autoscale_flap_window_secs,
+            scale_up_utilization=cfg.serving_autoscale_up_utilization,
+            scale_down_utilization=(
+                cfg.serving_autoscale_down_utilization
+            ),
+            interval_secs=cfg.serving_autoscale_interval_secs,
+            drain_timeout_secs=cfg.serving_autoscale_drain_timeout_secs,
+        )
+
     if engine_factory is not None:
         replicas = [
             InProcessReplica(
@@ -253,6 +326,7 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
         brownout_queue_ratio=cfg.serving_brownout_queue_ratio,
         brownout_max_new_tokens=cfg.serving_brownout_max_new_tokens,
         fault_injector=faults,
+        autoscaler=autoscaler,
     )
     if start:
         router.start()
@@ -264,8 +338,14 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
 
 
 __all__ = [
+    "AUTOSCALE_DOWN",
+    "AUTOSCALE_HOLD",
+    "AUTOSCALE_REPROVISION",
+    "AUTOSCALE_UP",
     "AdapterAffinity",
     "AdmissionController",
+    "Autoscaler",
+    "AutoscalerPolicy",
     "BREAKER_CLOSED",
     "BREAKER_HALF_OPEN",
     "BREAKER_OPEN",
@@ -275,8 +355,10 @@ __all__ = [
     "FleetRouter",
     "HTTPDoor",
     "InProcessReplica",
+    "InProcessReplicaProvider",
     "LeastLoaded",
     "PLACEMENT_POLICIES",
+    "PhaseCostModel",
     "PrefixAffinity",
     "RPC_PROTOCOL_VERSION",
     "RateLimited",
@@ -284,8 +366,11 @@ __all__ = [
     "ReplicaProtocolError",
     "ReplicaRPCError",
     "RoundRobin",
+    "SLOTargets",
+    "SocketNodeProvider",
     "SocketReplica",
     "SubprocessReplica",
+    "SubprocessReplicaProvider",
     "TokenBucket",
     "init_fleet",
     "serve_http",

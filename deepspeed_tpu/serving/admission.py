@@ -28,10 +28,18 @@ from ..inference.scheduler import (
 
 
 class RateLimited(RequestRejected):
-    """A tenant's token bucket is empty (reason ``"rate_limit"``)."""
+    """A tenant's token bucket is empty (reason ``"rate_limit"``).
 
-    def __init__(self, message):
+    ``retry_after_secs`` carries the bucket's ACTUAL refill time — how
+    long until one token exists again — so the HTTP door's 429 can send
+    a ``Retry-After`` the client can trust instead of a constant
+    (docs/serving.md). ``None`` when the rejecting layer cannot know."""
+
+    def __init__(self, message, retry_after_secs=None):
         super().__init__(message, reason=REJECT_RATE_LIMIT)
+        self.retry_after_secs = (
+            None if retry_after_secs is None else float(retry_after_secs)
+        )
 
 
 class FleetOverloaded(RequestRejected):
@@ -75,6 +83,21 @@ class TokenBucket:
                 return True
             return False
 
+    def retry_after(self, n=1):
+        """Seconds until ``n`` tokens will have refilled (0.0 when they
+        are already available, and for unlimited buckets) — the door's
+        429 ``Retry-After`` source. Read-only: no tokens are taken."""
+        if self.rate is None:
+            return 0.0
+        with self._lock:
+            now = self._clock()
+            tokens = min(
+                self.burst, self._tokens + (now - self._stamp) * self.rate
+            )
+            if tokens >= n:
+                return 0.0
+            return (n - tokens) / self.rate
+
 
 class AdmissionController:
     """Per-tenant rate limiting for the fleet front door.
@@ -115,5 +138,6 @@ class AdmissionController:
         if not bucket.try_acquire():
             raise RateLimited(
                 f"tenant {tenant!r} over its rate limit "
-                f"({bucket.rate}/s, burst {bucket.burst})"
+                f"({bucket.rate}/s, burst {bucket.burst})",
+                retry_after_secs=bucket.retry_after(),
             )

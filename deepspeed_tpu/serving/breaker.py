@@ -102,6 +102,22 @@ class CircuitBreaker:
                 return True
             return False
 
+    def begin_probation(self):
+        """Arm the half-open-probe gate for a replica that has never
+        served — the autoscaler's freshly spawned capacity
+        (docs/serving.md "SLO autoscaling"). State goes OPEN with an
+        already-elapsed window, so the replica is a placement candidate
+        whose FIRST submission is the window's single half-open probe:
+        success closes the breaker and full traffic flows; failure
+        re-opens with the base backoff. A half-built replica can cost
+        the fleet at most one request. Not counted as a trip (``opens``
+        stays put — probation is a birth certificate, not a failure)."""
+        with self._lock:
+            self.state = BREAKER_OPEN
+            self.consecutive_failures = 0
+            self._streak_opens = 0
+            self._probe_at = self._clock()
+
     # -- outcome feedback -----------------------------------------------
     def record_success(self):
         """A request (or probe) got a real answer from the replica —

@@ -14,12 +14,28 @@ fleet"). The contract the door enforces:
     become HTTP statuses — clients branch on the status, never on prose:
 
         reason        status
-        rate_limit    429  (Retry-After: 1)
+        rate_limit    429  (Retry-After: the token bucket's ACTUAL
+                            refill time, ceiled to whole seconds)
         overload      503  (Retry-After: 1)
         draining      503
         capacity      503
         deadline      504
         ValueError    400  (malformed request — never retried)
+        bad token     401  (``serving.http.auth_token`` mismatch;
+                            WWW-Authenticate: Bearer)
+
+  * **Auth is a bearer token, probes are exempt.** When
+    ``serving.http.auth_token`` is set, every route except the probe
+    endpoints demands ``Authorization: Bearer <token>`` — compared in
+    constant time, answered 401 on mismatch, and NEVER logged (neither
+    the configured token nor what the client sent). ``/healthz`` and
+    ``/readyz`` stay open: external load balancers carry no tenant
+    credentials.
+  * **Readiness is not liveness.** ``GET /healthz`` answers 200 while
+    the process serves at all; ``GET /readyz`` answers 503 the moment
+    the fleet is draining, browned out, without a routable replica, or
+    uniformly degraded — so an external load balancer stops routing
+    BEFORE requests shed (``FleetRouter.readiness``).
 
   * **An abandoned stream frees its slot.** A client disconnect cancels
     the fleet request (``FleetRouter.cancel``): the replica scheduler
@@ -46,6 +62,7 @@ API::
                                               "usage": {...}}
       stream=false -> one application/json body at completion
     GET /healthz             fleet liveness + routable-capacity summary
+    GET /readyz              readiness: 200 taking traffic, 503 not
 
 Deadlines propagate end to end: ``deadline_secs`` rides the router
 submit (charging re-routes), the socket transport's frame header
@@ -56,7 +73,9 @@ registry and export through the same sinks (docs/observability.md).
 """
 
 import asyncio
+import hmac
 import json
+import math
 import threading
 import time
 
@@ -119,13 +138,16 @@ class HTTPDoor:
 
     def __init__(self, router, host="127.0.0.1", port=0, *,
                  max_buffer_bytes=65536, overrun_policy="drop",
-                 poll_interval=0.002, registry=None):
+                 poll_interval=0.002, registry=None, auth_token=None):
         if overrun_policy not in OVERRUN_POLICIES:
             raise ValueError(
                 f"unknown overrun_policy {overrun_policy!r}; valid: "
                 f"{OVERRUN_POLICIES}"
             )
         self.router = router
+        # bearer secret (serving.http.auth_token): held privately, never
+        # logged, never echoed into any response or repr
+        self._auth_token = str(auth_token) if auth_token else None
         self._host = str(host)
         self._port = int(port)
         self.max_buffer_bytes = int(max_buffer_bytes)
@@ -248,11 +270,27 @@ class HTTPDoor:
                 return
             method, target, headers, body = request
             self._m_requests.inc()
+            if not self._authorized(target, headers):
+                await self._respond_json(
+                    writer, 401,
+                    {"error": "missing or invalid bearer token"},
+                    extra_headers=("WWW-Authenticate: Bearer",),
+                )
+                return
             if method == "GET" and target == "/healthz":
                 await self._respond_json(writer, 200, self._health())
+            elif method == "GET" and target == "/readyz":
+                # readiness costs per-replica snapshot RPCs: keep the
+                # event loop (and every open stream) out of them
+                ready, reasons = await asyncio.get_event_loop(
+                ).run_in_executor(None, self.router.readiness)
+                await self._respond_json(
+                    writer, 200 if ready else 503,
+                    {"ready": bool(ready), "reasons": list(reasons)},
+                )
             elif method == "POST" and target == "/v1/generate":
                 await self._generate(reader, writer, headers, body)
-            elif target in ("/healthz", "/v1/generate"):
+            elif target in ("/healthz", "/readyz", "/v1/generate"):
                 await self._respond_json(
                     writer, 405, {"error": f"{method} not allowed here"}
                 )
@@ -311,8 +349,22 @@ class HTTPDoor:
         body = await reader.readexactly(length) if length else b""
         return method.upper(), target, headers, body
 
+    def _authorized(self, target, headers):
+        """Bearer-token gate (``serving.http.auth_token``): the probe
+        endpoints stay exempt — external load balancers carry no tenant
+        credentials. Constant-time comparison; neither the configured
+        token nor the client's attempt is ever logged."""
+        if self._auth_token is None:
+            return True
+        if target in ("/healthz", "/readyz"):
+            return True
+        scheme, _, value = headers.get("authorization", "").partition(" ")
+        if scheme.strip().lower() != "bearer":
+            return False
+        return hmac.compare_digest(value.strip(), self._auth_token)
+
     async def _respond_json(self, writer, status, payload,
-                            extra_headers=()):
+                            extra_headers=(), retry_after_secs=None):
         body = json.dumps(payload).encode("utf-8")
         phrase = _REASONS_PHRASE.get(status, "")
         head = [
@@ -322,7 +374,13 @@ class HTTPDoor:
             "Connection: close",
         ]
         if status in _RETRYABLE:
-            head.append("Retry-After: 1")
+            # the real backoff when the rejecting layer knows it (the
+            # token bucket's refill time), the safe constant otherwise;
+            # whole seconds — the header's only portable unit
+            secs = 1
+            if retry_after_secs is not None:
+                secs = max(int(math.ceil(float(retry_after_secs))), 1)
+            head.append(f"Retry-After: {secs}")
         head.extend(extra_headers)
         writer.write(
             ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
@@ -398,7 +456,8 @@ class HTTPDoor:
         except RequestRejected as e:
             status = STATUS_BY_REASON.get(e.reason, 503)
             await self._respond_json(
-                writer, status, {"error": str(e), "reason": e.reason}
+                writer, status, {"error": str(e), "reason": e.reason},
+                retry_after_secs=getattr(e, "retry_after_secs", None),
             )
             return
         except (ValueError, TypeError) as e:
@@ -630,6 +689,7 @@ def serve_http(router, config=None, **overrides):
             "port": config.serving_http_port,
             "max_buffer_bytes": config.serving_http_max_buffer_bytes,
             "overrun_policy": config.serving_http_overrun_policy,
+            "auth_token": config.serving_http_auth_token,
         }
     kwargs.update(overrides)
     door = HTTPDoor(router, **kwargs)

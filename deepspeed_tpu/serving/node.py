@@ -41,6 +41,19 @@ Chaos: the spec config's ``resilience.fault_injection`` block arms the
 node-side injector; ``accept.drop`` fires in the accept loop (the
 overloaded-listener failure mode — the client's connect retry absorbs
 it).
+
+## Elastic capacity (docs/serving.md "SLO autoscaling")
+
+A hello naming :data:`transport.NODE_CONTROL_NAME` opens a CONTROL
+session bound to no engine; on it (and only meaningfully on it) the
+lifecycle ops run: ``spawn_replica`` builds a new engine from the op's
+spec (default: the node spec's ``spawn_spec``, falling back to the
+first declared replica's spec) OFF the connection thread and replies
+only once it serves — a caller never races a half-built replica;
+``retire_replica`` drains + closes one engine and reaps its sessions;
+``node_info`` lists the live roster. ``max_replicas`` in the node spec
+caps hosted engines. The router-side autoscaler drives these through
+``transport.NodeControlClient``.
 """
 
 import argparse
@@ -58,6 +71,7 @@ from ..telemetry.registry import count_suppressed
 from ..utils.logging import logger
 from .replica import RPC_PROTOCOL_VERSION
 from .transport import (
+    NODE_CONTROL_NAME,
     FrameError,
     corrupt_frame,  # noqa: F401  (re-exported for chaos tooling)
     decode_frame,
@@ -150,13 +164,32 @@ class NodeServer:
         spec = dict(spec)
         self.node_id = str(spec.get("node_id", "node"))
         replica_specs = spec.get("replicas") or {}
-        if not replica_specs:
-            raise ValueError("node spec needs a non-empty 'replicas' map")
+        if not replica_specs and spec.get("spawn_spec") is None:
+            raise ValueError(
+                "node spec needs a non-empty 'replicas' map (or a "
+                "'spawn_spec' template for a node that starts empty and "
+                "is populated by the autoscaler's spawn_replica ops)"
+            )
         self._replica_specs = {
             str(name): dict(rspec) for name, rspec in replica_specs.items()
         }
         self.lease_secs = float(spec.get("lease_secs", 10.0))
         self.resume_grace_secs = float(spec.get("resume_grace_secs", 10.0))
+        # elastic capacity (docs/serving.md "SLO autoscaling"): the spec
+        # an op-supplied-spec-less spawn_replica builds from (default:
+        # the first declared replica's spec — a homogeneous node), and a
+        # hard ceiling on hosted engines (None = the router's autoscaler
+        # is the only bound)
+        template = spec.get("spawn_spec")
+        if template is None and self._replica_specs:
+            template = self._replica_specs[sorted(self._replica_specs)[0]]
+        self._spawn_template = dict(template or {})
+        self.max_replicas = spec.get("max_replicas")
+        if self.max_replicas is not None:
+            self.max_replicas = int(self.max_replicas)
+        # serializes spawn/retire against each other (engine builds are
+        # slow; two concurrent spawns of one name must not both win)
+        self._elastic_lock = threading.Lock()
         self._host = str(host)
         self._port = int(port)
         self._build = engine_builder or build_engine_from_spec
@@ -338,14 +371,19 @@ class NodeServer:
             return None
         name = str(hello.get("replica"))
         client = str(hello.get("client"))
-        engine = self.engines.get(name)
-        if engine is None:
-            conn.sendall(encode_frame({
-                "event": "error",
-                "error": f"node {self.node_id} hosts no replica {name!r} "
-                         f"(valid: {sorted(self.engines)})",
-            }))
-            return None
+        if name == NODE_CONTROL_NAME:
+            # control-plane session (transport.py NodeControlClient):
+            # binds to NO engine — only the lifecycle ops are valid on it
+            engine = None
+        else:
+            engine = self.engines.get(name)
+            if engine is None:
+                conn.sendall(encode_frame({
+                    "event": "error",
+                    "error": f"node {self.node_id} hosts no replica "
+                             f"{name!r} (valid: {sorted(self.engines)})",
+                }))
+                return None
         key = (client, name)
         with self._sessions_lock:
             session = self._sessions.get(key)
@@ -393,6 +431,27 @@ class NodeServer:
         self._faults.maybe_stall("replica.hang")
         if op == "ping":
             session.emit({"event": "pong"})
+        elif op in ("spawn_replica", "retire_replica", "node_info"):
+            # control-plane ops (docs/serving.md "SLO autoscaling"):
+            # valid on any session, but a control session is their home
+            if op == "node_info":
+                session.emit({
+                    "event": "reply", "id": msg.get("id"),
+                    "node": self.node_id,
+                    "replicas": sorted(self.engines),
+                })
+            elif op == "spawn_replica":
+                self._op_spawn(session, msg)
+            else:
+                self._op_retire(session, msg)
+        elif session.engine is None:
+            # a control session asked for an engine op: answer the typed
+            # error instead of an AttributeError killing the connection
+            session.emit({
+                "event": "reply", "id": msg.get("id"),
+                "error": f"op {op!r} needs a replica session, not the "
+                         f"control session",
+            })
         elif op == "submit":
             self._op_submit(session, msg)
         elif op == "cancel":
@@ -489,6 +548,133 @@ class NodeServer:
         threading.Thread(
             target=run, name=f"ds-node-{self.node_id}-adapter",
             daemon=True,
+        ).start()
+
+    # -- elastic replica lifecycle (docs/serving.md "SLO autoscaling") ---
+    def _op_spawn(self, session, msg):
+        """Build + serve a new replica: the scale-up / re-provision op.
+        The engine builds OFF the connection thread (same discipline as
+        adapter loads — a multi-second model build must not starve pong
+        replies past the lease), and the reply lands only once the
+        engine is serving: the caller never races a half-built replica."""
+        rpc_id = msg.get("id")
+        name = str(msg.get("name") or "")
+        spec = msg.get("spec")
+        prefix_ids = bool(msg.get("prefix_ids", True))
+
+        def run():
+            with self._elastic_lock:
+                if not name or name == NODE_CONTROL_NAME:
+                    session.emit({
+                        "event": "reply", "id": rpc_id,
+                        "error": f"invalid replica name {name!r}",
+                    })
+                    return
+                if name in self.engines:
+                    session.emit({
+                        "event": "reply", "id": rpc_id,
+                        "error": f"node {self.node_id} already hosts "
+                                 f"replica {name!r}",
+                    })
+                    return
+                if (
+                    self.max_replicas is not None
+                    and len(self.engines) >= self.max_replicas
+                ):
+                    session.emit({
+                        "event": "reply", "id": rpc_id,
+                        "error": f"node {self.node_id} at its "
+                                 f"max_replicas ceiling "
+                                 f"({self.max_replicas})",
+                    })
+                    return
+                engine = None
+                try:
+                    engine = self._build(
+                        dict(spec) if spec else dict(self._spawn_template)
+                    )
+                    sched = getattr(engine, "scheduler", None)
+                    set_prefix = getattr(sched, "set_id_prefix", None)
+                    if prefix_ids and set_prefix is not None:
+                        set_prefix(f"{self.node_id}/{name}")
+                    engine.serve_forever()
+                except Exception as e:
+                    if engine is not None:
+                        # built but never served: free it, or retried
+                        # spawns compound the leak until the node OOMs
+                        try:
+                            engine.close()
+                        except Exception as e2:
+                            count_suppressed(
+                                "serving.node_engine_close", e2
+                            )
+                    logger.exception(
+                        "node %s: spawn of replica %r failed",
+                        self.node_id, name,
+                    )
+                    count_suppressed("serving.node_spawn_failed", e)
+                    session.emit({
+                        "event": "reply", "id": rpc_id,
+                        "error": f"spawn failed: {e}",
+                    })
+                    return
+                self.engines[name] = engine
+            logger.info(
+                "node %s: spawned replica %r (%d hosted)",
+                self.node_id, name, len(self.engines),
+            )
+            session.emit({
+                "event": "reply", "id": rpc_id, "replica": name,
+                "replicas": sorted(self.engines),
+            })
+
+        threading.Thread(
+            target=run, name=f"ds-node-{self.node_id}-spawn", daemon=True,
+        ).start()
+
+    def _op_retire(self, session, msg):
+        """Drain + close one hosted replica and free its engine: the
+        scale-down op. Sessions bound to the retired replica are reaped
+        (their in-flight requests cancel and the clients re-route) —
+        the router drains first on the graceful path, so a well-ordered
+        retire finds them already idle."""
+        rpc_id = msg.get("id")
+        name = str(msg.get("name") or "")
+
+        def run():
+            with self._elastic_lock:
+                engine = self.engines.pop(name, None)
+                if engine is None:
+                    session.emit({
+                        "event": "reply", "id": rpc_id,
+                        "error": f"node {self.node_id} hosts no replica "
+                                 f"{name!r}",
+                    })
+                    return
+                with self._sessions_lock:
+                    doomed = [
+                        s for (client, rname), s in self._sessions.items()
+                        if rname == name
+                    ]
+                for s in doomed:
+                    self._drop_session(
+                        s, f"replica {name!r} retired by the control plane"
+                    )
+                try:
+                    engine.close()
+                except Exception as e:
+                    count_suppressed("serving.node_engine_close", e)
+            logger.info(
+                "node %s: retired replica %r (%d hosted)",
+                self.node_id, name, len(self.engines),
+            )
+            session.emit({
+                "event": "reply", "id": rpc_id, "replica": name,
+                "replicas": sorted(self.engines),
+            })
+
+        threading.Thread(
+            target=run, name=f"ds-node-{self.node_id}-retire", daemon=True,
         ).start()
 
     # -- request watching (worker.py's poller, per session) --------------

@@ -924,6 +924,7 @@ def _dead_snapshot(failed):
         "queue_depth": 0, "queue_capacity": 0, "active_slots": 0,
         "free_slots": 0, "num_slots": 0, "health": 2,
         "mean_prefill_ms": 0.0, "mean_decode_ms": 0.0,
+        "p99_prefill_ms": 0.0, "mean_queue_wait_ms": 0.0,
         "requests_shed": 0.0, "restarts_used": 0,
         "requests_completed": 0, "tokens_generated": 0,
         "driving": False, "stopped": True, "driver_failed": failed,

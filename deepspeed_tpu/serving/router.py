@@ -297,7 +297,7 @@ class FleetRouter:
                  breaker_backoff_secs=0.5, breaker_backoff_max_secs=30.0,
                  zombie_secs=0.0, zombie_restart_budget=2,
                  brownout_queue_ratio=None, brownout_max_new_tokens=16,
-                 fault_injector=None):
+                 fault_injector=None, autoscaler=None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         from ..telemetry.manager import register_serving_metrics
@@ -322,15 +322,17 @@ class FleetRouter:
         )
         # per-replica circuit breakers (breaker.py): fed by submit-path
         # outcomes, filtered on in _candidates — an open replica costs
-        # placement nothing instead of a doomed submit + re-route
+        # placement nothing instead of a doomed submit + re-route.
+        # (kwargs kept: add_replica builds late-joining replicas'
+        # breakers from the same recipe)
+        self._breaker_kwargs = dict(
+            failure_threshold=breaker_failure_threshold,
+            backoff_secs=breaker_backoff_secs,
+            backoff_max_secs=breaker_backoff_max_secs,
+            clock=clock,
+        )
         self._breakers = {
-            rid: build_breaker(
-                rid,
-                failure_threshold=breaker_failure_threshold,
-                backoff_secs=breaker_backoff_secs,
-                backoff_max_secs=breaker_backoff_max_secs,
-                clock=clock,
-            )
+            rid: build_breaker(rid, **self._breaker_kwargs)
             for rid in self._order
         }
         # zombie detection (monitor loop): rid -> (progress marker, stamp)
@@ -419,6 +421,7 @@ class FleetRouter:
         )
         self._ttft_p50 = reg.gauge("fleet/ttft_p50_ms")
         self._ttft_p99 = reg.gauge("fleet/ttft_p99_ms")
+        self._shed_total = reg.gauge("fleet/requests_shed")
         self._routed = reg.counter("fleet/requests_routed")
         self._rerouted = reg.counter("fleet/requests_rerouted")
         self._completed = reg.counter("fleet/requests_completed")
@@ -433,6 +436,11 @@ class FleetRouter:
         self._zombie_restarts = reg.counter("fleet/zombie_restarts")
         self._brownout_gauge = reg.gauge("fleet/brownout")
         self._browned_out = reg.counter("fleet/requests_browned_out")
+        # the SLO autoscaler (autoscaler.py): None = feature off, zero
+        # overhead, no new threads — the monitor tick checks and moves on
+        self._autoscaler = autoscaler
+        if autoscaler is not None:
+            autoscaler.attach(self)
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
@@ -454,6 +462,10 @@ class FleetRouter:
         outstanding fleet requests — a waiter never hangs on a dead
         fleet."""
         self._stop.set()
+        if self._autoscaler is not None:
+            # wait out an in-flight scale op BEFORE tearing replicas
+            # down: a spawn landing mid-teardown would leak its engine
+            self._autoscaler.close(timeout)
         if self._monitor is not None:
             self._monitor.join(timeout)
             if self._monitor.is_alive():
@@ -468,9 +480,11 @@ class FleetRouter:
                 )
                 count_suppressed("serving.router.monitor_join_timeout")
             self._monitor = None
-        for rid in self._order:
+        for rid in list(self._order):
             if rid not in self._evicted:
-                self._replicas[rid].shutdown()
+                replica = self._replicas.get(rid)
+                if replica is not None:
+                    replica.shutdown()
         with self._lock:
             orphans = [fr for fr, _inner, _rid in self._outstanding.values()]
             self._outstanding.clear()
@@ -616,6 +630,122 @@ class FleetRouter:
                 return
             self.restart_replica(rid, wait_timeout=wait_timeout)
         self.refresh_telemetry()
+
+    # -- elastic capacity (docs/serving.md "SLO autoscaling") -----------
+    def live_replica_ids(self):
+        """Registered, non-evicted replica ids — the autoscaler's live
+        capacity count (draining replicas still count until removed)."""
+        with self._lock:
+            return [rid for rid in self._order if rid not in self._evicted]
+
+    def add_replica(self, replica, *, probation=True):
+        """Register a replica built AFTER construction — the
+        autoscaler's scale-up / re-provision path (also usable
+        directly for operator-driven capacity adds). ``replica`` must
+        already be started (engine serving).
+
+        The fleet-wide adapter registry replays onto it BEFORE it joins
+        placement (a tenant's request must never bounce off the new
+        capacity), the current brownout state propagates, and with
+        ``probation`` (the default) its circuit breaker arms the
+        half-open probe gate: the first submission is the window's one
+        probe, so a half-built or misconfigured replica costs the fleet
+        at most one request instead of a queue of them."""
+        rid = replica.replica_id
+        with self._lock:
+            if rid in self._replicas and rid not in self._evicted:
+                raise ValueError(
+                    f"replica id {rid!r} is already registered"
+                )
+        for name, kwargs in list(self._adapter_registry.items()):
+            try:
+                replica.load_adapter(name, **kwargs)
+                self._adapter_loads.inc()
+            except Exception as e:
+                logger.exception(
+                    "fleet: replaying adapter %r onto new replica %s "
+                    "failed; its requests will fail on this replica",
+                    name, rid,
+                )
+                count_suppressed("serving.adapter_replay_failed", e)
+        breaker = build_breaker(rid, **self._breaker_kwargs)
+        if probation:
+            breaker.begin_probation()
+        with self._lock:
+            self._replicas[rid] = replica
+            if rid not in self._order:
+                self._order.append(rid)
+            self._breakers[rid] = breaker
+            self._zombie_restarts_used.setdefault(rid, 0)
+            self.routed_counts.setdefault(rid, 0)
+            self._evicted.discard(rid)
+            self._force_failed.discard(rid)
+            self._routable.add(rid)
+        self._progress.pop(rid, None)
+        if self._brownout:
+            self._set_replica_brownout(rid, True)
+        logger.info(
+            "fleet: replica %s registered%s (%d live)", rid,
+            " behind its half-open probation probe" if probation else "",
+            len(self.live_replica_ids()),
+        )
+        self.refresh_telemetry()
+        return replica
+
+    def remove_replica(self, replica_id, *, wait_idle_timeout=30.0):
+        """Drain + deregister one replica — the autoscaler's scale-down
+        path: traffic steers away, queued and in-flight work finishes
+        (bounded by ``wait_idle_timeout``; stragglers fail-finish at the
+        replica's shutdown and the sweep re-routes them), then the
+        replica pops from every router structure and its
+        ``fleet/replica{id}/*`` gauges retire. Returns the popped
+        Replica — the caller (the autoscaler's provider) owns its
+        shutdown and any node-side engine teardown. Refuses to empty
+        the fleet."""
+        with self._lock:
+            if replica_id not in self._replicas:
+                raise ValueError(f"no replica {replica_id!r} registered")
+            live = [r for r in self._order if r not in self._evicted]
+            if replica_id in live and len(live) <= 1:
+                raise RuntimeError(
+                    "cannot remove the last live replica — a fleet "
+                    "needs at least one"
+                )
+        self.drain(replica_id)
+        replica = self._replicas[replica_id]
+        if not replica.wait_idle(wait_idle_timeout):
+            logger.warning(
+                "fleet: replica %s did not drain within %.1fs; removing "
+                "anyway (outstanding requests will re-route)",
+                replica_id, wait_idle_timeout,
+            )
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+            if replica_id in self._order:
+                self._order.remove(replica_id)
+            self._routable.discard(replica_id)
+            self._evicted.discard(replica_id)
+            self._force_failed.discard(replica_id)
+            self._breakers.pop(replica_id, None)
+            self._zombie_restarts_used.pop(replica_id, None)
+            self.routed_counts.pop(replica_id, None)
+        self._progress.pop(replica_id, None)
+        with self._placement_lock:
+            self.placement.forget(replica_id)
+        self._retire_replica_gauges(replica_id)
+        logger.info(
+            "fleet: replica %s removed (%d live)", replica_id,
+            len(self.live_replica_ids()),
+        )
+        self.refresh_telemetry()
+        return replica
+
+    def _retire_replica_gauges(self, replica_id):
+        """Drop every ``fleet/replica{id}/*`` stream from the registry:
+        a replica that left the fleet (eviction, scale-down) must stop
+        exporting its stale last values — a dashboard reading a dead
+        replica's frozen queue depth as live data is worse than a gap."""
+        self.metrics.remove_prefix(f"fleet/replica{replica_id}/")
 
     # -- adapter registry (docs/adapters.md) ----------------------------
     def load_adapter(self, name, replica_ids=None, **kwargs):
@@ -866,12 +996,18 @@ class FleetRouter:
         (and a re-route) on a replica known to be failing its RPCs."""
         routable = self._routable_ids()
         out = []
-        for rid in self._order:
+        with self._lock:
+            order = tuple(self._order)
+        for rid in order:
             if rid not in routable:
                 continue
-            if not self._breakers[rid].routable():
+            replica = self._replicas.get(rid)
+            breaker = self._breakers.get(rid)
+            if replica is None or breaker is None:
+                continue  # removed (scale-down) mid-pass
+            if not breaker.routable():
                 continue
-            snap = self._replicas[rid].load_snapshot()
+            snap = replica.load_snapshot()
             if snap.get("failed") or not snap.get("alive"):
                 continue
             out.append((rid, snap))
@@ -925,7 +1061,13 @@ class FleetRouter:
                     count_suppressed("serving.router_place", e)
                     rid = candidates[0][0]
                     was_hit = False
-            breaker = self._breakers[rid]
+            replica = self._replicas.get(rid)
+            breaker = self._breakers.get(rid)
+            if replica is None or breaker is None:
+                # removed (scale-down) between the candidate snapshot
+                # and this placement pass: not a failure, just gone
+                candidates = [c for c in candidates if c[0] != rid]
+                continue
             probing = breaker.state == BREAKER_OPEN
             if not breaker.allow_request():
                 # raced another submit into the window's single half-open
@@ -943,7 +1085,7 @@ class FleetRouter:
                     )
             attempts += 1
             try:
-                inner = self._replicas[rid].submit(
+                inner = replica.submit(
                     fleet_req.prompt_tokens, **submit_kwargs
                 )
             except ReplicaRPCError as e:
@@ -1000,7 +1142,9 @@ class FleetRouter:
 
     # -- circuit breakers (docs/serving.md "Circuit breakers") ----------
     def _note_breaker_failure(self, rid, exc):
-        breaker = self._breakers[rid]
+        breaker = self._breakers.get(rid)
+        if breaker is None:
+            return  # removed (scale-down) mid-placement
         before = breaker.state
         breaker.record_failure()
         if breaker.state == BREAKER_OPEN:
@@ -1020,7 +1164,9 @@ class FleetRouter:
                 )
 
     def _note_breaker_success(self, rid):
-        breaker = self._breakers[rid]
+        breaker = self._breakers.get(rid)
+        if breaker is None:
+            return  # removed (scale-down) mid-placement
         before = breaker.state
         breaker.record_success()
         if before != BREAKER_CLOSED:
@@ -1080,7 +1226,10 @@ class FleetRouter:
         return active
 
     def _set_replica_brownout(self, rid, on):
-        hook = getattr(self._replicas[rid], "set_brownout", None)
+        replica = self._replicas.get(rid)
+        if replica is None:
+            return  # removed (scale-down) racing the brownout edge
+        hook = getattr(replica, "set_brownout", None)
         if hook is None:
             return
         try:
@@ -1118,6 +1267,14 @@ class FleetRouter:
         self._sweep_zombies()
         self._sweep_failed_replicas()
         self._sweep_outstanding()
+        if self._autoscaler is not None:
+            try:
+                self._autoscaler.tick()
+            except Exception as e:
+                # a broken autoscaler must not take the zombie/eviction
+                # sweeps down with it
+                logger.exception("fleet autoscaler tick failed")
+                count_suppressed("serving.autoscale_tick", e)
         now = self._clock()
         if now - self._last_refresh >= self._telemetry_refresh_secs:
             self.refresh_telemetry()
@@ -1139,7 +1296,10 @@ class FleetRouter:
         for rid in list(self._routable_ids()):
             if rid in self._evicted:
                 continue
-            snap = self._replicas[rid].load_snapshot()
+            replica = self._replicas.get(rid)
+            if replica is None:
+                continue  # removed (scale-down) mid-sweep
+            snap = replica.load_snapshot()
             unresponsive = bool(snap.get("unresponsive"))
             stuck = unresponsive or (
                 snap.get("alive") and snap.get("active_slots", 0) > 0
@@ -1192,10 +1352,13 @@ class FleetRouter:
     def _sweep_failed_replicas(self):
         with self._lock:
             force_failed = set(self._force_failed)
-        for rid in self._order:
+            order = tuple(self._order)
+        for rid in order:
             if rid in self._evicted:
                 continue
-            replica = self._replicas[rid]
+            replica = self._replicas.get(rid)
+            if replica is None:
+                continue  # removed (scale-down) mid-sweep
             if replica.failed or rid in force_failed:
                 logger.warning(
                     "fleet: evicting replica %s (decode driver dead past "
@@ -1211,6 +1374,10 @@ class FleetRouter:
                 self._evictions.inc()
                 with self._placement_lock:
                     self.placement.forget(rid)
+                # a dead replica's per-replica gauges must not keep
+                # exporting their stale last values (docs/serving.md) —
+                # restart_replica re-creates them on a resurrection
+                self._retire_replica_gauges(rid)
                 # reap the corpse: in-process this fail-finishes anything
                 # still parked on its queue (the monitor re-routes those
                 # on the next sweep); subprocess it just waits the pid
@@ -1351,63 +1518,69 @@ class FleetRouter:
         prefix_hits = 0
         prefix_lookups = 0
         adapters_resident = set()
+        total_shed = 0.0
         routable = self._routable_ids()
-        for rid in self._order:
+        with self._lock:
+            order = tuple(self._order)
+        for rid in order:
             if rid in self._evicted:
-                alive_val = 0.0
-                snap = None
-            else:
-                snap = self._replicas[rid].load_snapshot()
-                alive_val = 1.0 if snap.get("alive") else 0.0
+                # an evicted replica's gauges were RETIRED at eviction
+                # (remove_prefix) — recreating them here would resurrect
+                # stale streams; restart_replica's refresh re-mints them
+                continue
+            replica = self._replicas.get(rid)
+            breaker = self._breakers.get(rid)
+            if replica is None or breaker is None:
+                continue  # removed (scale-down) mid-refresh
+            snap = replica.load_snapshot()
+            alive_val = 1.0 if snap.get("alive") else 0.0
             prefix = f"fleet/replica{rid}"
-            reg.gauge(f"{prefix}/circuit_state").set(
-                float(self._breakers[rid].state)
+            reg.gauge(f"{prefix}/circuit_state").set(float(breaker.state))
+            reg.gauge(f"{prefix}/queue_depth").set(snap["queue_depth"])
+            reg.gauge(f"{prefix}/slot_occupancy").set(
+                snap["active_slots"]
             )
-            if snap is not None:
-                reg.gauge(f"{prefix}/queue_depth").set(snap["queue_depth"])
-                reg.gauge(f"{prefix}/slot_occupancy").set(
-                    snap["active_slots"]
+            reg.gauge(f"{prefix}/health_state").set(snap["health"])
+            reg.gauge(f"{prefix}/requests_shed").set(
+                snap["requests_shed"]
+            )
+            total_shed += float(snap.get("requests_shed", 0.0))
+            if "prefix_hit_rate" in snap:
+                # paged replicas report their REAL prefix-cache
+                # effectiveness — the ground truth behind the
+                # router-side affinity_hits counter (a placement hit
+                # only pays off when the replica actually reuses the
+                # pages)
+                reg.gauge(f"{prefix}/prefix_hit_rate").set(
+                    snap["prefix_hit_rate"]
                 )
-                reg.gauge(f"{prefix}/health_state").set(snap["health"])
-                reg.gauge(f"{prefix}/requests_shed").set(
-                    snap["requests_shed"]
+                reg.gauge(f"{prefix}/kv_blocks_free").set(
+                    snap.get("kv_blocks_free", 0)
                 )
-                if "prefix_hit_rate" in snap:
-                    # paged replicas report their REAL prefix-cache
-                    # effectiveness — the ground truth behind the
-                    # router-side affinity_hits counter (a placement hit
-                    # only pays off when the replica actually reuses the
-                    # pages)
-                    reg.gauge(f"{prefix}/prefix_hit_rate").set(
-                        snap["prefix_hit_rate"]
-                    )
-                    reg.gauge(f"{prefix}/kv_blocks_free").set(
-                        snap.get("kv_blocks_free", 0)
-                    )
-                    prefix_hits += snap.get("prefix_hits", 0)
-                    prefix_lookups += (
-                        snap.get("prefix_hits", 0)
-                        + snap.get("prefix_misses", 0)
-                    )
-                if "adapters_loaded" in snap:
-                    # multi-LoRA replicas report their resident adapters
-                    # — the per-replica gauge adapter-affinity placement
-                    # is effectively acting on
-                    loaded = snap.get("adapters_loaded") or []
-                    reg.gauge(f"{prefix}/adapters_loaded").set(len(loaded))
-                    adapters_resident.update(loaded)
-                total_queue += snap["queue_depth"]
-                total_active += snap["active_slots"]
-                if rid in routable and snap.get("alive"):
-                    # degraded replicas still take priority-0 traffic, so
-                    # they count as available; draining/stopped do not —
-                    # and ONLY routable replicas feed the brownout ratio
-                    # (both terms: a draining replica's backlog is not
-                    # pressure on the replicas actually taking traffic,
-                    # matching the submit path's candidate-based ratio)
-                    available += 1
-                    total_capacity += snap["queue_capacity"]
-                    routable_queue += snap["queue_depth"]
+                prefix_hits += snap.get("prefix_hits", 0)
+                prefix_lookups += (
+                    snap.get("prefix_hits", 0)
+                    + snap.get("prefix_misses", 0)
+                )
+            if "adapters_loaded" in snap:
+                # multi-LoRA replicas report their resident adapters
+                # — the per-replica gauge adapter-affinity placement
+                # is effectively acting on
+                loaded = snap.get("adapters_loaded") or []
+                reg.gauge(f"{prefix}/adapters_loaded").set(len(loaded))
+                adapters_resident.update(loaded)
+            total_queue += snap["queue_depth"]
+            total_active += snap["active_slots"]
+            if rid in routable and snap.get("alive"):
+                # degraded replicas still take priority-0 traffic, so
+                # they count as available; draining/stopped do not —
+                # and ONLY routable replicas feed the brownout ratio
+                # (both terms: a draining replica's backlog is not
+                # pressure on the replicas actually taking traffic,
+                # matching the submit path's candidate-based ratio)
+                available += 1
+                total_capacity += snap["queue_capacity"]
+                routable_queue += snap["queue_depth"]
             reg.gauge(f"{prefix}/alive").set(alive_val)
         # brownout state follows the fill ratio DOWN too: the monitor's
         # refresh cadence is what ends a brownout window once the queue
@@ -1417,6 +1590,7 @@ class FleetRouter:
         )
         reg.gauge("fleet/queue_depth").set(total_queue)
         reg.gauge("fleet/slot_occupancy").set(total_active)
+        self._shed_total.set(total_shed)
         reg.gauge("fleet/replicas_total").set(
             len(self._order) - len(self._evicted)
         )
@@ -1433,6 +1607,31 @@ class FleetRouter:
             self._telemetry.export(step=self._refreshes)
 
     # -- introspection --------------------------------------------------
+    def readiness(self):
+        """``(ready, reasons)`` — the external-load-balancer view the
+        door's ``GET /readyz`` answers (docs/serving.md): NOT ready
+        while the fleet is draining, browned out, without a routable
+        replica, or with every routable replica reporting degraded
+        health — an LB should stop routing here BEFORE requests shed.
+        Liveness is ``/healthz``'s job; this is about taking traffic."""
+        reasons = []
+        if self._stop.is_set() or self._draining:
+            reasons.append("draining")
+        if self._brownout:
+            reasons.append("brownout")
+        candidates = self._candidates()
+        if not candidates:
+            reasons.append("no_routable_replicas")
+        elif all(s.get("health", 0) > 0 for _rid, s in candidates):
+            reasons.append("degraded")
+        return (not reasons, reasons)
+
+    @property
+    def autoscaler(self):
+        """The attached SLO autoscaler (autoscaler.py), or None when
+        the feature is off (zero-overhead passthrough)."""
+        return self._autoscaler
+
     @property
     def replica_ids(self):
         return list(self._order)

@@ -72,6 +72,11 @@ from .replica import (
 # an attack), not a request — the connection resynchronizes
 FRAME_MAX_BYTES = 8 << 20
 
+# the hello's pseudo-replica name for a CONTROL-plane session on a node
+# agent (node.py): spawn/retire replica lifecycle ops ride the same
+# frame schema but bind to no engine — the autoscaler's elasticity seam
+NODE_CONTROL_NAME = "__node__"
+
 # appended by the frame.corrupt chaos mutation: greppable, un-JSON-able
 _CORRUPT_MARKER = b'#CHAOS-FRAME-CORRUPT#{"'
 
@@ -586,3 +591,101 @@ class SocketReplica(RpcReplicaBase):
     @property
     def failed(self):
         return self._gone and not self._shutdown_requested
+
+
+class NodeControlClient:
+    """Short-lived synchronous control-plane client for a node agent
+    (serving/node.py): dial, hello as the :data:`NODE_CONTROL_NAME`
+    pseudo-replica, one op, one reply, bye. Built per call — control
+    ops are rare (autoscale transitions), so persistent-connection
+    machinery (leases, reconnect-with-resume) buys nothing here; a
+    dead node answers as a connect/read failure the caller absorbs.
+
+    ``spawn_replica`` is generously timed out by default: the node
+    builds the new engine (model init or checkpoint load + device put)
+    before replying."""
+
+    def __init__(self, address, *, connect_timeout=10.0,
+                 op_timeout=180.0):
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            address = (host or "127.0.0.1", int(port))
+        self.address = (str(address[0]), int(address[1]))
+        self._connect_timeout = float(connect_timeout)
+        self._op_timeout = float(op_timeout)
+
+    def spawn_replica(self, name, spec=None, node_prefix_ids=True):
+        """Ask the node to build + serve a new replica ``name`` (engine
+        constructed OFF the connection thread — see node.py). ``spec``
+        defaults to the node's own spawn template. Returns the node's
+        reply dict; raises RuntimeError on a node-side refusal (name
+        collision, max_replicas, builder failure)."""
+        op = {"op": "spawn_replica", "name": str(name)}
+        if spec is not None:
+            op["spec"] = dict(spec)
+        if not node_prefix_ids:
+            op["prefix_ids"] = False
+        return self._roundtrip(op)
+
+    def retire_replica(self, name):
+        """Ask the node to drain + close replica ``name`` and free its
+        engine (the scale-down counterpart of :meth:`spawn_replica`)."""
+        return self._roundtrip({"op": "retire_replica", "name": str(name)})
+
+    def node_info(self):
+        """The node's live replica roster (``{"node": ..., "replicas":
+        [...]}``) — what a provider verifies a spawn/retire against."""
+        return self._roundtrip({"op": "node_info"})
+
+    def _roundtrip(self, op):
+        sock = socket.create_connection(
+            self.address, timeout=self._connect_timeout
+        )
+        try:
+            sock.settimeout(self._op_timeout)
+            sock.sendall(encode_frame({
+                "op": "hello", "proto": RPC_PROTOCOL_VERSION,
+                "client": f"ctl-{os.getpid():x}-{uuid.uuid4().hex[:8]}",
+                "replica": NODE_CONTROL_NAME,
+            }))
+            rfile = sock.makefile("rb")
+            self._await_event(rfile, "ready")
+            sock.sendall(encode_frame(dict(op, id=1)))
+            reply = self._await_event(rfile, "reply")
+            try:
+                sock.sendall(encode_frame({"op": "bye"}))
+            except OSError:
+                pass
+            if reply.get("error"):
+                raise RuntimeError(
+                    f"node {self.address[0]}:{self.address[1]} refused "
+                    f"{op.get('op')}: {reply['error']}"
+                )
+            return reply
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _await_event(self, rfile, event):
+        deadline = time.monotonic() + self._op_timeout
+        while time.monotonic() < deadline:
+            line = read_frame_line(rfile)
+            if not line:
+                raise ConnectionError(
+                    f"node {self.address[0]}:{self.address[1]} closed the "
+                    f"control connection before answering"
+                )
+            try:
+                msg = decode_frame(line)
+            except FrameError:
+                continue
+            if msg.get("event") == "error":
+                raise RuntimeError(str(msg.get("error")))
+            if msg.get("event") == event:
+                return msg
+        raise TimeoutError(
+            f"node {self.address[0]}:{self.address[1]}: no {event!r} "
+            f"within {self._op_timeout}s"
+        )

@@ -433,6 +433,7 @@ class StubWorkerEngine:
             "active_slots": active, "free_slots": max(8 - active, 0),
             "num_slots": 8, "health": 2 if self._draining else 0,
             "mean_prefill_ms": 1.0, "mean_decode_ms": 1.0,
+            "p99_prefill_ms": 1.0, "mean_queue_wait_ms": 0.0,
             "requests_shed": 0.0, "restarts_used": 0,
             "requests_completed": completed, "tokens_generated": tokens,
             "driving": True, "stopped": self._draining,

@@ -172,6 +172,22 @@ SERVING_METRICS = (
     ("counter", "fleet/net_lease_expiries", "socket connections torn down after a silent heartbeat-lease window (the half-open-link detector)"),
     ("counter", "fleet/net_frames_corrupt", "received socket frames dropped for failing the length check or JSON decode (idempotent-RPC retry re-asks; submits fall through placement)"),
     ("counter", "fleet/net_slow_client_drops", "HTTP streams dropped by the overrun policy: the client drained slower than its tokens arrived, so the request cancelled and the slot freed"),
+    # SLO autoscaling (docs/serving.md "SLO autoscaling"): the predictive
+    # cost-model view and the elastic-capacity transitions it drives
+    ("gauge", "fleet/requests_shed", "requests shed at replica doors fleet-wide (sum of the live replicas' shed counters at the last refresh)"),
+    ("gauge", "fleet/slo_ttft_p99_ms", "configured serving.slo.ttft_p99_ms target (0 = no TTFT SLO configured)"),
+    ("gauge", "fleet/slo_token_p99_ms", "configured serving.slo.token_p99_ms target (0 = no token-latency SLO configured)"),
+    ("gauge", "fleet/slo_predicted_ttft_ms", "cost-model-predicted TTFT under the current arrival rate and fleet capacity (the autoscaler's scale-up signal)"),
+    ("gauge", "fleet/slo_predicted_token_ms", "cost-model-predicted per-token decode latency at the current occupancy"),
+    ("gauge", "fleet/slo_utilization", "predicted fleet utilization: observed arrival rate over the cost model's sustainable request rate"),
+    ("gauge", "fleet/slo_error_budget_remaining", "fraction of the serving.slo.eval_window_secs window's samples meeting the SLO (1.0 = full budget; decays as observed p99 breaches the target)"),
+    ("counter", "fleet/slo_violations", "autoscaler evaluation samples where the observed fleet TTFT p99 exceeded the configured SLO target"),
+    ("gauge", "fleet/autoscale_target_replicas", "the autoscaler's current desired replica count (live capacity below this triggers re-provisioning)"),
+    ("counter", "fleet/autoscale_ups", "scale-up transitions executed (a new replica spawned and registered behind its half-open probe)"),
+    ("counter", "fleet/autoscale_downs", "scale-down transitions executed (a replica drained, retired, and its gauges removed)"),
+    ("counter", "fleet/autoscale_reprovisions", "replicas re-provisioned after chaos took capacity away (eviction, node death) — live count restored to the target"),
+    ("counter", "fleet/autoscale_refusals", "autoscale decisions refused by a clamp: cooldown, flap budget, or the min/max replica bounds"),
+    ("counter", "fleet/autoscale_failures", "scale operations that failed mid-execution (spawn raised, node unreachable, retire refused)"),
     ("counter", "door/requests", "HTTP requests accepted by the front door"),
     ("gauge", "door/open_streams", "SSE token streams currently open on the door"),
     ("histogram", "door/stream_ttft_ms", "door-observed time to first streamed token event (request receipt to the first SSE token flush)"),

@@ -191,6 +191,21 @@ class MetricsRegistry:
     def histogram(self, name, buckets=DEFAULT_TIME_BUCKETS_MS, help=""):
         return self._get_or_create(Histogram, name, buckets=buckets, help=help)
 
+    def remove_prefix(self, prefix):
+        """Retire every metric whose name starts with ``prefix`` —
+        the fleet router's per-replica gauge cleanup when a replica is
+        evicted or scaled away (docs/serving.md): a dead replica's
+        ``fleet/replica{i}/*`` streams must stop exporting their stale
+        last values, not freeze at them forever. Returns the retired
+        names. Callers holding a retired instrument object keep a live
+        (but orphaned) handle; re-registering the name mints a fresh
+        zeroed instrument."""
+        with self._lock:
+            dead = [k for k in self._metrics if k.startswith(prefix)]
+            for k in dead:
+                del self._metrics[k]
+        return dead
+
     def collect(self):
         """Consistent point-in-time list of live metric objects, sorted by
         name (exporters iterate this under no lock — instruments are only

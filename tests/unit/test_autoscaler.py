@@ -1,0 +1,802 @@
+"""SLO-driven predictive autoscaler tests (deepspeed_tpu/serving/
+autoscaler.py, docs/serving.md "SLO autoscaling"): the cost model's
+deterministic predictions, the full decision table from synthetic
+snapshots with an injectable clock (surge -> scale-up before the
+brownout band, headroom -> drain-then-retire, eviction -> re-provision,
+cooldown / flap-budget refusal, min/max clamps), elastic replica
+lifecycle end to end over real schedulers, per-replica gauge retirement,
+the node agent's spawn/retire control ops over a real socket, and the
+disabled-config zero-overhead pin."""
+
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.inference.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.serving import (
+    AUTOSCALE_DOWN,
+    AUTOSCALE_HOLD,
+    AUTOSCALE_REPROVISION,
+    AUTOSCALE_UP,
+    BREAKER_CLOSED,
+    BREAKER_OPEN,
+    Autoscaler,
+    AutoscalerPolicy,
+    FleetRouter,
+    InProcessReplica,
+    InProcessReplicaProvider,
+    PhaseCostModel,
+    SLOTargets,
+    SocketNodeProvider,
+)
+from deepspeed_tpu.serving.autoscaler import AutoscaleState, ErrorBudget
+from deepspeed_tpu.serving.node import NodeServer
+from deepspeed_tpu.serving.replica import ReplicaBase
+from deepspeed_tpu.serving.transport import (
+    NodeControlClient,
+    SocketReplica,
+)
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# synthetic snapshots (the decision table's inputs)
+# ---------------------------------------------------------------------------
+def _snap(**kw):
+    base = {
+        "alive": True, "failed": False, "queue_depth": 0,
+        "queue_capacity": 8, "active_slots": 0, "free_slots": 2,
+        "num_slots": 2, "health": 0, "mean_prefill_ms": 10.0,
+        "p99_prefill_ms": 20.0, "mean_decode_ms": 3.0,
+        "mean_queue_wait_ms": 1.0, "requests_shed": 0.0,
+        "restarts_used": 0, "requests_completed": 10,
+        "tokens_generated": 320, "driving": True, "stopped": False,
+        "driver_failed": False,
+    }
+    base.update(kw)
+    return base
+
+
+def _fitted_model(snaps):
+    model = PhaseCostModel()
+    model.observe(snaps)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+def test_cost_model_fit_and_prediction_deterministic():
+    snaps = [("0", _snap())]
+    model = _fitted_model(snaps)
+    assert model.fitted
+    # service = prefill + tokens/request * decode = 10 + 32*3 = 106ms
+    assert model.service_ms() == pytest.approx(106.0)
+    p1 = model.predict(snaps, arrival_rps=5.0)
+    p2 = model.predict(snaps, arrival_rps=5.0)
+    assert p1 == p2  # pure arithmetic: same inputs, same numbers
+    # 2 slots / 106ms => ~18.87 sustainable rps
+    assert p1.sustainable_rps == pytest.approx(2000.0 / 106.0)
+    assert p1.utilization == pytest.approx(5.0 / (2000.0 / 106.0))
+    assert p1.token_ms == pytest.approx(3.0)
+
+
+def test_cost_model_saturation_amplifies_predicted_wait():
+    snaps = [("0", _snap(queue_depth=6))]
+    model = _fitted_model(snaps)
+    calm = model.predict(snaps, arrival_rps=1.0)
+    saturated = model.predict(snaps, arrival_rps=100.0)
+    assert saturated.utilization > 1.0
+    # the same backlog predicts an exploding wait near saturation —
+    # the property that lets the autoscaler act while queues are shallow
+    assert saturated.ttft_ms > 10 * calm.ttft_ms
+    assert saturated.ttft_ms < float("inf")
+
+
+def test_cost_model_unfitted_predicts_zero_utilization():
+    model = PhaseCostModel()
+    snaps = [("0", _snap(mean_prefill_ms=0.0, mean_decode_ms=0.0,
+                         queue_depth=4))]
+    model.observe(snaps)  # zero means contribute nothing
+    assert not model.fitted
+    p = model.predict(snaps, arrival_rps=100.0)
+    assert p.utilization == 0.0 and not p.fitted
+    assert p.queue_ratio == pytest.approx(0.5)  # fill still reported
+
+
+def test_error_budget_window_prunes_and_accounts():
+    budget = ErrorBudget(window_secs=10.0)
+    assert budget.remaining(now=0.0) == 1.0  # idle fleet: full budget
+    budget.record(0.0, violated=True)
+    budget.record(1.0, violated=False)
+    budget.record(2.0, violated=False)
+    budget.record(3.0, violated=False)
+    assert budget.remaining(now=3.0) == pytest.approx(0.75)
+    # the violation ages out of the window; the budget refills
+    assert budget.remaining(now=11.5) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# the decision table (pure: synthetic snapshots + injectable clock)
+# ---------------------------------------------------------------------------
+def _policy(**kw):
+    kw.setdefault("slo", SLOTargets(ttft_p99_ms=250.0))
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("cooldown_secs", 30.0)
+    kw.setdefault("hysteresis_secs", 60.0)
+    return AutoscalerPolicy(**kw)
+
+
+def test_decide_surge_scales_up_on_predicted_slo_miss():
+    snaps = [("0", _snap(queue_depth=6, active_slots=2))]
+    model = _fitted_model(snaps)
+    prediction = model.predict(snaps, arrival_rps=50.0)
+    policy = _policy()
+    state = AutoscaleState(target=1)
+    d = policy.decide(live_replicas=1, candidates=snaps,
+                      prediction=prediction, state=state, now=100.0)
+    assert d.action == AUTOSCALE_UP
+    assert "SLO" in d.reason
+    # purity: the identical inputs yield the identical decision
+    d2 = policy.decide(live_replicas=1, candidates=snaps,
+                       prediction=prediction, state=state, now=100.0)
+    assert d == d2
+
+
+def test_decide_scales_up_before_brownout_band_engages():
+    """Queue fill at 80% of the brownout threshold triggers capacity
+    growth even with an UNFITTED cost model — degradation must never be
+    the first responder."""
+    policy = _policy(slo=SLOTargets(), brownout_queue_ratio=0.5)
+    snaps = [("0", _snap(queue_depth=4, queue_capacity=10,
+                         mean_prefill_ms=0.0, mean_decode_ms=0.0))]
+    model = PhaseCostModel()
+    model.observe(snaps)
+    prediction = model.predict(snaps, arrival_rps=0.0)
+    assert prediction.queue_ratio == pytest.approx(0.4)  # = 0.8 * 0.5
+    d = policy.decide(live_replicas=1, candidates=snaps,
+                      prediction=prediction, state=AutoscaleState(1),
+                      now=0.0)
+    assert d.action == AUTOSCALE_UP
+    assert "brownout" in d.reason
+
+
+def test_decide_base_latency_slo_miss_is_not_scalable_overload():
+    """Capacity shrinks only the QUEUEING term: a fleet whose prefill
+    tail alone busts the TTFT SLO (a first-compile outlier pinning the
+    cumulative p99, or a model simply too slow for the target) must not
+    read as a permanent overload — scale-up could never fix it, and it
+    would also block every future scale-down."""
+    snaps = [("0", _snap(p99_prefill_ms=5000.0, queue_depth=0))]
+    model = _fitted_model(snaps)
+    prediction = model.predict(snaps, arrival_rps=0.1)
+    assert prediction.ttft_ms > 250.0  # the base alone busts the SLO
+    assert prediction.wait_ms < prediction.ttft_ms
+    policy = _policy(slo=SLOTargets(ttft_p99_ms=250.0))
+    overloaded, _why = policy.overloaded(prediction)
+    assert not overloaded
+    # with headroom sustained, the same fleet may still scale DOWN
+    state = AutoscaleState(target=2)
+    state.headroom_since = 0.0
+    snaps2 = [("0", _snap(p99_prefill_ms=5000.0)),
+              ("1", _snap(p99_prefill_ms=5000.0))]
+    d = policy.decide(live_replicas=2, candidates=snaps2,
+                      prediction=prediction, state=state, now=100.0)
+    assert d.action == AUTOSCALE_DOWN
+
+
+def test_decide_max_replicas_clamp_refuses_scale_up():
+    snaps = [("0", _snap(queue_depth=6))]
+    model = _fitted_model(snaps)
+    prediction = model.predict(snaps, arrival_rps=50.0)
+    policy = _policy(max_replicas=2)
+    d = policy.decide(live_replicas=2, candidates=snaps,
+                      prediction=prediction, state=AutoscaleState(2),
+                      now=0.0)
+    assert d.action == AUTOSCALE_HOLD and d.refused == AUTOSCALE_UP
+    assert "max_replicas" in d.reason
+
+
+def test_decide_cooldown_refuses_scale_up():
+    snaps = [("0", _snap(queue_depth=6))]
+    model = _fitted_model(snaps)
+    prediction = model.predict(snaps, arrival_rps=50.0)
+    policy = _policy(cooldown_secs=30.0)
+    state = AutoscaleState(target=1)
+    state.last_scale_at = 90.0
+    d = policy.decide(live_replicas=1, candidates=snaps,
+                      prediction=prediction, state=state, now=100.0)
+    assert d.action == AUTOSCALE_HOLD and d.refused == AUTOSCALE_UP
+    assert "cooldown" in d.reason
+    # the cooldown elapses; the same pressure now scales
+    d = policy.decide(live_replicas=1, candidates=snaps,
+                      prediction=prediction, state=state, now=121.0)
+    assert d.action == AUTOSCALE_UP
+
+
+def test_decide_flap_budget_refuses_direction_reversal():
+    snaps = [("0", _snap(queue_depth=6))]
+    model = _fitted_model(snaps)
+    prediction = model.predict(snaps, arrival_rps=50.0)
+    policy = _policy(flap_budget=1, flap_window_secs=600.0,
+                     cooldown_secs=1.0)
+    state = AutoscaleState(target=1)
+    # up -> down already burned the window's one reversal; another
+    # up would be reversal #2
+    state.transitions = ((10.0, "up"), (20.0, "down"))
+    d = policy.decide(live_replicas=1, candidates=snaps,
+                      prediction=prediction, state=state, now=100.0)
+    assert d.action == AUTOSCALE_HOLD and d.refused == AUTOSCALE_UP
+    assert "flap budget" in d.reason
+    # once the old transitions age out of the window, pressure scales
+    d = policy.decide(live_replicas=1, candidates=snaps,
+                      prediction=prediction, state=state, now=700.0)
+    assert d.action == AUTOSCALE_UP
+
+
+def test_decide_sustained_headroom_scales_down_deterministic_victim():
+    snaps = [
+        ("0", _snap(queue_depth=0, active_slots=1)),
+        ("1", _snap(queue_depth=0, active_slots=0)),
+        ("as0", _snap(queue_depth=0, active_slots=0)),
+    ]
+    model = _fitted_model(snaps)
+    prediction = model.predict(snaps, arrival_rps=0.1)
+    policy = _policy(hysteresis_secs=60.0)
+    assert policy.has_headroom(prediction, live_replicas=3)
+    state = AutoscaleState(target=3)
+    state.headroom_since = 0.0
+    # hysteresis not yet served: hold
+    d = policy.decide(live_replicas=3, candidates=snaps,
+                      prediction=prediction, state=state, now=30.0)
+    assert d.action == AUTOSCALE_HOLD
+    # served: drain the least-loaded, ties to the LATEST-registered
+    d = policy.decide(live_replicas=3, candidates=snaps,
+                      prediction=prediction, state=state, now=61.0)
+    assert d.action == AUTOSCALE_DOWN
+    assert d.replica_id == "as0"
+
+
+def test_decide_min_replicas_clamp_refuses_scale_down():
+    snaps = [("0", _snap())]
+    model = _fitted_model(snaps)
+    prediction = model.predict(snaps, arrival_rps=0.0)
+    policy = _policy(min_replicas=1, hysteresis_secs=1.0)
+    state = AutoscaleState(target=1)
+    state.headroom_since = 0.0
+    d = policy.decide(live_replicas=1, candidates=snaps,
+                      prediction=prediction, state=state, now=10.0)
+    assert d.action == AUTOSCALE_HOLD and d.refused == AUTOSCALE_DOWN
+    assert "min_replicas" in d.reason
+    # min_replicas also kills the headroom predicate itself
+    assert not policy.has_headroom(prediction, live_replicas=1)
+
+
+def test_decide_reprovision_when_live_below_target_ignores_cooldown():
+    """Chaos took a replica: restoring the target is not a scaling
+    oscillation — the cooldown and flap clamps do not apply."""
+    snaps = [("0", _snap())]
+    model = _fitted_model(snaps)
+    prediction = model.predict(snaps, arrival_rps=0.0)
+    policy = _policy(cooldown_secs=3600.0, flap_budget=0)
+    state = AutoscaleState(target=2)
+    state.last_scale_at = 99.0  # cooldown would block a scale-up
+    d = policy.decide(live_replicas=1, candidates=snaps,
+                      prediction=prediction, state=state, now=100.0)
+    assert d.action == AUTOSCALE_REPROVISION
+    assert "below the target" in d.reason
+
+
+def test_decide_holds_while_op_in_flight():
+    snaps = [("0", _snap(queue_depth=6))]
+    model = _fitted_model(snaps)
+    prediction = model.predict(snaps, arrival_rps=50.0)
+    state = AutoscaleState(target=1)
+    state.op_in_flight = True
+    d = _policy().decide(live_replicas=1, candidates=snaps,
+                         prediction=prediction, state=state, now=0.0)
+    assert d.action == AUTOSCALE_HOLD and "in flight" in d.reason
+
+
+# ---------------------------------------------------------------------------
+# stub replicas for lifecycle tests (the router contract, no engines)
+# ---------------------------------------------------------------------------
+class _StubHandle:
+    def __init__(self, prompt_tokens):
+        self.prompt_tokens = list(prompt_tokens)
+        self.tokens = []
+        self.finish_reason = None
+        self.first_token_at = None
+        self._done = threading.Event()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def _finish(self, tokens, reason):
+        self.tokens = list(tokens)
+        self.finish_reason = reason
+        self.first_token_at = time.monotonic()
+        self._done.set()
+
+
+class _StubReplica(ReplicaBase):
+    def __init__(self, replica_id, snapshot=None, autofinish=(1, 2, 3)):
+        super().__init__(replica_id)
+        self.snap = _snap(**(snapshot or {}))
+        self.autofinish = list(autofinish)
+        self.failed = False
+        self.adapters_loaded = []
+        self.submit_calls = 0
+
+    def start(self):
+        return self
+
+    def submit(self, prompt_tokens, **kwargs):
+        self.submit_calls += 1
+        handle = _StubHandle(prompt_tokens)
+        handle._finish(self.autofinish, "max_new_tokens")
+        return handle
+
+    def load_adapter(self, name, **kwargs):
+        self.adapters_loaded.append((name, dict(kwargs)))
+        return len(self.adapters_loaded)
+
+    def unload_adapter(self, name):
+        return 0
+
+    def _snapshot_now(self):
+        snap = dict(self.snap)
+        snap["failed"] = self.failed
+        snap["alive"] = snap["alive"] and not self.failed
+        return snap
+
+    def drain(self):
+        pass
+
+    def restart(self):
+        self.failed = False
+        return self
+
+    def shutdown(self):
+        pass
+
+
+class _StubProvider:
+    name = "stub"
+
+    def __init__(self):
+        self.spawned = []
+        self.retired = []
+
+    def spawn(self, existing_ids):
+        rid = f"as{len(self.spawned)}"
+        while rid in set(existing_ids):
+            rid += "x"
+        replica = _StubReplica(rid).start()
+        self.spawned.append(replica)
+        return replica
+
+    def retire(self, replica):
+        self.retired.append(replica.replica_id)
+        replica.shutdown()
+
+
+def _wait(predicate, timeout=30.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# router elasticity: add/remove, probation, gauge retirement
+# ---------------------------------------------------------------------------
+def test_add_replica_joins_behind_half_open_probe():
+    router = FleetRouter(
+        [_StubReplica("0")], monitor_interval=0.002,
+    ).start()
+    try:
+        new = _StubReplica("as0")
+        router.add_replica(new, probation=True)
+        assert "as0" in router.live_replica_ids()
+        # probation: OPEN with an elapsed window — a placement candidate
+        # whose first submission is the single half-open probe
+        assert router.breaker_state("as0") == BREAKER_OPEN
+        probes_before = router.metrics.counter(
+            "fleet/breaker_probes"
+        ).value
+        # drain the incumbent so placement MUST pick the probationer
+        router.drain("0")
+        fr = router.submit([5], max_new_tokens=3)
+        assert fr.result(10.0) == [1, 2, 3]
+        assert router.breaker_state("as0") == BREAKER_CLOSED
+        assert router.metrics.counter(
+            "fleet/breaker_probes"
+        ).value == probes_before + 1
+        assert new.submit_calls == 1
+    finally:
+        router.shutdown()
+
+
+def test_add_replica_replays_fleet_adapter_registry():
+    r0 = _StubReplica("0")
+    router = FleetRouter([r0], monitor_interval=0.002).start()
+    try:
+        router.load_adapter("tenant-a", load_dir="/ckpt/a")
+        new = _StubReplica("as0")
+        router.add_replica(new)
+        assert new.adapters_loaded == [
+            ("tenant-a", {"load_dir": "/ckpt/a"})
+        ]
+    finally:
+        router.shutdown()
+
+
+def test_add_replica_rejects_duplicate_id():
+    router = FleetRouter([_StubReplica("0")], monitor_interval=0.002
+                         ).start()
+    try:
+        with pytest.raises(ValueError, match="already registered"):
+            router.add_replica(_StubReplica("0"))
+    finally:
+        router.shutdown()
+
+
+def test_remove_replica_refuses_to_empty_the_fleet():
+    router = FleetRouter([_StubReplica("0")], monitor_interval=0.002
+                         ).start()
+    try:
+        with pytest.raises(RuntimeError, match="last live replica"):
+            router.remove_replica("0")
+    finally:
+        router.shutdown()
+
+
+def test_replica_gauges_retired_on_scale_down_and_eviction():
+    """The satellite pin: a dead replica's fleet/replica{i}/* gauges
+    must not keep exporting their stale last values."""
+    r0 = _StubReplica("0")
+    r1 = _StubReplica("1", snapshot={"queue_depth": 5})
+    router = FleetRouter([r0, r1], monitor_interval=0.002).start()
+    try:
+        router.refresh_telemetry()
+        snap = router.metrics.snapshot()
+        assert snap["fleet/replica1/queue_depth"] == 5
+        # scale-down: gauges retired with the replica (the stub reports
+        # a non-empty queue forever, so cap the drain wait — the pin
+        # here is gauge retirement, not the drain barrier)
+        router.remove_replica("1", wait_idle_timeout=0.2)
+        snap = router.metrics.snapshot()
+        stale = [k for k in snap if k.startswith("fleet/replica1/")]
+        assert stale == [], stale
+        # eviction: same contract (the monitor's failed-replica sweep)
+        new = _StubReplica("as0", snapshot={"queue_depth": 7})
+        router.add_replica(new, probation=False)
+        router.refresh_telemetry()
+        assert router.metrics.snapshot()[
+            "fleet/replicaas0/queue_depth"
+        ] == 7
+        new.failed = True
+        assert _wait(lambda: "as0" in router.evicted_ids, timeout=10.0)
+        router.refresh_telemetry()
+        snap = router.metrics.snapshot()
+        stale = [k for k in snap if k.startswith("fleet/replicaas0/")]
+        assert stale == [], stale
+        # the aggregate fleet gauges survive and reflect the shrink
+        assert snap["fleet/replicas_total"] == 1
+    finally:
+        router.shutdown()
+
+
+def test_fleet_requests_shed_aggregate_gauge():
+    r0 = _StubReplica("0", snapshot={"requests_shed": 2.0})
+    r1 = _StubReplica("1", snapshot={"requests_shed": 3.0})
+    router = FleetRouter([r0, r1], monitor_interval=0.002).start()
+    try:
+        router.refresh_telemetry()
+        assert router.metrics.snapshot()["fleet/requests_shed"] == 5.0
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# eviction -> re-provision (the chaos-restoration loop)
+# ---------------------------------------------------------------------------
+def test_eviction_triggers_reprovision_to_target():
+    provider = _StubProvider()
+    autoscaler = Autoscaler(
+        provider, min_replicas=2, max_replicas=3, interval_secs=0.01,
+        cooldown_secs=3600.0,  # re-provision must not need the cooldown
+    )
+    router = FleetRouter(
+        [_StubReplica("0"), _StubReplica("1")],
+        monitor_interval=0.002, autoscaler=autoscaler,
+    ).start()
+    try:
+        assert autoscaler.state.target == 2
+        router._replicas["1"].failed = True
+        assert _wait(lambda: "1" in router.evicted_ids, timeout=10.0)
+        # live dropped to 1 < target 2: the autoscaler restores capacity
+        assert _wait(
+            lambda: len(router.live_replica_ids()) == 2, timeout=20.0
+        ), router.live_replica_ids()
+        assert provider.spawned, "no replacement was spawned"
+        # the executor counts the transition just after registration
+        assert _wait(
+            lambda: router.metrics.counter(
+                "fleet/autoscale_reprovisions"
+            ).value >= 1,
+            timeout=10.0,
+        )
+        # the replacement serves
+        fr = router.submit([9], max_new_tokens=3)
+        assert fr.result(10.0) == [1, 2, 3]
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end elasticity over REAL schedulers (jax-free host engines)
+# ---------------------------------------------------------------------------
+class _HostEngine:
+    """test_door's harness: real ContinuousBatchingScheduler, plain-
+    Python decode hooks paced by step_secs."""
+
+    prefill_len = 16
+    paged = False
+    speculative = False
+
+    def __init__(self, step_secs=0.02):
+        self.step_secs = float(step_secs)
+        self._last = {}
+        self.scheduler = None
+
+    def prefill_request(self, slot, prompt_tokens, temperature):
+        del temperature
+        first = (int(prompt_tokens[-1]) + 1) % 1000
+        self._last[slot] = first
+        return first
+
+    def decode_tokens(self, active_slots):
+        time.sleep(self.step_secs)
+        out = []
+        for slot in active_slots:
+            nxt = (self._last.get(slot, 0) + 1) % 1000
+            self._last[slot] = nxt
+            out.append(nxt)
+        return out
+
+    def submit(self, prompt_tokens, **kwargs):
+        return self.scheduler.submit(prompt_tokens, **kwargs)
+
+    def load_snapshot(self):
+        return self.scheduler.load_snapshot()
+
+    def serve_forever(self):
+        self.scheduler.serve_forever(idle_sleep=0.001)
+
+    def close(self):
+        self.scheduler.shutdown()
+
+
+def _make_engine(step_secs=0.02, num_slots=2):
+    engine = _HostEngine(step_secs=step_secs)
+    engine.scheduler = ContinuousBatchingScheduler(
+        engine, num_slots=num_slots, max_seq_len=512, queue_depth=64,
+        queue_timeout=0.0, eos_token_id=None, temperature=0.0,
+        registry=MetricsRegistry(),
+    )
+    return engine
+
+
+def _expected(prompt, n):
+    base = int(prompt[-1])
+    return [(base + i + 1) % 1000 for i in range(n)]
+
+
+def test_surge_scales_up_then_idle_scales_down_end_to_end():
+    """The tentpole loop over real schedulers: a surge against one
+    replica grows the fleet to two (behind the probation probe) with
+    zero requests lost; the subsequent idle window drains the spawned
+    replica back out and retires its gauges."""
+    engines = []
+
+    def factory():
+        engine = _make_engine(step_secs=0.02, num_slots=2)
+        engines.append(engine)
+        return engine
+
+    provider = InProcessReplicaProvider(factory)
+    autoscaler = Autoscaler(
+        provider,
+        slo=SLOTargets(ttft_p99_ms=150.0, eval_window_secs=5.0),
+        min_replicas=1, max_replicas=2, cooldown_secs=0.2,
+        hysteresis_secs=0.3, flap_budget=8, interval_secs=0.02,
+        scale_up_utilization=0.5, scale_down_utilization=0.3,
+        drain_timeout_secs=10.0,
+    )
+    router = FleetRouter(
+        [InProcessReplica("0", factory)], monitor_interval=0.005,
+        autoscaler=autoscaler,
+    ).start()
+    try:
+        prompts = [[10 + i] for i in range(8)]
+        reqs = [router.submit(p, max_new_tokens=20) for p in prompts]
+        assert _wait(
+            lambda: len(router.live_replica_ids()) == 2, timeout=30.0
+        ), "the surge never scaled the fleet up"
+        assert _wait(
+            lambda: router.metrics.counter(
+                "fleet/autoscale_ups"
+            ).value >= 1,
+            timeout=10.0,
+        )
+        # the target tracks the executed transition (read promptly:
+        # the later idle window legitimately shrinks it back to 1)
+        assert _wait(lambda: autoscaler.state.target == 2, timeout=5.0)
+        # zero lost, bitwise-exact answers through the scale event
+        outs = [r.result(60.0) for r in reqs]
+        assert outs == [_expected(p, 20) for p in prompts]
+        snap = router.metrics.snapshot()
+        assert snap["fleet/requests_shed"] == 0.0
+        assert snap["fleet/requests_browned_out"] == 0.0
+        assert snap["fleet/slo_predicted_ttft_ms"] >= 0.0
+        # idle: sustained headroom drains the spawned replica back out
+        assert _wait(
+            lambda: len(router.live_replica_ids()) == 1, timeout=60.0
+        ), "idle never scaled the fleet down"
+        assert _wait(
+            lambda: router.metrics.counter(
+                "fleet/autoscale_downs"
+            ).value >= 1,
+            timeout=10.0,
+        )
+        retired = [
+            rid for rid in ("as0",) if rid not in router.replica_ids
+        ]
+        assert retired == ["as0"], router.replica_ids
+        snap = router.metrics.snapshot()
+        stale = [k for k in snap if k.startswith("fleet/replicaas0/")]
+        assert stale == [], stale
+        # one more request serves normally on the shrunken fleet
+        fr = router.submit([77], max_new_tokens=3)
+        assert fr.result(30.0) == _expected([77], 3)
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# node-agent elasticity: spawn/retire over a real control socket
+# ---------------------------------------------------------------------------
+def test_node_spawn_and_retire_ops_over_control_session():
+    node = NodeServer({
+        "node_id": "n0",
+        "replicas": {"r0": {"stub": {"delay_secs": 0.0}}},
+        "max_replicas": 2,
+        "lease_secs": 5.0, "resume_grace_secs": 5.0,
+    })
+    host, port = node.start()
+    try:
+        ctl = NodeControlClient((host, port), op_timeout=30.0)
+        info = ctl.node_info()
+        assert info["replicas"] == ["r0"]
+        # spawn from the node's template (r0's stub spec)
+        reply = ctl.spawn_replica("r1")
+        assert reply["replicas"] == ["r0", "r1"]
+        # the spawned replica serves real traffic over the data plane
+        replica = SocketReplica(
+            "n0:r1", (host, port), remote_name="r1", rpc_timeout=5.0,
+            registry=MetricsRegistry(),
+        ).start()
+        try:
+            req = replica.submit([30], max_new_tokens=3)
+            assert req.result(10.0) == [31, 32, 33]
+        finally:
+            replica.shutdown()
+        # duplicates refuse; the ceiling refuses
+        with pytest.raises(RuntimeError, match="already hosts"):
+            ctl.spawn_replica("r1")
+        with pytest.raises(RuntimeError, match="max_replicas"):
+            ctl.spawn_replica("r2")
+        # retire frees the engine and the roster
+        reply = ctl.retire_replica("r1")
+        assert reply["replicas"] == ["r0"]
+        with pytest.raises(RuntimeError, match="hosts no replica"):
+            ctl.retire_replica("r1")
+        # a control session cannot run engine ops
+        with pytest.raises(RuntimeError, match="control session"):
+            ctl._roundtrip({"op": "snapshot"})
+    finally:
+        node.shutdown()
+
+
+def test_socket_provider_spawns_on_least_loaded_reachable_node():
+    node = NodeServer({
+        "node_id": "n0",
+        "replicas": {"r0": {"stub": {"delay_secs": 0.0}}},
+    })
+    host, port = node.start()
+    try:
+        provider = SocketNodeProvider(
+            {"n0": {"address": f"{host}:{port}", "replicas": ["r0"]},
+             "dead": {"address": "127.0.0.1:9", "replicas": []}},
+            connect_timeout=1.0, connect_retries=1, spawn_timeout=30.0,
+            node_retry_secs=60.0, registry=MetricsRegistry(),
+        )
+        # "dead" hosts fewer replicas so it is tried first — the
+        # connect failure marks it and the spawn lands on n0
+        with pytest.raises(Exception):
+            provider.spawn(["n0:r0"])
+        replica = provider.spawn(["n0:r0"])
+        try:
+            assert replica.replica_id.startswith("n0:as")
+            req = replica.submit([40], max_new_tokens=2)
+            assert req.result(10.0) == [41, 42]
+        finally:
+            provider.retire(replica)
+        assert NodeControlClient((host, port)).node_info()[
+            "replicas"
+        ] == ["r0"]
+    finally:
+        node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# disabled config = zero-overhead passthrough
+# ---------------------------------------------------------------------------
+def test_disabled_autoscale_is_zero_overhead_passthrough():
+    before = {t.name for t in threading.enumerate()}
+    router = FleetRouter([_StubReplica("0")], monitor_interval=0.002
+                         ).start()
+    try:
+        assert router.autoscaler is None
+        # no autoscale thread exists anywhere in the process
+        new = {t.name for t in threading.enumerate()} - before
+        assert not any("autoscale" in n for n in new), new
+        # the slo/autoscale catalog streams exist but stay inert
+        snap = router.metrics.snapshot()
+        assert snap["fleet/autoscale_ups"] == 0
+        assert snap["fleet/slo_violations"] == 0
+    finally:
+        router.shutdown()
+
+
+def test_init_fleet_builds_autoscaler_only_when_enabled():
+    from deepspeed_tpu.serving import init_fleet
+
+    def factory():
+        return _make_engine(step_secs=0.0)
+
+    router = init_fleet(
+        engine_factory=factory,
+        config={"train_batch_size": 1,
+                "serving": {"replicas": 1}},
+    )
+    try:
+        assert router.autoscaler is None
+    finally:
+        router.shutdown()
+    router = init_fleet(
+        engine_factory=factory,
+        config={
+            "train_batch_size": 1,
+            "serving": {
+                "replicas": 1,
+                "slo": {"ttft_p99_ms": 500.0},
+                "autoscale": {"enabled": True, "max_replicas": 2,
+                              "interval_secs": 0.05},
+            },
+        },
+    )
+    try:
+        assert router.autoscaler is not None
+        assert router.autoscaler.policy.slo.ttft_p99_ms == 500.0
+        assert router.autoscaler.policy.max_replicas == 2
+        assert router.autoscaler.state.target == 1
+    finally:
+        router.shutdown()

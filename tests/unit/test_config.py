@@ -649,6 +649,51 @@ def test_serving_defaults():
     assert cfg.serving_cb_backoff_max_secs == 30.0
     assert cfg.serving_brownout_queue_ratio is None  # brownout off
     assert cfg.serving_brownout_max_new_tokens == 16
+    assert cfg.serving_http_auth_token is None  # open door
+    assert cfg.serving_slo_ttft_p99_ms is None  # no SLO targets
+    assert cfg.serving_slo_token_p99_ms is None
+    assert cfg.serving_slo_eval_window_secs == 60.0
+    assert cfg.serving_autoscale_enabled is False  # passthrough
+    assert cfg.serving_autoscale_min_replicas == 1
+    assert cfg.serving_autoscale_max_replicas == 4
+    assert cfg.serving_autoscale_cooldown_secs == 30.0
+    assert cfg.serving_autoscale_hysteresis_secs == 60.0
+    assert cfg.serving_autoscale_flap_budget == 4
+    assert cfg.serving_autoscale_flap_window_secs == 600.0
+    assert cfg.serving_autoscale_up_utilization == 0.85
+    assert cfg.serving_autoscale_down_utilization == 0.30
+    assert cfg.serving_autoscale_interval_secs == 1.0
+    assert cfg.serving_autoscale_drain_timeout_secs == 30.0
+
+
+def test_serving_slo_autoscale_auth_block_parses():
+    cfg = _srv({
+        "http": {"auth_token": "tok-123"},
+        "slo": {"ttft_p99_ms": 250, "token_p99_ms": 40,
+                "eval_window_secs": 30.0},
+        "autoscale": {
+            "enabled": True, "min_replicas": 2, "max_replicas": 8,
+            "cooldown_secs": 10.0, "hysteresis_secs": 20.0,
+            "flap_budget": 2, "flap_window_secs": 120.0,
+            "scale_up_utilization": 0.7, "scale_down_utilization": 0.2,
+            "interval_secs": 0.5, "drain_timeout_secs": 15.0,
+        },
+    })
+    assert cfg.serving_http_auth_token == "tok-123"
+    assert cfg.serving_slo_ttft_p99_ms == 250
+    assert cfg.serving_slo_token_p99_ms == 40
+    assert cfg.serving_slo_eval_window_secs == 30.0
+    assert cfg.serving_autoscale_enabled is True
+    assert cfg.serving_autoscale_min_replicas == 2
+    assert cfg.serving_autoscale_max_replicas == 8
+    assert cfg.serving_autoscale_cooldown_secs == 10.0
+    assert cfg.serving_autoscale_hysteresis_secs == 20.0
+    assert cfg.serving_autoscale_flap_budget == 2
+    assert cfg.serving_autoscale_flap_window_secs == 120.0
+    assert cfg.serving_autoscale_up_utilization == 0.7
+    assert cfg.serving_autoscale_down_utilization == 0.2
+    assert cfg.serving_autoscale_interval_secs == 0.5
+    assert cfg.serving_autoscale_drain_timeout_secs == 15.0
 
 
 def test_serving_valid_block_parses():
@@ -746,6 +791,35 @@ def test_serving_valid_block_parses():
     {"brownout": {"queue_ratio": 0.8}},           # >= default shed 0.75
     {"brownout": {"queue_ratio": 0.5, "max_new_tokens": 0}},
     {"shed_queue_ratio": 0.5, "brownout": {"queue_ratio": 0.5}},
+    {"http": {"auth_token": ""}},               # empty secret != open door
+    {"http": {"auth_token": 123}},
+    {"http": {"token": "x"}},                   # typo'd key
+    {"slo": {"ttft_p99": 250}},                 # typo'd key != no SLO
+    {"slo": {"ttft_p99_ms": 0}},
+    {"slo": {"ttft_p99_ms": -5}},
+    {"slo": {"ttft_p99_ms": True}},
+    {"slo": {"token_p99_ms": 0}},
+    {"slo": {"eval_window_secs": 0}},
+    {"slo": {"eval_window_secs": "soon"}},
+    {"autoscale": {"enable": True}},            # typo'd key != enabled
+    {"autoscale": {"enabled": "yes"}},
+    {"autoscale": {"min_replicas": 0}},
+    {"autoscale": {"min_replicas": True}},
+    {"autoscale": {"max_replicas": 0}},
+    {"autoscale": {"min_replicas": 3, "max_replicas": 2}},
+    {"autoscale": {"cooldown_secs": 0}},
+    {"autoscale": {"hysteresis_secs": -1}},
+    {"autoscale": {"flap_budget": -1}},
+    {"autoscale": {"flap_budget": 1.5}},
+    {"autoscale": {"flap_window_secs": 0}},
+    {"autoscale": {"scale_up_utilization": 0}},
+    {"autoscale": {"scale_up_utilization": 1.5}},
+    {"autoscale": {"scale_down_utilization": 0}},
+    # inverted bands would oscillate on every tick
+    {"autoscale": {"scale_up_utilization": 0.3,
+                   "scale_down_utilization": 0.5}},
+    {"autoscale": {"interval_secs": 0}},
+    {"autoscale": {"drain_timeout_secs": 0}},
 ])
 def test_serving_rejects(block):
     from deepspeed_tpu.config.config import DeepSpeedConfigError

@@ -132,12 +132,12 @@ def _events(buf):
     return out
 
 
-def _http_json(host, port, method, target, payload=None):
+def _http_json(host, port, method, target, payload=None, headers=None):
     import http.client
 
     conn = http.client.HTTPConnection(host, port, timeout=30.0)
     body = None if payload is None else json.dumps(payload)
-    conn.request(method, target, body)
+    conn.request(method, target, body, headers or {})
     resp = conn.getresponse()
     raw = resp.read()
     conn.close()
@@ -259,7 +259,10 @@ def test_rate_limited_tenant_gets_429_with_retry_after():
         })
         assert resp.status == 429
         assert out["reason"] == "rate_limit"
-        assert resp.getheader("Retry-After") == "1"
+        # the header carries the bucket's ACTUAL refill time (ceiled to
+        # whole seconds), not a constant: 1 token at 0.001/s is ~1000s
+        retry_after = int(resp.getheader("Retry-After"))
+        assert 900 <= retry_after <= 1000, retry_after
     finally:
         door.shutdown()
         router.shutdown()
@@ -433,3 +436,131 @@ def test_queued_cancel_never_takes_a_slot():
     assert runner.done and runner.finish_reason == "cancelled"
     assert sched.active_slots == []
     sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bearer auth (serving.http.auth_token): 401 on mismatch, probes exempt,
+# token never logged
+# ---------------------------------------------------------------------------
+def test_auth_token_gates_generate_but_not_probes(caplog):
+    import logging
+
+    router, _engines = _fleet(step_secs=0.0)
+    door = HTTPDoor(router, auth_token="s3kr1t-token")
+    host, port = door.start()
+    try:
+        with caplog.at_level(logging.DEBUG):
+            # no token -> 401 with the WWW-Authenticate challenge
+            resp, out = _http_json(host, port, "POST", "/v1/generate", {
+                "prompt": [1], "max_new_tokens": 1, "stream": False,
+            })
+            assert resp.status == 401
+            assert resp.getheader("WWW-Authenticate") == "Bearer"
+            # wrong token -> 401; wrong scheme -> 401
+            for header in (
+                {"Authorization": "Bearer wrong"},
+                {"Authorization": "Basic s3kr1t-token"},
+            ):
+                resp, _ = _http_json(
+                    host, port, "POST", "/v1/generate",
+                    {"prompt": [1], "max_new_tokens": 1, "stream": False},
+                    headers=header,
+                )
+                assert resp.status == 401, header
+            # right token -> served
+            resp, out = _http_json(
+                host, port, "POST", "/v1/generate",
+                {"prompt": [7], "max_new_tokens": 2, "stream": False},
+                headers={"Authorization": "Bearer s3kr1t-token"},
+            )
+            assert resp.status == 200
+            assert out["tokens"] == _expected([7], 2)
+            # probes stay open: external LBs carry no tenant credentials
+            resp, _ = _http_json(host, port, "GET", "/healthz")
+            assert resp.status == 200
+            resp, _ = _http_json(host, port, "GET", "/readyz")
+            assert resp.status == 200
+        # the secret must never reach a log line — not on the 401 paths,
+        # not on the accepted request
+        assert "s3kr1t-token" not in caplog.text
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_auth_token_never_logged_by_config_print(caplog):
+    import logging
+
+    from deepspeed_tpu.config.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig(None, param_dict={
+        "train_batch_size": 1,
+        "serving": {"http": {"auth_token": "print-me-not"}},
+    }, world_size=1)
+    assert cfg.serving_http_auth_token == "print-me-not"
+    with caplog.at_level(logging.DEBUG):
+        cfg.print()
+    assert "print-me-not" not in caplog.text
+
+
+# ---------------------------------------------------------------------------
+# GET /readyz: readiness (take traffic?) vs /healthz liveness
+# ---------------------------------------------------------------------------
+def test_readyz_503_while_draining_healthz_stays_200():
+    router, _engines = _fleet(step_secs=0.0)
+    door = HTTPDoor(router)
+    host, port = door.start()
+    try:
+        resp, out = _http_json(host, port, "GET", "/readyz")
+        assert resp.status == 200 and out["ready"] is True
+        router.drain_fleet()
+        resp, out = _http_json(host, port, "GET", "/readyz")
+        assert resp.status == 503
+        assert "draining" in out["reasons"]
+        # liveness is a different question: the process still serves
+        resp, _ = _http_json(host, port, "GET", "/healthz")
+        assert resp.status == 200
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_readyz_503_under_brownout():
+    router, _engines = _fleet(step_secs=0.0, brownout_queue_ratio=0.5)
+    door = HTTPDoor(router)
+    host, port = door.start()
+    try:
+        resp, out = _http_json(host, port, "GET", "/readyz")
+        assert resp.status == 200, out
+        router._update_brownout(0.9)  # force the band (fill 0.9 >= 0.5)
+        resp, out = _http_json(host, port, "GET", "/readyz")
+        assert resp.status == 503
+        assert "brownout" in out["reasons"]
+        router._update_brownout(0.0)
+        resp, out = _http_json(host, port, "GET", "/readyz")
+        assert resp.status == 200, out
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_429_retry_after_tracks_bucket_refill_rate():
+    # 1-token burst refilling at 0.5/s: the second request's Retry-After
+    # must say ~2s (ceil of the bucket's real refill time), not 1
+    router, _engines = _fleet(step_secs=0.005, rate_limit=(0.5, 1))
+    door = HTTPDoor(router)
+    host, port = door.start()
+    try:
+        resp, _ = _http_json(host, port, "POST", "/v1/generate", {
+            "prompt": [1], "max_new_tokens": 1, "stream": False,
+        })
+        assert resp.status == 200
+        resp, out = _http_json(host, port, "POST", "/v1/generate", {
+            "prompt": [1], "max_new_tokens": 1, "stream": False,
+        })
+        assert resp.status == 429
+        assert out["reason"] == "rate_limit"
+        assert resp.getheader("Retry-After") == "2"
+    finally:
+        door.shutdown()
+        router.shutdown()
