@@ -2154,17 +2154,21 @@ def smoke_chaos_fleet():
 
 
 def _launch_node(node_id, engine_spec, replicas=("r0",), lease_secs=10.0,
-                 resume_grace_secs=10.0):
+                 resume_grace_secs=10.0, config=None):
     """Spawn one ``python -m deepspeed_tpu.serving.node`` subprocess and
     block on its stdout 'listening' announcement (printed only after
     every engine is built — a connecting client never races an
-    initializing model). Returns (proc, (host, port))."""
+    initializing model). ``config`` is the node-level spec config block
+    (e.g. a telemetry.tracing arm for the hub's drain_telemetry pulls).
+    Returns (proc, (host, port))."""
     spec = {
         "node_id": node_id,
         "replicas": {name: engine_spec for name in replicas},
         "lease_secs": lease_secs,
         "resume_grace_secs": resume_grace_secs,
     }
+    if config is not None:
+        spec["config"] = config
     proc = subprocess.Popen(
         [sys.executable, "-m", "deepspeed_tpu.serving.node",
          "--spec", json.dumps(spec), "--port", "0"],
@@ -2513,6 +2517,26 @@ def smoke_autoscale():
         probe = router.submit(prompts[0], max_new_tokens=24)
         assert probe.result(60.0) == reference[0]
         extras["scale_down_secs"] = round(time.monotonic() - t1, 2)
+        # the SLO trajectory rides in the attempt record: BENCH_r*.json
+        # carries how close the fleet ran to its error budget and what
+        # the autoscaler actually decided, not just that it scaled
+        snap = reg.snapshot()
+        extras["slo_ttft_p99_ms"] = snap["fleet/slo_ttft_p99_ms"]
+        extras["slo_utilization"] = round(
+            snap["fleet/slo_utilization"], 3
+        )
+        extras["slo_error_budget_remaining"] = round(
+            snap["fleet/slo_error_budget_remaining"], 3
+        )
+        extras["slo_violations"] = int(snap["fleet/slo_violations"])
+        extras["slo_samples"] = int(snap["fleet/slo_samples"])
+        extras["autoscale_decisions"] = {
+            "ups": int(snap["fleet/autoscale_ups"]),
+            "downs": int(snap["fleet/autoscale_downs"]),
+            "reprovisions": int(snap["fleet/autoscale_reprovisions"]),
+            "refusals": int(snap["fleet/autoscale_refusals"]),
+            "failures": int(snap["fleet/autoscale_failures"]),
+        }
     finally:
         router.shutdown()
         proc_a.kill()
@@ -3069,6 +3093,222 @@ def smoke_trace():
     }))
 
 
+def smoke_obs():
+    """CI fast path (``python bench.py --smoke-obs``): the fleet
+    observability plane end to end (docs/observability.md "fleet-wide
+    view") over a REAL 2-node TCP stub fleet. Pins, in order:
+
+      1. Fleet-aggregated scrape: one ``GET /metrics`` off the door
+         answers with the router's own series AND a REMOTE node's
+         ``infer/*`` engine series carrying ``{node, replica}`` labels
+         — the hub's metrics_snapshot control op crossed the wire.
+      2. Cross-host traces: a remote replica's sampled ``node.submit``
+         spans and a forced flight dump land in the ROUTER-side
+         telemetry directory as one loadable Chrome trace (remote pids
+         present, the fleet flight file carries both nodes' rings).
+      3. Burn-rate + alerting: under injected SLO-violating load the
+         ``/statz`` fast burn window moves, the ``slo_burn`` alert
+         fires its rising edge (fleet/alerts_slo_burn counter) and the
+         hub.alert instant event is in the flight ring.
+      4. Zero overhead when disabled: a hub-less fleet runs no hub
+         threads and the door 404s /metrics, /statz and /dashboard.
+
+    Prints one JSON line and exits non-zero on any failed check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import http.client
+    import re
+    import shutil
+    import tempfile
+    import threading
+
+    import deepspeed_tpu
+    from deepspeed_tpu.serving import HTTPDoor
+    from deepspeed_tpu.telemetry.tracing import load_chrome_trace
+
+    tmp = tempfile.mkdtemp(prefix="ds_smoke_obs_")
+    extras = {}
+
+    def wait_for(predicate, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(0.02)
+        assert predicate(), what
+
+    def get(host, port, path):
+        conn = http.client.HTTPConnection(host, port, timeout=30.0)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read().decode()
+        finally:
+            conn.close()
+
+    # ---- a 2-node stub fleet with node-side tracing armed -------------
+    node_cfg = {
+        "telemetry": {"tracing": {"enabled": True, "sample_rate": 1.0}},
+    }
+    stub_spec = {"stub": {"delay_secs": 0.02}}
+    proc_a, addr_a = _launch_node("obs-a", stub_spec, config=node_cfg)
+    proc_b, addr_b = _launch_node("obs-b", stub_spec, config=node_cfg)
+    nodes = {
+        "obs-a": {"address": f"{addr_a[0]}:{addr_a[1]}",
+                  "replicas": ["r0"]},
+        "obs-b": {"address": f"{addr_b[0]}:{addr_b[1]}",
+                  "replicas": ["r0"]},
+    }
+    router = deepspeed_tpu.init_fleet(
+        nodes=nodes,
+        config={
+            "serving": {
+                "backend": "socket",
+                # an unmeetable TTFT target: every completion tick is
+                # an SLO violation, so the burn windows saturate fast
+                "slo": {"ttft_p99_ms": 0.001, "eval_window_secs": 2.0},
+                # min == max: SLO accounting runs every tick but the
+                # fleet never actually scales under the injected burn
+                "autoscale": {"enabled": True, "min_replicas": 2,
+                              "max_replicas": 2, "interval_secs": 0.05,
+                              "cooldown_secs": 3600.0},
+                "hub": {"enabled": True, "interval_secs": 0.1,
+                        "drain_interval_secs": 3600.0,
+                        "alerts": {"fast_window_secs": 1.0,
+                                   "slow_window_secs": 2.0}},
+            },
+            "telemetry": {
+                "enabled": True,
+                "output_path": os.path.join(tmp, "telemetry"),
+                "job_name": "smoke_obs",
+                "watchdog": {"enabled": False},
+                "tracing": {"enabled": True, "sample_rate": 1.0},
+            },
+        },
+    )
+    door = HTTPDoor(router)
+    host, port = door.start()
+    try:
+        # ---- SLO-violating load until the alert's rising edge ---------
+        t0 = time.monotonic()
+        submitted = 0
+        alerts = router.metrics.counter("fleet/alerts_slo_burn")
+        while (
+            alerts.value < 1 and time.monotonic() - t0 < 60.0
+        ):
+            reqs = [router.submit([7 + i], max_new_tokens=2)
+                    for i in range(4)]
+            for r in reqs:
+                r.result(30.0)
+            submitted += len(reqs)
+        assert alerts.value >= 1, (
+            "the slo_burn alert never fired under all-violating load"
+        )
+        extras["alert_after_secs"] = round(time.monotonic() - t0, 2)
+        extras["requests_driven"] = submitted
+
+        # ---- pin 1: one scrape, fleet-aggregated, {node,replica} ------
+        wait_for(
+            lambda: router.hub.statz()["nodes_up"] == 2, 30.0,
+            "the hub never scraped both nodes",
+        )
+        status, body = get(host, port, "/metrics")
+        assert status == 200, (status, body[:200])
+        remote = [
+            line for line in body.splitlines()
+            if line.startswith("infer_")
+            and 'node="obs-' in line and 'replica="r0"' in line
+        ]
+        assert remote, "no remote infer_* series on the /metrics scrape"
+        assert any('node="obs-b"' in line for line in remote), (
+            "the second node's engine series never aggregated"
+        )
+        # the router's own unlabeled series share the same scrape
+        assert re.search(r"^fleet_requests_completed ", body, re.M), (
+            "the router's local series are missing from /metrics"
+        )
+        extras["remote_series_scraped"] = len(remote)
+
+        # ---- pin 3: /statz burn window moved + alert is active --------
+        status, body = get(host, port, "/statz")
+        assert status == 200
+        statz = json.loads(body)
+        fast = statz["windows"]["1s"]
+        assert fast["slo_samples"] and fast["slo_samples"] > 0, fast
+        assert fast["burn_rate"] and fast["burn_rate"] > 1.0, fast
+        assert "slo_burn" in statz["alerts"]["active"], statz["alerts"]
+        assert statz["fleet"]["fleet/alerts_slo_burn"] >= 1
+        extras["fast_burn_rate"] = round(fast["burn_rate"], 1)
+
+        status, body = get(host, port, "/dashboard")
+        assert status == 200
+        assert "<html" in body and "EventSource" in body
+
+        # ---- pin 2: remote spans + fleet flight dump come home --------
+        spans, dump_path = router.hub.drain_once(
+            flight=True, reason="smoke"
+        )
+        assert spans > 0, "no remote spans came home on drain_telemetry"
+        assert dump_path and os.path.exists(dump_path)
+        with open(dump_path) as f:
+            flight = json.load(f)
+        flight_names = {e["name"] for e in flight["traceEvents"]}
+        assert "hub.alert" in flight_names, sorted(flight_names)
+        assert "node.flight_drain" in flight_names, sorted(flight_names)
+        drained_nodes = {
+            e["args"].get("node") for e in flight["traceEvents"]
+            if e["name"] == "node.flight_drain"
+        }
+        assert drained_nodes == {"obs-a", "obs-b"}, drained_nodes
+        extras["remote_spans_ingested"] = spans
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+    # one loadable router-side Chrome trace covers the whole fleet
+    trace_path = os.path.join(tmp, "telemetry", "smoke_obs", "trace.json")
+    events = load_chrome_trace(trace_path)
+    node_submits = [e for e in events if e["name"] == "node.submit"]
+    assert node_submits, "no remote node.submit spans in the fleet trace"
+    assert {e["args"]["node"] for e in node_submits} == {"obs-a", "obs-b"}
+    assert {e["pid"] for e in node_submits} & (
+        {e["pid"] for e in events if e["name"] == "fleet.request"}
+    ) == set(), "remote spans carry the router's pid — not cross-host"
+    extras["trace_spans"] = len(events)
+    extras["trace_pids"] = len({e["pid"] for e in events})
+
+    # ---- pin 4: hub disabled = zero threads, zero routes --------------
+    router2 = deepspeed_tpu.init_fleet(nodes=nodes, config={
+        "serving": {"backend": "socket"},
+    })
+    door2 = HTTPDoor(router2)
+    host2, port2 = door2.start()
+    try:
+        assert router2.hub is None
+        hub_threads = [t.name for t in threading.enumerate()
+                       if t.name.startswith("ds-hub")]
+        assert not hub_threads, hub_threads
+        for path in ("/metrics", "/statz", "/dashboard"):
+            status, _body = get(host2, port2, path)
+            assert status == 404, (path, status)
+        # the fleet itself still serves
+        assert len(router2.submit([3], max_new_tokens=2).result(30.0)) == 2
+    finally:
+        door2.shutdown()
+        router2.shutdown()
+        for proc in (proc_a, proc_b):
+            proc.kill()
+            proc.wait(30)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "smoke_obs",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": extras,
+    }))
+
+
 def main():
     if "--smoke" in sys.argv:
         smoke()
@@ -3105,6 +3345,9 @@ def main():
         return
     if "--smoke-door" in sys.argv:
         smoke_door()
+        return
+    if "--smoke-obs" in sys.argv:
+        smoke_obs()
         return
     if "--smoke-chaos" in sys.argv:
         smoke_chaos()

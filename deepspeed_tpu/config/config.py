@@ -765,6 +765,64 @@ class DeepSpeedConfig:
             asc_dict, C.SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS,
             C.SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS_DEFAULT,
         )
+        hub_dict = get_dict_param(srv_dict, C.SERVING_HUB)
+        self.serving_hub_enabled = get_scalar_param(
+            hub_dict, C.SERVING_HUB_ENABLED,
+            C.SERVING_HUB_ENABLED_DEFAULT,
+        )
+        self.serving_hub_interval_secs = get_scalar_param(
+            hub_dict, C.SERVING_HUB_INTERVAL_SECS,
+            C.SERVING_HUB_INTERVAL_SECS_DEFAULT,
+        )
+        self.serving_hub_retention_points = get_scalar_param(
+            hub_dict, C.SERVING_HUB_RETENTION_POINTS,
+            C.SERVING_HUB_RETENTION_POINTS_DEFAULT,
+        )
+        self.serving_hub_drain_interval_secs = get_scalar_param(
+            hub_dict, C.SERVING_HUB_DRAIN_INTERVAL_SECS,
+            C.SERVING_HUB_DRAIN_INTERVAL_SECS_DEFAULT,
+        )
+        self.serving_hub_op_timeout_secs = get_scalar_param(
+            hub_dict, C.SERVING_HUB_OP_TIMEOUT_SECS,
+            C.SERVING_HUB_OP_TIMEOUT_SECS_DEFAULT,
+        )
+        self.serving_hub_node_backoff_secs = get_scalar_param(
+            hub_dict, C.SERVING_HUB_NODE_BACKOFF_SECS,
+            C.SERVING_HUB_NODE_BACKOFF_SECS_DEFAULT,
+        )
+        self.serving_hub_auth_exempt = tuple(get_scalar_param(
+            hub_dict, C.SERVING_HUB_AUTH_EXEMPT,
+            C.SERVING_HUB_AUTH_EXEMPT_DEFAULT,
+        ) or ())
+        hub_alerts = get_dict_param(hub_dict, C.SERVING_HUB_ALERTS)
+        self.serving_hub_alerts_slo_target = get_scalar_param(
+            hub_alerts, C.SERVING_HUB_ALERTS_SLO_TARGET,
+            C.SERVING_HUB_ALERTS_SLO_TARGET_DEFAULT,
+        )
+        self.serving_hub_alerts_fast_window_secs = get_scalar_param(
+            hub_alerts, C.SERVING_HUB_ALERTS_FAST_WINDOW_SECS,
+            C.SERVING_HUB_ALERTS_FAST_WINDOW_SECS_DEFAULT,
+        )
+        self.serving_hub_alerts_slow_window_secs = get_scalar_param(
+            hub_alerts, C.SERVING_HUB_ALERTS_SLOW_WINDOW_SECS,
+            C.SERVING_HUB_ALERTS_SLOW_WINDOW_SECS_DEFAULT,
+        )
+        self.serving_hub_alerts_fast_burn = get_scalar_param(
+            hub_alerts, C.SERVING_HUB_ALERTS_FAST_BURN,
+            C.SERVING_HUB_ALERTS_FAST_BURN_DEFAULT,
+        )
+        self.serving_hub_alerts_slow_burn = get_scalar_param(
+            hub_alerts, C.SERVING_HUB_ALERTS_SLOW_BURN,
+            C.SERVING_HUB_ALERTS_SLOW_BURN_DEFAULT,
+        )
+        self.serving_hub_alerts_breaker_flood = get_scalar_param(
+            hub_alerts, C.SERVING_HUB_ALERTS_BREAKER_FLOOD,
+            C.SERVING_HUB_ALERTS_BREAKER_FLOOD_DEFAULT,
+        )
+        self.serving_hub_alerts_suppressed_growth = get_scalar_param(
+            hub_alerts, C.SERVING_HUB_ALERTS_SUPPRESSED_GROWTH,
+            C.SERVING_HUB_ALERTS_SUPPRESSED_GROWTH_DEFAULT,
+        )
 
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
@@ -2118,6 +2176,145 @@ class DeepSpeedConfig:
                 f"{asc}.{C.SERVING_AUTOSCALE_DOWN_UTILIZATION} ({down!r}) "
                 f"must be below {C.SERVING_AUTOSCALE_UP_UTILIZATION} "
                 f"({up!r}) — the bands must not overlap"
+            )
+        hub = f"{C.SERVING}.{C.SERVING_HUB}"
+        hub_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING), C.SERVING_HUB
+        )
+        valid_hub = {
+            C.SERVING_HUB_ENABLED, C.SERVING_HUB_INTERVAL_SECS,
+            C.SERVING_HUB_RETENTION_POINTS,
+            C.SERVING_HUB_DRAIN_INTERVAL_SECS,
+            C.SERVING_HUB_OP_TIMEOUT_SECS,
+            C.SERVING_HUB_NODE_BACKOFF_SECS,
+            C.SERVING_HUB_AUTH_EXEMPT, C.SERVING_HUB_ALERTS,
+        }
+        unknown = set(hub_dict) - valid_hub
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"{hub}: unknown keys {sorted(unknown)}; valid: "
+                f"{sorted(valid_hub)}"
+            )
+        if not isinstance(self.serving_hub_enabled, bool):
+            raise DeepSpeedConfigError(
+                f"{hub}.{C.SERVING_HUB_ENABLED} must be a boolean, got "
+                f"{self.serving_hub_enabled!r}"
+            )
+        for key, value in (
+            (C.SERVING_HUB_INTERVAL_SECS, self.serving_hub_interval_secs),
+            (C.SERVING_HUB_OP_TIMEOUT_SECS,
+             self.serving_hub_op_timeout_secs),
+        ):
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or value <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{hub}.{key} must be a number > 0, got {value!r}"
+                )
+        for key, value in (
+            (C.SERVING_HUB_DRAIN_INTERVAL_SECS,
+             self.serving_hub_drain_interval_secs),
+            (C.SERVING_HUB_NODE_BACKOFF_SECS,
+             self.serving_hub_node_backoff_secs),
+        ):
+            # 0 is meaningful: drain on every tick / no scrape backoff
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or value < 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{hub}.{key} must be a number >= 0, got {value!r}"
+                )
+        retention = self.serving_hub_retention_points
+        if (
+            not isinstance(retention, int) or isinstance(retention, bool)
+            or retention < 2
+        ):
+            raise DeepSpeedConfigError(
+                f"{hub}.{C.SERVING_HUB_RETENTION_POINTS} must be an "
+                f"integer >= 2 (window queries need two points), got "
+                f"{retention!r}"
+            )
+        exempt_raw = hub_dict.get(
+            C.SERVING_HUB_AUTH_EXEMPT, C.SERVING_HUB_AUTH_EXEMPT_DEFAULT
+        )
+        if not isinstance(exempt_raw, (list, tuple)) or any(
+            not isinstance(p, str) for p in exempt_raw
+        ):
+            raise DeepSpeedConfigError(
+                f"{hub}.{C.SERVING_HUB_AUTH_EXEMPT} must be a list of "
+                f"path strings, got {exempt_raw!r}"
+            )
+        bad = set(exempt_raw) - set(C.SERVING_HUB_VALID_AUTH_EXEMPT)
+        if bad:
+            # only hub-served paths may be exempted: a typo here must
+            # not silently leave /v1/generate behind the token while the
+            # operator believes it opened a metrics path
+            raise DeepSpeedConfigError(
+                f"{hub}.{C.SERVING_HUB_AUTH_EXEMPT}: unknown paths "
+                f"{sorted(bad)}; valid: "
+                f"{list(C.SERVING_HUB_VALID_AUTH_EXEMPT)}"
+            )
+        alerts = f"{hub}.{C.SERVING_HUB_ALERTS}"
+        alerts_dict = get_dict_param(hub_dict, C.SERVING_HUB_ALERTS)
+        valid_alerts = {
+            C.SERVING_HUB_ALERTS_SLO_TARGET,
+            C.SERVING_HUB_ALERTS_FAST_WINDOW_SECS,
+            C.SERVING_HUB_ALERTS_SLOW_WINDOW_SECS,
+            C.SERVING_HUB_ALERTS_FAST_BURN,
+            C.SERVING_HUB_ALERTS_SLOW_BURN,
+            C.SERVING_HUB_ALERTS_BREAKER_FLOOD,
+            C.SERVING_HUB_ALERTS_SUPPRESSED_GROWTH,
+        }
+        unknown = set(alerts_dict) - valid_alerts
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"{alerts}: unknown keys {sorted(unknown)}; valid: "
+                f"{sorted(valid_alerts)}"
+            )
+        target = self.serving_hub_alerts_slo_target
+        if (
+            not isinstance(target, (int, float))
+            or isinstance(target, bool)
+            or not 0 < target < 1
+        ):
+            raise DeepSpeedConfigError(
+                f"{alerts}.{C.SERVING_HUB_ALERTS_SLO_TARGET} must be a "
+                f"number in (0, 1), got {target!r}"
+            )
+        for key, value in (
+            (C.SERVING_HUB_ALERTS_FAST_WINDOW_SECS,
+             self.serving_hub_alerts_fast_window_secs),
+            (C.SERVING_HUB_ALERTS_SLOW_WINDOW_SECS,
+             self.serving_hub_alerts_slow_window_secs),
+            (C.SERVING_HUB_ALERTS_FAST_BURN,
+             self.serving_hub_alerts_fast_burn),
+            (C.SERVING_HUB_ALERTS_SLOW_BURN,
+             self.serving_hub_alerts_slow_burn),
+            (C.SERVING_HUB_ALERTS_BREAKER_FLOOD,
+             self.serving_hub_alerts_breaker_flood),
+            (C.SERVING_HUB_ALERTS_SUPPRESSED_GROWTH,
+             self.serving_hub_alerts_suppressed_growth),
+        ):
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool)
+                or value <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{alerts}.{key} must be a number > 0, got {value!r}"
+                )
+        if (
+            self.serving_hub_alerts_fast_window_secs
+            >= self.serving_hub_alerts_slow_window_secs
+        ):
+            raise DeepSpeedConfigError(
+                f"{alerts}.{C.SERVING_HUB_ALERTS_FAST_WINDOW_SECS} must "
+                f"be below {C.SERVING_HUB_ALERTS_SLOW_WINDOW_SECS} — the "
+                f"multiwindow burn rule needs a short and a long window"
             )
 
     def _do_warning_check(self):

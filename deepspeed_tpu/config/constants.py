@@ -833,6 +833,56 @@ SERVING_AUTOSCALE_INTERVAL_SECS_DEFAULT = 1.0
 SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS = "drain_timeout_secs"
 SERVING_AUTOSCALE_DRAIN_TIMEOUT_SECS_DEFAULT = 30.0
 
+# "hub": the fleet observability plane (telemetry/hub.py,
+# docs/observability.md "fleet-wide view") — the router-side
+# TelemetryHub scrapes every node agent's registries over the
+# metrics_snapshot control op on this cadence, retains each series in a
+# fixed-size time-series ring, pulls sampled spans / flight rings home
+# over drain_telemetry, evaluates the alert rules, and serves
+# /metrics //statz //dashboard on the HTTP door. Disabled (the default)
+# = zero-overhead passthrough: no hub object, no threads, the door
+# routes 404.
+SERVING_HUB = "hub"
+SERVING_HUB_ENABLED = "enabled"
+SERVING_HUB_ENABLED_DEFAULT = False
+SERVING_HUB_INTERVAL_SECS = "interval_secs"
+SERVING_HUB_INTERVAL_SECS_DEFAULT = 2.0
+SERVING_HUB_RETENTION_POINTS = "retention_points"
+SERVING_HUB_RETENTION_POINTS_DEFAULT = 512
+SERVING_HUB_DRAIN_INTERVAL_SECS = "drain_interval_secs"
+SERVING_HUB_DRAIN_INTERVAL_SECS_DEFAULT = 10.0
+SERVING_HUB_OP_TIMEOUT_SECS = "op_timeout_secs"
+SERVING_HUB_OP_TIMEOUT_SECS_DEFAULT = 5.0
+SERVING_HUB_NODE_BACKOFF_SECS = "node_backoff_secs"
+SERVING_HUB_NODE_BACKOFF_SECS_DEFAULT = 10.0
+# door paths served WITHOUT the bearer token when serving.http.auth_token
+# is set (an in-cluster Prometheus scraper carries no tenant
+# credentials); empty default = everything hub-served is protected
+SERVING_HUB_AUTH_EXEMPT = "auth_exempt"
+SERVING_HUB_AUTH_EXEMPT_DEFAULT = ()
+SERVING_HUB_VALID_AUTH_EXEMPT = (
+    "/metrics", "/statz", "/dashboard",
+)
+# "alerts" sub-block: the rule thresholds the hub evaluates over its
+# ring. slo_target + fast/slow burn multipliers follow the SRE-workbook
+# multiwindow form (burn = observed error rate / (1 - slo_target));
+# breaker_flood and suppressed_growth are windowed counter-delta floors.
+SERVING_HUB_ALERTS = "alerts"
+SERVING_HUB_ALERTS_SLO_TARGET = "slo_target"
+SERVING_HUB_ALERTS_SLO_TARGET_DEFAULT = 0.99
+SERVING_HUB_ALERTS_FAST_WINDOW_SECS = "fast_window_secs"
+SERVING_HUB_ALERTS_FAST_WINDOW_SECS_DEFAULT = 60.0
+SERVING_HUB_ALERTS_SLOW_WINDOW_SECS = "slow_window_secs"
+SERVING_HUB_ALERTS_SLOW_WINDOW_SECS_DEFAULT = 600.0
+SERVING_HUB_ALERTS_FAST_BURN = "fast_burn"
+SERVING_HUB_ALERTS_FAST_BURN_DEFAULT = 14.4
+SERVING_HUB_ALERTS_SLOW_BURN = "slow_burn"
+SERVING_HUB_ALERTS_SLOW_BURN_DEFAULT = 6.0
+SERVING_HUB_ALERTS_BREAKER_FLOOD = "breaker_flood"
+SERVING_HUB_ALERTS_BREAKER_FLOOD_DEFAULT = 3
+SERVING_HUB_ALERTS_SUPPRESSED_GROWTH = "suppressed_growth"
+SERVING_HUB_ALERTS_SUPPRESSED_GROWTH_DEFAULT = 10
+
 #############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,
 # which delegated model parallelism to an external mpu object)

@@ -35,6 +35,7 @@ scheduler in deepspeed_tpu/inference/. Four layers:
 
 from ..config import constants as C
 from ..config.config import DeepSpeedConfig
+from ..telemetry.hub import TelemetryHub
 from .admission import (
     AdmissionController,
     FleetOverloaded,
@@ -254,6 +255,38 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
             drain_timeout_secs=cfg.serving_autoscale_drain_timeout_secs,
         )
 
+    # fleet observability plane (telemetry/hub.py, docs/observability.md
+    # "fleet-wide view"): same zero-overhead discipline as the
+    # autoscaler — disabled constructs NOTHING (no scrape thread, no
+    # ring, and the HTTP door's /metrics //statz //dashboard routes 404)
+    hub = None
+    if cfg.serving_hub_enabled:
+        hub = TelemetryHub(
+            nodes={
+                name: block["address"]
+                for name, block in (nodes or {}).items()
+            },
+            interval_secs=cfg.serving_hub_interval_secs,
+            retention_points=cfg.serving_hub_retention_points,
+            drain_interval_secs=cfg.serving_hub_drain_interval_secs,
+            op_timeout_secs=cfg.serving_hub_op_timeout_secs,
+            node_backoff_secs=cfg.serving_hub_node_backoff_secs,
+            auth_exempt=cfg.serving_hub_auth_exempt,
+            slo_target=cfg.serving_hub_alerts_slo_target,
+            alert_fast_window_secs=(
+                cfg.serving_hub_alerts_fast_window_secs
+            ),
+            alert_slow_window_secs=(
+                cfg.serving_hub_alerts_slow_window_secs
+            ),
+            alert_fast_burn=cfg.serving_hub_alerts_fast_burn,
+            alert_slow_burn=cfg.serving_hub_alerts_slow_burn,
+            alert_breaker_flood=cfg.serving_hub_alerts_breaker_flood,
+            alert_suppressed_growth=(
+                cfg.serving_hub_alerts_suppressed_growth
+            ),
+        )
+
     if engine_factory is not None:
         replicas = [
             InProcessReplica(
@@ -327,6 +360,7 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
         brownout_max_new_tokens=cfg.serving_brownout_max_new_tokens,
         fault_injector=faults,
         autoscaler=autoscaler,
+        hub=hub,
     )
     if start:
         router.start()
@@ -371,6 +405,7 @@ __all__ = [
     "SocketReplica",
     "SubprocessReplica",
     "SubprocessReplicaProvider",
+    "TelemetryHub",
     "TokenBucket",
     "init_fleet",
     "serve_http",

@@ -710,6 +710,10 @@ class Autoscaler:
         self._g_util = reg.gauge("fleet/slo_utilization")
         self._g_budget = reg.gauge("fleet/slo_error_budget_remaining")
         self._c_violations = reg.counter("fleet/slo_violations")
+        # paired with violations so any windowed reader (the telemetry
+        # hub's burn-rate windows) can form the violation FRACTION from
+        # two counter deltas
+        self._c_samples = reg.counter("fleet/slo_samples")
         self._c_ups = reg.counter("fleet/autoscale_ups")
         self._c_downs = reg.counter("fleet/autoscale_downs")
         self._c_reprovisions = reg.counter("fleet/autoscale_reprovisions")
@@ -782,6 +786,21 @@ class Autoscaler:
         return decision
 
     def _update_arrival(self, router, now):
+        hub = getattr(router, "hub", None)
+        if hub is not None:
+            # the telemetry hub retains fleet/requests_routed in its
+            # time-series ring: read the observed windowed rate from the
+            # shared plane instead of keeping private bookkeeping — the
+            # same number /statz and the alert rules see. Falls through
+            # to the private EWMA until the ring holds two points (hub
+            # just started) so early ticks behave exactly like a
+            # hub-less fleet.
+            rate = hub.observed_rate(
+                "fleet/requests_routed", self.policy.slo.eval_window_secs,
+            )
+            if rate is not None:
+                self._arrival_rps = float(rate)
+                return self._arrival_rps
         routed = int(router.metrics.counter("fleet/requests_routed").value)
         if self._last_routed is None:
             self._last_routed, self._last_routed_at = routed, now
@@ -812,9 +831,22 @@ class Autoscaler:
             )
             violated = observed > slo.ttft_p99_ms
             self.budget.record(now, violated)
+            self._c_samples.inc()
             if violated:
                 self._c_violations.inc()
         self._last_completed = completed
+        hub = getattr(router, "hub", None)
+        if hub is not None:
+            # prefer the hub's windowed budget (computed from the
+            # retained slo_violations/slo_samples counter rings — the
+            # number /statz serves); the private deque stays authoritative
+            # until the ring warms up, and for hub-less fleets forever
+            remaining = hub.error_budget_remaining(
+                slo.eval_window_secs, now=None,
+            )
+            if remaining is not None:
+                self._g_budget.set(remaining)
+                return
         self._g_budget.set(self.budget.remaining(now))
 
     # -- execution -------------------------------------------------------

@@ -138,13 +138,18 @@ class HTTPDoor:
 
     def __init__(self, router, host="127.0.0.1", port=0, *,
                  max_buffer_bytes=65536, overrun_policy="drop",
-                 poll_interval=0.002, registry=None, auth_token=None):
+                 poll_interval=0.002, registry=None, auth_token=None,
+                 hub=None):
         if overrun_policy not in OVERRUN_POLICIES:
             raise ValueError(
                 f"unknown overrun_policy {overrun_policy!r}; valid: "
                 f"{OVERRUN_POLICIES}"
             )
         self.router = router
+        # the fleet observability plane (telemetry/hub.py): None means
+        # no /metrics //statz //dashboard routes — they fall through to
+        # 404 (the hub-disabled zero-overhead pin)
+        self.hub = hub if hub is not None else getattr(router, "hub", None)
         # bearer secret (serving.http.auth_token): held privately, never
         # logged, never echoed into any response or repr
         self._auth_token = str(auth_token) if auth_token else None
@@ -290,7 +295,50 @@ class HTTPDoor:
                 )
             elif method == "POST" and target == "/v1/generate":
                 await self._generate(reader, writer, headers, body)
-            elif target in ("/healthz", "/readyz", "/v1/generate"):
+            elif (
+                self.hub is not None and method == "GET"
+                and target == "/metrics"
+            ):
+                # the fleet scrape renders from cached snapshots but
+                # still walks every series: off the event loop, like
+                # readyz
+                text = await asyncio.get_event_loop().run_in_executor(
+                    None, self.hub.prometheus_text
+                )
+                await self._respond_text(
+                    writer, 200, text,
+                    content_type="text/plain; version=0.0.4; "
+                                 "charset=utf-8",
+                )
+            elif (
+                self.hub is not None and method == "GET"
+                and target == "/statz"
+            ):
+                payload = await asyncio.get_event_loop().run_in_executor(
+                    None, self.hub.statz
+                )
+                await self._respond_json(writer, 200, payload)
+            elif (
+                self.hub is not None and method == "GET"
+                and target == "/dashboard"
+            ):
+                html = await asyncio.get_event_loop().run_in_executor(
+                    None, self.hub.dashboard_html
+                )
+                await self._respond_text(
+                    writer, 200, html,
+                    content_type="text/html; charset=utf-8",
+                )
+            elif (
+                self.hub is not None and method == "GET"
+                and target == "/statz/stream"
+            ):
+                await self._statz_stream(writer)
+            elif target in ("/healthz", "/readyz", "/v1/generate") or (
+                self.hub is not None and target in (
+                    "/metrics", "/statz", "/statz/stream", "/dashboard",
+                )
+            ):
                 await self._respond_json(
                     writer, 405, {"error": f"{method} not allowed here"}
                 )
@@ -352,12 +400,19 @@ class HTTPDoor:
     def _authorized(self, target, headers):
         """Bearer-token gate (``serving.http.auth_token``): the probe
         endpoints stay exempt — external load balancers carry no tenant
-        credentials. Constant-time comparison; neither the configured
-        token nor the client's attempt is ever logged."""
+        credentials. The hub's observability endpoints default to
+        PROTECTED and opt out per path via ``serving.hub.auth_exempt``
+        (an internal scraper without credentials). Constant-time
+        comparison; neither the configured token nor the client's
+        attempt is ever logged."""
         if self._auth_token is None:
             return True
         if target in ("/healthz", "/readyz"):
             return True
+        if self.hub is not None:
+            for path in getattr(self.hub, "auth_exempt", ()):
+                if target == path or target.startswith(path + "/"):
+                    return True
         scheme, _, value = headers.get("authorization", "").partition(" ")
         if scheme.strip().lower() != "bearer":
             return False
@@ -386,6 +441,51 @@ class HTTPDoor:
             ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
         )
         await writer.drain()
+
+    async def _respond_text(self, writer, status, text, *,
+                            content_type="text/plain; charset=utf-8"):
+        """Non-JSON bodies (Prometheus exposition, the dashboard HTML)."""
+        body = text.encode("utf-8")
+        phrase = _REASONS_PHRASE.get(status, "")
+        head = [
+            f"HTTP/1.1 {status} {phrase}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}",
+            "Connection: close",
+        ]
+        writer.write(
+            ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
+        )
+        await writer.drain()
+
+    async def _statz_stream(self, writer):
+        """SSE feed for the dashboard: one ``statz`` event per hub
+        interval, each frame built off the event loop (statz walks the
+        ring under its lock). Runs until the client disconnects or the
+        door shuts down."""
+        head = [
+            "HTTP/1.1 200 OK",
+            "Content-Type: text/event-stream",
+            "Cache-Control: no-store",
+            "Connection: close",
+        ]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1"))
+        await writer.drain()
+        self._m_open.inc(1)
+        try:
+            while True:
+                state = await asyncio.get_event_loop().run_in_executor(
+                    None, self.hub.dashboard_state
+                )
+                writer.write(_sse("statz", state))
+                await writer.drain()
+                await asyncio.sleep(
+                    max(float(self.hub.interval_secs), 0.25)
+                )
+        except (ConnectionError, OSError):
+            pass  # dashboard tab closed; nothing to answer
+        finally:
+            self._m_open.inc(-1)
 
     def _health(self):
         snap = self.router.metrics.snapshot()

@@ -67,7 +67,8 @@ import time
 
 from ..inference.scheduler import RequestRejected
 from ..resilience.faults import build_fault_injector_from_dict
-from ..telemetry.registry import count_suppressed
+from ..telemetry.registry import count_suppressed, wire_snapshot
+from ..telemetry.tracing import NOOP_TRACER, SpanTracer
 from ..utils.logging import logger
 from .replica import RPC_PROTOCOL_VERSION
 from .transport import (
@@ -198,6 +199,25 @@ class NodeServer:
             (spec.get("config") or {}).get("resilience") or {}
         ).get("fault_injection") or {}
         self._faults = build_fault_injector_from_dict(fi)
+        # node-side tracer (spec config's telemetry.tracing block, read
+        # raw — the node runs without a validated DeepSpeedConfig): no
+        # local export file, no local dump dir — the telemetry hub
+        # pulls sampled spans (and, on demand, the flight ring) home
+        # over drain_telemetry, so one router-side trace covers the
+        # fleet. flush_every is effectively infinite because flush()
+        # with no export_path would DISCARD the pending batch.
+        tr = (
+            (spec.get("config") or {}).get("telemetry") or {}
+        ).get("tracing") or {}
+        if tr.get("enabled"):
+            self.tracer = SpanTracer(
+                sample_rate=float(tr.get("sample_rate", 1.0)),
+                ring_events=int(tr.get("ring_events", 512)),
+                export_path=None, dump_dir=None,
+                flush_every=1_000_000_000,
+            )
+        else:
+            self.tracer = NOOP_TRACER
         self.engines = {}
         self._sessions = {}  # (client, replica_name) -> _Session
         self._sessions_lock = threading.Lock()
@@ -431,15 +451,21 @@ class NodeServer:
         self._faults.maybe_stall("replica.hang")
         if op == "ping":
             session.emit({"event": "pong"})
-        elif op in ("spawn_replica", "retire_replica", "node_info"):
-            # control-plane ops (docs/serving.md "SLO autoscaling"):
-            # valid on any session, but a control session is their home
+        elif op in ("spawn_replica", "retire_replica", "node_info",
+                    "metrics_snapshot", "drain_telemetry"):
+            # control-plane ops (docs/serving.md "SLO autoscaling" and
+            # docs/observability.md "fleet-wide view"): valid on any
+            # session, but a control session is their home
             if op == "node_info":
                 session.emit({
                     "event": "reply", "id": msg.get("id"),
                     "node": self.node_id,
                     "replicas": sorted(self.engines),
                 })
+            elif op == "metrics_snapshot":
+                self._op_metrics_snapshot(session, msg)
+            elif op == "drain_telemetry":
+                self._op_drain_telemetry(session, msg)
             elif op == "spawn_replica":
                 self._op_spawn(session, msg)
             else:
@@ -504,12 +530,24 @@ class NodeServer:
         # same contract as the worker: never block the op path on queue
         # room — a full queue rejects NOW and the router falls through
         kwargs.setdefault("timeout", 0.0)
+        t0 = time.monotonic()
         try:
             req = session.engine.submit(
                 msg["prompt"],
                 max_new_tokens=msg.get("max_new_tokens", 32),
                 **kwargs,
             )
+            if self.tracer.enabled:
+                # the node's own view of the accept (joins the request's
+                # fleet trace via the propagated context); shipped home
+                # by the hub's drain_telemetry pulls
+                self.tracer.record(
+                    "node.submit", t0, time.monotonic(),
+                    ctx=kwargs.get("trace_ctx"),
+                    attrs={"node": self.node_id,
+                           "replica": session.replica_name,
+                           "rpc_id": rpc_id},
+                )
         except RequestRejected as e:
             session.emit({
                 "event": "reply", "id": rpc_id,
@@ -525,6 +563,55 @@ class NodeServer:
         with session.lock:
             session.tracked[rpc_id] = (req, False, 0)
         session.emit({"event": "reply", "id": rpc_id})
+
+    # -- fleet observability (docs/observability.md "fleet-wide view") --
+    def _op_metrics_snapshot(self, session, msg):
+        """The telemetry hub's scrape: every live engine's registry as
+        JSON-safe wire entries, keyed by replica name. Engines without
+        a ``metrics`` registry contribute nothing (the hub merges what
+        exists rather than erroring). The engines dict is copied first
+        — a concurrent spawn/retire must not blow up the iteration."""
+        replicas = {}
+        for name, engine in sorted(list(self.engines.items())):
+            reg = getattr(engine, "metrics", None)
+            if reg is not None:
+                try:
+                    replicas[name] = wire_snapshot(reg)
+                except Exception as e:
+                    # a half-retired engine's registry must cost its
+                    # own entry, not the whole scrape
+                    count_suppressed("serving.node_metrics_snapshot", e)
+        session.emit({
+            "event": "reply", "id": msg.get("id"),
+            "node": self.node_id, "replicas": replicas,
+            "ts": time.time(),
+        })
+
+    def _op_drain_telemetry(self, session, msg):
+        """Ship the node tracer's telemetry home: the sampled-span batch
+        accumulated since the last drain, plus — when the op asks for a
+        ``flight`` — the full flight-recorder ring, so the router folds
+        this node into ONE fleet-wide trace file / flight dump instead
+        of the dumps stranding on the node host."""
+        tracer = self.tracer
+        want_flight = bool(msg.get("flight"))
+        reply = {
+            "event": "reply", "id": msg.get("id"), "node": self.node_id,
+        }
+        if tracer.enabled and want_flight:
+            # breadcrumb INSIDE the shipped ring: when/why this node's
+            # flight was pulled
+            tracer.event(
+                "node.flight_drain",
+                attrs={"node": self.node_id,
+                       "reason": msg.get("reason") or "fleet"},
+            )
+        reply["spans"] = tracer.drain_sampled() if tracer.enabled else []
+        if want_flight:
+            reply["flight_events"] = (
+                tracer.flight_snapshot() if tracer.enabled else []
+            )
+        session.emit(reply)
 
     def _op_adapter(self, session, msg, fn):
         """Adapter ops run OFF the connection thread: a load_adapter is
@@ -623,6 +710,11 @@ class NodeServer:
                 "node %s: spawned replica %r (%d hosted)",
                 self.node_id, name, len(self.engines),
             )
+            if self.tracer.enabled:
+                self.tracer.event(
+                    "node.spawn_replica",
+                    attrs={"node": self.node_id, "replica": name},
+                )
             session.emit({
                 "event": "reply", "id": rpc_id, "replica": name,
                 "replicas": sorted(self.engines),
@@ -668,6 +760,11 @@ class NodeServer:
                 "node %s: retired replica %r (%d hosted)",
                 self.node_id, name, len(self.engines),
             )
+            if self.tracer.enabled:
+                self.tracer.event(
+                    "node.retire_replica",
+                    attrs={"node": self.node_id, "replica": name},
+                )
             session.emit({
                 "event": "reply", "id": rpc_id, "replica": name,
                 "replicas": sorted(self.engines),

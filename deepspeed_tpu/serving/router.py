@@ -297,7 +297,7 @@ class FleetRouter:
                  breaker_backoff_secs=0.5, breaker_backoff_max_secs=30.0,
                  zombie_secs=0.0, zombie_restart_budget=2,
                  brownout_queue_ratio=None, brownout_max_new_tokens=16,
-                 fault_injector=None, autoscaler=None):
+                 fault_injector=None, autoscaler=None, hub=None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         from ..telemetry.manager import register_serving_metrics
@@ -441,6 +441,12 @@ class FleetRouter:
         self._autoscaler = autoscaler
         if autoscaler is not None:
             autoscaler.attach(self)
+        # the fleet observability plane (telemetry/hub.py): same
+        # discipline — None = no scrape threads, no ring, and the HTTP
+        # door's /metrics //statz //dashboard routes 404
+        self.hub = hub
+        if hub is not None:
+            hub.attach(self)
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
@@ -466,6 +472,11 @@ class FleetRouter:
             # wait out an in-flight scale op BEFORE tearing replicas
             # down: a spawn landing mid-teardown would leak its engine
             self._autoscaler.close(timeout)
+        if self.hub is not None:
+            # stop scraping before nodes disappear under the hub (a
+            # scrape racing teardown is just noise in the failure
+            # counters)
+            self.hub.close(timeout)
         if self._monitor is not None:
             self._monitor.join(timeout)
             if self._monitor.is_alive():
@@ -744,8 +755,12 @@ class FleetRouter:
         """Drop every ``fleet/replica{id}/*`` stream from the registry:
         a replica that left the fleet (eviction, scale-down) must stop
         exporting its stale last values — a dashboard reading a dead
-        replica's frozen queue depth as live data is worse than a gap."""
-        self.metrics.remove_prefix(f"fleet/replica{replica_id}/")
+        replica's frozen queue depth as live data is worse than a gap.
+        Serialized against the monitor's refresh: a refresh that read
+        this replica's snapshot before removal would otherwise re-mint
+        the gauges AFTER the retire, resurrecting the dead streams."""
+        with self._refresh_lock:
+            self.metrics.remove_prefix(f"fleet/replica{replica_id}/")
 
     # -- adapter registry (docs/adapters.md) ----------------------------
     def load_adapter(self, name, replica_ids=None, **kwargs):
@@ -1275,6 +1290,14 @@ class FleetRouter:
                 # sweeps down with it
                 logger.exception("fleet autoscaler tick failed")
                 count_suppressed("serving.autoscale_tick", e)
+        if self.hub is not None:
+            try:
+                # rate-limited internally; scrape I/O runs on the hub's
+                # own short-lived thread, never on this monitor thread
+                self.hub.tick()
+            except Exception as e:
+                logger.exception("telemetry hub tick failed")
+                count_suppressed("telemetry.hub_tick", e)
         now = self._clock()
         if now - self._last_refresh >= self._telemetry_refresh_secs:
             self.refresh_telemetry()

@@ -637,6 +637,25 @@ class NodeControlClient:
         [...]}``) — what a provider verifies a spawn/retire against."""
         return self._roundtrip({"op": "node_info"})
 
+    def metrics_snapshot(self):
+        """Scrape the node: one JSON-safe wire snapshot per live
+        replica registry (``{"node": ..., "replicas": {name:
+        [wire entries]}, "ts": ...}``) — the telemetry hub's pull op
+        (telemetry/hub.py)."""
+        return self._roundtrip({"op": "metrics_snapshot"})
+
+    def drain_telemetry(self, flight=False, reason=None):
+        """Ship the node tracer's sampled-span batch home (``{"node":
+        ..., "spans": [...]}``); with ``flight=True`` the reply also
+        carries the node's full flight-recorder ring so the router can
+        fold it into one fleet-wide dump."""
+        op = {"op": "drain_telemetry"}
+        if flight:
+            op["flight"] = True
+        if reason is not None:
+            op["reason"] = str(reason)
+        return self._roundtrip(op)
+
     def _roundtrip(self, op):
         sock = socket.create_connection(
             self.address, timeout=self._connect_timeout

@@ -72,6 +72,9 @@ import time
 
 from ..inference.scheduler import RequestRejected
 from ..resilience.faults import NULL_INJECTOR
+from ..telemetry.registry import (
+    DEFAULT_TIME_BUCKETS_MS, MetricsRegistry, wire_snapshot,
+)
 from .replica import RPC_PROTOCOL_VERSION
 
 
@@ -228,6 +231,17 @@ class WorkerServer:
             "snapshot": self._engine.load_snapshot(),
         })
 
+    def _op_metrics_snapshot(self, msg):
+        """The telemetry hub's scrape, relayed by the node agent: the
+        engine's registry as JSON-safe wire entries (engines without a
+        registry answer empty — the hub treats that as 'nothing to
+        merge', not an error)."""
+        reg = getattr(self._engine, "metrics", None)
+        self._emit({
+            "event": "reply", "id": msg["id"],
+            "metrics": wire_snapshot(reg) if reg is not None else [],
+        })
+
     def _op_adapter(self, msg, fn):
         """Shared load/unload wrapper: adapter management failures are
         op-level errors (the replica raises them to its caller), never
@@ -275,6 +289,8 @@ class WorkerServer:
                     self._op_cancel(msg)
                 elif op == "snapshot":
                     self._op_snapshot(msg)
+                elif op == "metrics_snapshot":
+                    self._op_metrics_snapshot(msg)
                 elif op == "load_adapter":
                     self._op_adapter(
                         msg,
@@ -379,6 +395,28 @@ class StubWorkerEngine:
         self._completed = 0
         self._tokens_out = 0
         self._draining = False
+        # the same infer/* surface the real engine exports, so remote
+        # stub nodes are scrapeable by the telemetry hub (the fleet
+        # /metrics acceptance pin runs against stub node subprocesses)
+        self.metrics = MetricsRegistry()
+        self._m_submitted = self.metrics.counter(
+            "infer/requests_submitted",
+            help="requests accepted by this replica",
+        )
+        self._m_completed = self.metrics.counter(
+            "infer/requests_completed",
+            help="requests finished by this replica",
+        )
+        self._m_tokens = self.metrics.counter(
+            "infer/tokens_generated", help="tokens emitted by this replica",
+        )
+        self._m_active = self.metrics.gauge(
+            "infer/active_slots", help="requests currently in flight",
+        )
+        self._m_ttft = self.metrics.histogram(
+            "infer/ttft_ms", buckets=DEFAULT_TIME_BUCKETS_MS,
+            help="stub time-to-first-token (the configured delay)",
+        )
 
     # -- scheduler surface the worker/replica tier drives ---------------
     def serve_forever(self):
@@ -404,6 +442,8 @@ class StubWorkerEngine:
         )
         with self._lock:
             self._active.append(req)
+            self._m_submitted.inc()
+            self._m_active.set(len(self._active))
         if not self.hang:
             timer = threading.Timer(
                 self.delay_secs, self._complete, args=(req,)
@@ -419,6 +459,10 @@ class StubWorkerEngine:
                 self._active.remove(req)
             self._completed += 1
             self._tokens_out += len(req.tokens)
+            self._m_completed.inc()
+            self._m_tokens.inc(len(req.tokens))
+            self._m_active.set(len(self._active))
+        self._m_ttft.observe(self.delay_secs * 1e3)
 
     def load_snapshot(self):
         with self._lock:

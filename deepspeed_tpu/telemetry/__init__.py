@@ -30,8 +30,11 @@ from .exporters import (
     PrometheusTextfileExporter,
     SummaryWriterExporter,
     prometheus_name,
+    render_prometheus,
 )
+from .hub import HUB_HTTP_PATHS, TelemetryHub
 from .manager import ENGINE_METRICS, Telemetry, build_telemetry
+from .timeseries import TimeSeriesStore
 from .profiling import ProfilerWindow
 from .registry import (
     Counter,
@@ -54,6 +57,7 @@ __all__ = [
     "Counter",
     "ENGINE_METRICS",
     "Gauge",
+    "HUB_HTTP_PATHS",
     "Histogram",
     "JsonlExporter",
     "MetricExporter",
@@ -66,10 +70,13 @@ __all__ = [
     "StepHeartbeatWatchdog",
     "SummaryWriterExporter",
     "Telemetry",
+    "TelemetryHub",
+    "TimeSeriesStore",
     "TraceContext",
     "build_telemetry",
     "build_tracer",
     "install_recompile_hook",
     "load_chrome_trace",
     "prometheus_name",
+    "render_prometheus",
 ]

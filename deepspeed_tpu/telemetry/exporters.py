@@ -14,7 +14,8 @@ import os
 import re
 import time
 
-from ..utils.logging import warn_once
+from ..utils.logging import logger, warn_once
+from .registry import count_suppressed, metric_to_wire
 
 
 class MetricExporter:
@@ -139,6 +140,131 @@ def _exemplar_line(name, le, exemplar):
     )
 
 
+def _escape_label_value(v):
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _label_str(labels, extra=None):
+    """``{node="n0",replica="r0",le="5.0"}`` (``extra`` is an already
+    formatted trailing pair, how histogram buckets append ``le``);
+    empty string when there is nothing to say — a bare sample name."""
+    parts = [
+        f'{k}="{_escape_label_value(v)}"' for k, v in (labels or {}).items()
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus(entries):
+    """Wire entries (:func:`..registry.metric_to_wire` dicts, optionally
+    carrying a ``labels`` dict) -> Prometheus 0.0.4 text exposition.
+
+    Shared by the textfile exporter (unlabeled, per-process) and the
+    telemetry hub's ``GET /metrics`` (fleet view, ``{node, replica}``
+    labels). Samples are grouped by prom name so HELP/TYPE emit exactly
+    once per family even when many label sets share it — the format
+    requires a family's samples to be contiguous.
+
+    ``prometheus_name()`` is lossy (``a/b`` and ``a.b`` both sanitize to
+    ``a_b``), so two DISTINCT registry names can collide on one prom
+    name. Silently interleaving their samples would corrupt the series;
+    instead the first registry name claims the prom name, later distinct
+    names are dropped with a debug log + ``count_suppressed`` — visible
+    in ``internal/suppressed_errors/telemetry.prom_name_collision``
+    instead of invisible in a merged series. A kind mismatch inside one
+    family (possible only across registries) is dropped the same way.
+    """
+    order = []
+    groups = {}
+    owner = {}  # prom name -> the registry name that claimed it
+    for e in entries:
+        name = e.get("name", "")
+        prom = prometheus_name(name)
+        claimed = owner.get(prom)
+        if claimed is None:
+            owner[prom] = name
+        elif claimed != name:
+            logger.debug(
+                "prometheus name collision: %r and %r both map to %r; "
+                "keeping the first", claimed, name, prom,
+            )
+            count_suppressed("telemetry.prom_name_collision")
+            continue
+        group = groups.get(prom)
+        if group is None:
+            groups[prom] = group = []
+            order.append(prom)
+        elif group[0].get("kind") != e.get("kind"):
+            logger.debug(
+                "prometheus kind mismatch for %r: %r vs %r; dropping the "
+                "latter sample", prom, group[0].get("kind"), e.get("kind"),
+            )
+            count_suppressed("telemetry.prom_name_collision")
+            continue
+        group.append(e)
+    lines = []
+    for prom in order:
+        group = groups[prom]
+        help_text = next((e.get("help") for e in group if e.get("help")), "")
+        if help_text:
+            lines.append(f"# HELP {prom} {help_text}")
+        lines.append(f"# TYPE {prom} {group[0].get('kind')}")
+        for e in group:
+            labels = e.get("labels")
+            if e.get("kind") == "histogram":
+                # exemplars (bucket index -> (value, trace_id, ts)):
+                # the histogram->trace link, carried as comment lines
+                # beside the bucket samples (see _exemplar_line for why
+                # not an inline OpenMetrics tail)
+                exemplars = e.get("exemplars") or {}
+                cumulative = 0
+                thresholds = e.get("thresholds", ())
+                counts = e.get("bucket_counts", ())
+                for i, (threshold, count) in enumerate(
+                    zip(thresholds, counts)
+                ):
+                    cumulative += count
+                    le = _format_value(threshold)
+                    le_pair = 'le="' + le + '"'
+                    lines.append(
+                        f'{prom}_bucket{_label_str(labels, extra=le_pair)} '
+                        f'{cumulative}'
+                    )
+                    ex = _exemplar_line(
+                        prom, le, exemplars.get(i, exemplars.get(str(i)))
+                    )
+                    if ex:
+                        lines.append(ex)
+                total = int(e.get("count", 0))
+                inf_pair = 'le="+Inf"'
+                lines.append(
+                    f'{prom}_bucket{_label_str(labels, extra=inf_pair)} '
+                    f'{total}'
+                )
+                inf_idx = len(thresholds)
+                ex = _exemplar_line(
+                    prom, "+Inf",
+                    exemplars.get(inf_idx, exemplars.get(str(inf_idx))),
+                )
+                if ex:
+                    lines.append(ex)
+                lines.append(
+                    f'{prom}_sum{_label_str(labels)} '
+                    f'{_format_value(e.get("sum", 0.0))}'
+                )
+                lines.append(f"{prom}_count{_label_str(labels)} {total}")
+            else:
+                lines.append(
+                    f'{prom}{_label_str(labels)} '
+                    f'{_format_value(e.get("value", 0.0))}'
+                )
+    return "\n".join(lines) + "\n"
+
+
 class PrometheusTextfileExporter(MetricExporter):
     """Registry -> Prometheus text exposition format, rewritten atomically
     (write-temp-then-rename) so a scraper never reads a torn file. Point
@@ -151,42 +277,11 @@ class PrometheusTextfileExporter(MetricExporter):
 
     def export(self, metrics, step):
         del step  # prometheus samples carry scrape time, not step indices
-        lines = []
-        for m in metrics:
-            name = prometheus_name(m.name)
-            if m.help:
-                lines.append(f"# HELP {name} {m.help}")
-            lines.append(f"# TYPE {name} {m.kind}")
-            if m.kind == "histogram":
-                # exemplars (bucket index -> (value, trace_id, ts)):
-                # the histogram->trace link, carried as comment lines
-                # beside the bucket samples (see _exemplar_line for why
-                # not an inline OpenMetrics tail)
-                exemplars = getattr(m, "exemplars", None) or {}
-                cumulative = 0
-                for i, (threshold, count) in enumerate(
-                    zip(m.thresholds, m.bucket_counts)
-                ):
-                    cumulative += count
-                    le = _format_value(threshold)
-                    lines.append(f'{name}_bucket{{le="{le}"}} {cumulative}')
-                    ex = _exemplar_line(name, le, exemplars.get(i))
-                    if ex:
-                        lines.append(ex)
-                lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
-                ex = _exemplar_line(
-                    name, "+Inf", exemplars.get(len(m.thresholds))
-                )
-                if ex:
-                    lines.append(ex)
-                lines.append(f"{name}_sum {_format_value(m.sum)}")
-                lines.append(f"{name}_count {m.count}")
-            else:
-                lines.append(f"{name} {_format_value(m.value)}")
+        text = render_prometheus(metric_to_wire(m) for m in metrics)
         tmp = self.path + ".tmp"
         try:
             with open(tmp, "w") as f:
-                f.write("\n".join(lines) + "\n")
+                f.write(text)
             os.replace(tmp, self.path)
         except OSError as e:
             warn_once(

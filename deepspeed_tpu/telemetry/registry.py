@@ -228,6 +228,90 @@ class MetricsRegistry:
         return out
 
 
+def metric_to_wire(m):
+    """One instrument -> a JSON-safe dict (the ``metrics_snapshot``
+    control-op payload and the telemetry hub's internal sample form).
+    Scalars carry ``value``; histograms carry thresholds, NON-cumulative
+    bucket counts, sum/count, and exemplars keyed by stringified bucket
+    index (JSON objects cannot key on ints)."""
+    if m.kind == "histogram":
+        entry = {
+            "name": m.name,
+            "kind": "histogram",
+            "help": m.help,
+            "count": int(m.count),
+            "sum": float(m.sum),
+            "thresholds": list(m.thresholds),
+            "bucket_counts": list(m.bucket_counts),
+        }
+        exemplars = getattr(m, "exemplars", None)
+        if exemplars:
+            entry["exemplars"] = {
+                str(i): [float(e[0]), str(e[1]), float(e[2])]
+                for i, e in exemplars.items()
+            }
+        return entry
+    return {"name": m.name, "kind": m.kind, "help": m.help,
+            "value": float(m.value)}
+
+
+def wire_snapshot(registry):
+    """The whole registry as a list of :func:`metric_to_wire` dicts,
+    sorted by name — what a node agent returns for the hub's
+    ``metrics_snapshot`` scrape. Safe to call concurrently with
+    ``remove_prefix`` (``collect()`` takes the registry lock for the
+    key list; instrument reads after that are lock-free attribute
+    loads, and a retired instrument stays readable through the held
+    reference)."""
+    return [metric_to_wire(m) for m in registry.collect()]
+
+
+def wire_scalars(entries):
+    """Flatten wire entries into the registry's ``snapshot()`` scalar
+    form (histograms -> ``name/count`` + ``name/sum``) — what the hub
+    feeds its time-series rings."""
+    out = {}
+    for e in entries:
+        if e.get("kind") == "histogram":
+            out[e["name"] + "/count"] = float(e.get("count", 0))
+            out[e["name"] + "/sum"] = float(e.get("sum", 0.0))
+        else:
+            out[e["name"]] = float(e.get("value", 0.0))
+    return out
+
+
+class WireHistogram:
+    """Read-only :class:`Histogram` facade over a wire dict — gives
+    :func:`histogram_quantile` (and anything else duck-typed on the
+    instrument attributes) a remote histogram to chew on."""
+
+    kind = "histogram"
+
+    def __init__(self, entry):
+        self.name = entry.get("name", "")
+        self.help = entry.get("help", "")
+        self.thresholds = tuple(
+            float(t) for t in entry.get("thresholds", ())
+        )
+        self._counts = tuple(
+            int(c) for c in entry.get("bucket_counts", ())
+        )
+        self._sum = float(entry.get("sum", 0.0))
+        self._count = int(entry.get("count", 0))
+
+    @property
+    def bucket_counts(self):
+        return self._counts
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+
 # ---------------------------------------------------------------------------
 # Suppressed-error accounting: best-effort probe paths (TPU metadata
 # probes, compile-cache verdict resets, model-spec lookups) deliberately

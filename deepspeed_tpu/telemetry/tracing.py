@@ -168,6 +168,9 @@ class NoopTracer:
     def ingest(self, spans):
         return 0
 
+    def drain_sampled(self):
+        return []
+
     def flight_snapshot(self):
         return []
 
@@ -317,6 +320,18 @@ class SpanTracer:
                     self._pending.append(span)
             n += 1
         return n
+
+    def drain_sampled(self):
+        """Atomically take the sampled-span batch accumulated since the
+        last flush/drain — the node agent's ``drain_telemetry`` reply
+        body. A tracer with no ``export_path`` (node agents export
+        nothing locally; the hub ships spans home instead) would
+        otherwise discard the batch at its next auto-flush, so node
+        tracers pair this with a large ``flush_every``. Returns the
+        spans oldest first; the flight-recorder ring is untouched."""
+        with self._lock:
+            batch, self._pending = self._pending, []
+        return batch
 
     # -- flight recorder -------------------------------------------------
     def flight_snapshot(self):

@@ -1,0 +1,600 @@
+"""Fleet observability plane (telemetry/hub.py + timeseries.py, the
+door's /metrics //statz //dashboard endpoints, and the node agent's
+metrics_snapshot / drain_telemetry control ops — docs/observability.md
+"fleet-wide view").
+
+jax-free throughout: nodes host worker.py's StubWorkerEngine on real
+loopback sockets, the hub is driven with an injected clock, and the
+router under the door is a live FleetRouter over socket replicas.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.config.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.serving import FleetRouter, HTTPDoor, SocketReplica
+from deepspeed_tpu.serving.node import NodeServer
+from deepspeed_tpu.serving.transport import NodeControlClient
+from deepspeed_tpu.telemetry.hub import (
+    ALERT_BREAKER_FLOOD,
+    ALERT_SLO_BURN,
+    HUB_HTTP_PATHS,
+    TelemetryHub,
+)
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.timeseries import TimeSeriesStore
+from deepspeed_tpu.telemetry.tracing import NOOP_TRACER, SpanTracer
+
+
+def _node(replicas=("r0",), *, node_id="n0", tracing=False):
+    spec = {
+        "node_id": node_id,
+        "replicas": {
+            name: {"stub": {"delay_secs": 0.01}} for name in replicas
+        },
+        "lease_secs": 5.0,
+        "resume_grace_secs": 5.0,
+    }
+    if tracing:
+        spec["config"] = {
+            "telemetry": {"tracing": {"enabled": True, "sample_rate": 1.0}},
+        }
+    return NodeServer(spec)
+
+
+class _FakeRouter:
+    """The slice of FleetRouter the hub touches: a registry, a tracer,
+    and the back-pointer attribute."""
+
+    def __init__(self, tracer=None):
+        self.metrics = MetricsRegistry()
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
+        self.hub = None
+
+
+# ---------------------------------------------------------------------------
+# the time-series ring
+# ---------------------------------------------------------------------------
+def test_timeseries_retention_bounds_each_ring():
+    store = TimeSeriesStore(retention_points=4, clock=lambda: 0.0)
+    for i in range(10):
+        store.record("c", float(i), now=float(i))
+    pts = store.window("c", window_secs=100.0, now=9.0)
+    assert pts == [(6.0, 6.0), (7.0, 7.0), (8.0, 8.0), (9.0, 9.0)]
+    assert store.latest("c") == (9.0, 9.0)
+    assert store.latest("unknown") is None
+
+
+def test_timeseries_window_queries():
+    store = TimeSeriesStore(retention_points=64)
+    for i in range(6):
+        store.record("reqs", 10.0 * i, now=100.0 + i)
+    # delta and rate over the trailing window
+    assert store.window_delta("reqs", 100.0, now=105.0) == 50.0
+    assert store.window_rate("reqs", 100.0, now=105.0) == 10.0
+    # a narrow window sees fewer points
+    assert store.window_delta("reqs", 2.0, now=105.0) == 20.0
+    # < 2 points -> None, not 0 (an empty window is unknown, not quiet)
+    assert store.window_delta("reqs", 0.5, now=105.0) is None
+    assert store.window_rate("empty", 10.0, now=105.0) is None
+    stats = store.window_stats("reqs", 100.0, now=105.0)
+    assert stats == {"count": 6, "min": 0.0, "max": 50.0, "last": 50.0}
+    assert store.sparkline("reqs", points=3) == [30.0, 40.0, 50.0]
+
+
+def test_timeseries_counter_reset_clamps_to_zero():
+    store = TimeSeriesStore(retention_points=8)
+    store.record("c", 100.0, now=1.0)
+    store.record("c", 3.0, now=2.0)  # process restart reset the counter
+    assert store.window_delta("c", 10.0, now=2.0) == 0.0
+
+
+def test_timeseries_rejects_degenerate_retention():
+    with pytest.raises(ValueError):
+        TimeSeriesStore(retention_points=1)
+
+
+# ---------------------------------------------------------------------------
+# the hub: scrape, windows, alert rules (injected clock, no sockets)
+# ---------------------------------------------------------------------------
+def _hub(router, clock, **kw):
+    kw.setdefault("interval_secs", 1.0)
+    kw.setdefault("slo_target", 0.99)
+    kw.setdefault("alert_fast_window_secs", 10.0)
+    kw.setdefault("alert_slow_window_secs", 30.0)
+    hub = TelemetryHub(clock=clock, **kw)
+    hub.attach(router)
+    router.hub = hub
+    return hub
+
+
+def test_hub_local_scrape_feeds_windows_and_budget():
+    t = {"now": 1000.0}
+    router = _FakeRouter()
+    hub = _hub(router, lambda: t["now"])
+    routed = router.metrics.counter("fleet/requests_routed")
+    violations = router.metrics.counter("fleet/slo_violations")
+    samples = router.metrics.counter("fleet/slo_samples")
+    # before two points, every windowed read abstains
+    assert hub.observed_rate("fleet/requests_routed", 10.0) is None
+    assert hub.error_budget_remaining(10.0) is None
+    for _ in range(5):
+        routed.inc(8)
+        samples.inc(4)
+        violations.inc(1)  # 25% violating
+        hub.scrape_once()
+        t["now"] += 1.0
+    assert hub.observed_rate(
+        "fleet/requests_routed", 10.0, now=t["now"]
+    ) == pytest.approx(8.0)
+    assert hub.error_budget_remaining(
+        10.0, now=t["now"]
+    ) == pytest.approx(0.75)
+    # 25% violating / 1% budget = burn 25: both windows over threshold
+    assert ALERT_SLO_BURN in hub._active_alerts
+    assert router.metrics.counter("fleet/alerts_slo_burn").value == 1
+
+
+def test_hub_alert_fires_on_rising_edge_only():
+    t = {"now": 0.0}
+    router = _FakeRouter()
+    hub = _hub(router, lambda: t["now"], alert_breaker_flood=3)
+    opens = router.metrics.counter("fleet/breaker_opens")
+    for _ in range(4):
+        opens.inc(2)  # 8 opens over ~4s >> flood threshold 3
+        hub.scrape_once()
+        t["now"] += 1.0
+    alerts = router.metrics.counter(f"fleet/alerts_{ALERT_BREAKER_FLOOD}")
+    assert ALERT_BREAKER_FLOOD in hub._active_alerts
+    assert alerts.value == 1  # many evaluations, ONE rising edge
+    # the flood subsides past the window: the alert resolves, and a new
+    # flood later is a NEW rising edge
+    t["now"] += 60.0
+    hub.scrape_once()
+    t["now"] += 1.0
+    hub.scrape_once()
+    assert ALERT_BREAKER_FLOOD not in hub._active_alerts
+    for _ in range(3):
+        opens.inc(2)
+        hub.scrape_once()
+        t["now"] += 1.0
+    assert alerts.value == 2
+
+
+def test_hub_alert_event_lands_in_flight_ring(tmp_path):
+    t = {"now": 0.0}
+    tracer = SpanTracer(
+        sample_rate=1.0, ring_events=64, dump_dir=str(tmp_path)
+    )
+    router = _FakeRouter(tracer=tracer)
+    hub = _hub(router, lambda: t["now"])
+    samples = router.metrics.counter("fleet/slo_samples")
+    violations = router.metrics.counter("fleet/slo_violations")
+    for _ in range(3):
+        samples.inc(2)
+        violations.inc(2)  # 100% violating
+        hub.scrape_once()
+        t["now"] += 1.0
+    names = [e["name"] for e in tracer.flight_snapshot()]
+    assert "hub.alert" in names
+    tracer.close()
+
+
+def test_hub_statz_and_dashboard_shapes():
+    t = {"now": 50.0}
+    router = _FakeRouter()
+    hub = _hub(router, lambda: t["now"])
+    router.metrics.counter("fleet/requests_routed").inc(3)
+    hub.scrape_once()
+    t["now"] += 1.0
+    hub.scrape_once()
+    statz = hub.statz()
+    assert statz["nodes"] == [] and statz["nodes_up"] == 0
+    assert "10s" in statz["windows"] and "30s" in statz["windows"]
+    assert statz["windows"]["10s"]["request_rate"] == pytest.approx(0.0)
+    assert statz["alerts"]["active"] == []
+    assert statz["fleet"]["fleet/requests_routed"] == 3.0
+    # the dashboard page is self-contained and carries the state inline
+    html = hub.dashboard_html()
+    assert "__INITIAL_STATE__" not in html
+    assert "EventSource" in html and "/statz/stream" in html
+    state = hub.dashboard_state()
+    assert set(state["spark"]) == {
+        "ttft_p99_ms", "utilization", "queue_depth", "budget_remaining",
+    }
+
+
+def test_hub_prometheus_text_merges_remote_with_labels():
+    router = _FakeRouter()
+    hub = _hub(router, time.time)
+    router.metrics.counter("fleet/requests_routed", help="routed").inc(2)
+    # a cached remote view, as scrape_once would leave it
+    hub._remote[("n9", "r0")] = (time.time(), [
+        {"name": "infer/requests_completed", "kind": "counter",
+         "help": "done", "value": 7.0},
+    ])
+    text = hub.prometheus_text()
+    assert "fleet_requests_routed 2.0" in text
+    assert (
+        'infer_requests_completed{node="n9",replica="r0"} 7.0' in text
+    )
+    # HELP/TYPE once per family
+    assert text.count("# TYPE infer_requests_completed counter") == 1
+
+
+def test_hub_scrape_failure_backoff_and_recovery():
+    t = {"now": 0.0}
+    router = _FakeRouter()
+    hub = _hub(
+        router, lambda: t["now"],
+        nodes={"gone": "127.0.0.1:1"},  # nothing listens there
+        node_backoff_secs=30.0, op_timeout_secs=0.2,
+    )
+    assert hub.scrape_once() == 0
+    failures = router.metrics.counter("fleet/hub_scrape_failures").value
+    assert failures == 1
+    # within the backoff the dead node is not re-dialed
+    t["now"] += 1.0
+    assert hub.scrape_once() == 0
+    assert (
+        router.metrics.counter("fleet/hub_scrape_failures").value
+        == failures
+    )
+    # past the backoff it is
+    t["now"] += 60.0
+    hub.scrape_once()
+    assert (
+        router.metrics.counter("fleet/hub_scrape_failures").value
+        == failures + 1
+    )
+
+
+# ---------------------------------------------------------------------------
+# the node agent's control ops over a real loopback socket
+# ---------------------------------------------------------------------------
+def test_node_metrics_snapshot_op_ships_engine_registries():
+    node = _node(("r0", "r1"))
+    node.start()
+    try:
+        client = NodeControlClient(node.address)
+        # drive one request through r0 so its counters move
+        replica = SocketReplica(
+            "n0:r0", node.address, remote_name="r0", rpc_timeout=2.0,
+        )
+        replica.start()
+        try:
+            req = replica.submit([5], max_new_tokens=2)
+            assert req.result(10.0) == [6, 7]
+        finally:
+            replica.shutdown()
+        reply = client.metrics_snapshot()
+        assert reply["node"] == "n0"
+        assert set(reply["replicas"]) == {"r0", "r1"}
+        by_name = {
+            e["name"]: e for e in reply["replicas"]["r0"]
+        }
+        assert by_name["infer/requests_submitted"]["value"] == 1.0
+        assert by_name["infer/requests_completed"]["value"] == 1.0
+        assert by_name["infer/tokens_generated"]["value"] == 2.0
+        assert by_name["infer/ttft_ms"]["kind"] == "histogram"
+        # the idle replica answers too, with zeroed counters
+        idle = {e["name"]: e for e in reply["replicas"]["r1"]}
+        assert idle["infer/requests_submitted"]["value"] == 0.0
+        # everything JSON-safe end to end (it crossed the wire already,
+        # but pin the round-trip explicitly)
+        json.dumps(reply)
+    finally:
+        node.shutdown()
+
+
+def test_node_drain_telemetry_op_ships_spans_and_flight():
+    node = _node(tracing=True)
+    node.start()
+    try:
+        replica = SocketReplica(
+            "n0:r0", node.address, remote_name="r0", rpc_timeout=2.0,
+        )
+        replica.start()
+        try:
+            req = replica.submit([9], max_new_tokens=2)
+            assert req.result(10.0) == [10, 11]
+        finally:
+            replica.shutdown()
+        client = NodeControlClient(node.address)
+        reply = client.drain_telemetry()
+        spans = reply["spans"]
+        assert any(s["name"] == "node.submit" for s in spans)
+        sub = next(s for s in spans if s["name"] == "node.submit")
+        assert sub["attrs"]["node"] == "n0"
+        assert sub["attrs"]["replica"] == "r0"
+        assert sub["sampled"] is True
+        assert "flight_events" not in reply
+        # the drain drained: a second pull is empty until new traffic
+        assert client.drain_telemetry()["spans"] == []
+        # flight=True additionally ships the ring, with the drain
+        # breadcrumb recorded INSIDE it
+        flight = client.drain_telemetry(flight=True, reason="test")
+        names = [e["name"] for e in flight["flight_events"]]
+        assert "node.flight_drain" in names
+        assert "node.submit" in names  # the ring keeps history
+    finally:
+        node.shutdown()
+
+
+def test_node_without_tracing_drains_empty():
+    node = _node(tracing=False)
+    node.start()
+    try:
+        reply = NodeControlClient(node.address).drain_telemetry(flight=True)
+        assert reply["spans"] == []
+        assert reply["flight_events"] == []
+    finally:
+        node.shutdown()
+
+
+def test_hub_drain_once_ingests_remote_spans(tmp_path):
+    node = _node(node_id="nd", tracing=True)
+    node.start()
+    router_tracer = SpanTracer(
+        sample_rate=1.0, ring_events=64,
+        export_path=str(tmp_path / "trace.json"),
+        dump_dir=str(tmp_path),
+    )
+    router = _FakeRouter(tracer=router_tracer)
+    try:
+        replica = SocketReplica(
+            "nd:r0", node.address, remote_name="r0", rpc_timeout=2.0,
+        )
+        replica.start()
+        try:
+            assert replica.submit([1], max_new_tokens=1).result(10.0)
+        finally:
+            replica.shutdown()
+        host, port = node.address
+        hub = _hub(
+            router, time.time, nodes={"nd": f"{host}:{port}"},
+        )
+        # the node's spans carry the node PROCESS pid; NodeServer here is
+        # in-process, so re-stamp them remote-looking via a fake pid to
+        # exercise the ingest path the way a real fleet does
+        real_drain = hub._make_client(f"{host}:{port}").drain_telemetry()
+        assert real_drain["spans"]  # sanity: there was something to ship
+        for s in real_drain["spans"]:
+            s["pid"] = 999999
+        ingested = router_tracer.ingest(real_drain["spans"])
+        assert ingested == len(real_drain["spans"])
+        router_tracer.flush()
+        router_tracer.close()
+        from deepspeed_tpu.telemetry.tracing import load_chrome_trace
+
+        events = load_chrome_trace(str(tmp_path / "trace.json"))
+        assert any(e["name"] == "node.submit" for e in events)
+        assert {e["pid"] for e in events} == {999999}
+        # the drain counters move through the real drain_once sweep
+        spans, dump = hub.drain_once(flight=True, reason="unit")
+        assert router.metrics.counter("fleet/hub_drains").value == 1
+        assert dump is None or dump  # dump path only when dump_dir set
+    finally:
+        node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the door's observability endpoints
+# ---------------------------------------------------------------------------
+def _get(host, port, path, headers=None):
+    conn = http.client.HTTPConnection(host, port, timeout=30.0)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _fleet(hub=None, **door_kw):
+    node = _node(node_id="dn", tracing=False)
+    node.start()
+    replica = SocketReplica(
+        "dn:r0", node.address, remote_name="r0", rpc_timeout=2.0,
+    )
+    router = FleetRouter(
+        [replica], monitor_interval=0.01, telemetry_refresh_secs=3600.0,
+        hub=hub,
+    ).start()
+    door = HTTPDoor(router, **door_kw)
+    host, port = door.start()
+    return node, router, door, host, port
+
+
+def test_door_hub_endpoints_serve_the_fleet_view():
+    t_node = _node(node_id="dn2", tracing=False)
+    t_node.start()
+    try:
+        host_n, port_n = t_node.address
+        hub = TelemetryHub(
+            nodes={"dn2": f"{host_n}:{port_n}"}, interval_secs=0.05,
+            alert_fast_window_secs=10.0, alert_slow_window_secs=30.0,
+        )
+        node, router, door, host, port = _fleet(hub=hub)
+        try:
+            assert router.submit([4], max_new_tokens=2).result(10.0)
+            hub.scrape_once()
+            status, headers, body = _get(host, port, "/metrics")
+            assert status == 200
+            assert headers["Content-Type"].startswith("text/plain")
+            text = body.decode()
+            assert "fleet_requests_completed 1.0" in text
+            assert 'node="dn2",replica="r0"' in text
+            status, _h, body = _get(host, port, "/statz")
+            assert status == 200
+            statz = json.loads(body)
+            assert statz["nodes"] == ["dn2"]
+            assert "dn2/r0" in statz["replicas"]
+            status, headers, body = _get(host, port, "/dashboard")
+            assert status == 200
+            assert headers["Content-Type"].startswith("text/html")
+            assert b"EventSource" in body
+            # wrong method on a hub path: 405, not 404
+            conn = http.client.HTTPConnection(host, port, timeout=10.0)
+            conn.request("POST", "/metrics")
+            assert conn.getresponse().status == 405
+            conn.close()
+        finally:
+            door.shutdown()
+            router.shutdown()
+            node.shutdown()
+    finally:
+        t_node.shutdown()
+
+
+def test_door_404s_hub_paths_without_a_hub():
+    node, router, door, host, port = _fleet(hub=None)
+    try:
+        assert router.hub is None
+        for path in HUB_HTTP_PATHS:
+            status, _h, _b = _get(host, port, path)
+            assert status == 404, path
+        # and no hub thread exists anywhere in the process
+        assert not [
+            t for t in threading.enumerate()
+            if t.name.startswith("ds-hub")
+        ]
+    finally:
+        door.shutdown()
+        router.shutdown()
+        node.shutdown()
+
+
+def test_door_auth_exemption_covers_hub_paths():
+    hub = TelemetryHub(auth_exempt=("/metrics", "/statz"))
+    node, router, door, host, port = _fleet(
+        hub=hub, auth_token="hub-secret",
+    )
+    try:
+        hub.scrape_once()
+        # exempted paths answer without credentials (probe-style)
+        assert _get(host, port, "/metrics")[0] == 200
+        assert _get(host, port, "/statz")[0] == 200
+        # the exemption prefix covers the SSE sub-path too -- but the
+        # dashboard was NOT exempted, so it still wants the bearer token
+        assert _get(host, port, "/dashboard")[0] == 401
+        assert _get(
+            host, port, "/dashboard",
+            headers={"Authorization": "Bearer hub-secret"},
+        )[0] == 200
+    finally:
+        door.shutdown()
+        router.shutdown()
+        node.shutdown()
+
+
+def test_statz_stream_emits_sse_frames():
+    hub = TelemetryHub(interval_secs=0.05)
+    node, router, door, host, port = _fleet(hub=hub)
+    try:
+        hub.scrape_once()
+        import socket as socketlib
+
+        sock = socketlib.create_connection((host, port))
+        sock.settimeout(10.0)
+        sock.sendall(
+            b"GET /statz/stream HTTP/1.1\r\nHost: door\r\n\r\n"
+        )
+        buf = b""
+        # two frames prove the loop re-arms, not just the first emit
+        while buf.count(b"event: statz") < 2:
+            chunk = sock.recv(4096)
+            assert chunk, "stream closed before two statz frames"
+            buf += chunk
+        assert b"200 OK" in buf
+        assert b"text/event-stream" in buf
+        frame = [
+            line for line in buf.split(b"\n")
+            if line.startswith(b"data: ")
+        ][0]
+        state = json.loads(frame[6:])
+        assert "windows" in state and "spark" in state
+        sock.close()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if router.metrics.gauge("door/open_streams").value == 0:
+                break
+            time.sleep(0.01)
+        assert router.metrics.gauge("door/open_streams").value == 0
+    finally:
+        door.shutdown()
+        router.shutdown()
+        node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# router wiring: tick drives the hub; shutdown closes it
+# ---------------------------------------------------------------------------
+def test_router_tick_drives_hub_and_shutdown_joins_it():
+    hub = TelemetryHub(interval_secs=0.02)
+    node, router, door, host, port = _fleet(hub=hub)
+    try:
+        assert router.hub is hub
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if router.metrics.gauge("fleet/hub_series").value > 0:
+                break
+            time.sleep(0.01)
+        assert router.metrics.gauge("fleet/hub_series").value > 0, (
+            "the router monitor never drove a hub scrape"
+        )
+    finally:
+        door.shutdown()
+        router.shutdown()
+        node.shutdown()
+    assert hub._closed
+    assert not [
+        t for t in threading.enumerate() if t.name.startswith("ds-hub")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# config validation (serving.hub block)
+# ---------------------------------------------------------------------------
+def _cfg(hub_block):
+    return DeepSpeedConfig(None, param_dict={
+        "train_batch_size": 1,
+        "serving": {"hub": hub_block},
+    }, world_size=1)
+
+
+def test_hub_config_defaults_and_arming():
+    cfg = _cfg({"enabled": True, "interval_secs": 0.5,
+                "alerts": {"fast_window_secs": 5, "slow_window_secs": 50}})
+    assert cfg.serving_hub_enabled is True
+    assert cfg.serving_hub_interval_secs == 0.5
+    assert cfg.serving_hub_retention_points == 512
+    assert cfg.serving_hub_alerts_fast_window_secs == 5
+    assert cfg.serving_hub_alerts_slow_window_secs == 50
+    disabled = DeepSpeedConfig(
+        None, param_dict={"train_batch_size": 1}, world_size=1,
+    )
+    assert disabled.serving_hub_enabled is False
+
+
+@pytest.mark.parametrize("block", [
+    {"enabled": True, "bogus_key": 1},
+    {"enabled": "yes"},
+    {"enabled": True, "interval_secs": 0},
+    {"enabled": True, "retention_points": 1},
+    {"enabled": True, "drain_interval_secs": -1},
+    {"enabled": True, "auth_exempt": ["/not-a-hub-path"]},
+    {"enabled": True, "auth_exempt": "/metrics"},
+    {"enabled": True, "alerts": {"bogus": 1}},
+    {"enabled": True, "alerts": {"slo_target": 1.0}},
+    {"enabled": True, "alerts": {"fast_window_secs": 60,
+                                 "slow_window_secs": 60}},
+    {"enabled": True, "alerts": {"fast_burn": 0}},
+])
+def test_hub_config_rejects_bad_blocks(block):
+    with pytest.raises(DeepSpeedConfigError):
+        _cfg(block)

@@ -722,3 +722,117 @@ def test_telemetry_disabled_is_inert(tmp_path):
     assert not engine.telemetry.enabled
     assert engine.telemetry.watchdog is None
     assert engine.telemetry.exporters == []
+
+
+# ---------------------------------------------------------------------------
+# exporter degradation under fault (docs/observability.md "fleet-wide
+# view"): the scrape pipe must bend, not break
+# ---------------------------------------------------------------------------
+def test_prometheus_textfile_unwritable_path_degrades(tmp_path):
+    """An export target that becomes unwritable mid-run warns once and
+    keeps the process alive — a full disk must not take down training."""
+    path = tmp_path / "metrics.prom"
+    reg = MetricsRegistry()
+    reg.counter("a/b", help="h").inc()
+    exp = PrometheusTextfileExporter(str(path))
+    exp.export(reg.collect(), step=0)
+    assert "a_b 1.0" in path.read_text()
+    # the target turns into a directory: os.replace now raises OSError
+    path.unlink()
+    path.mkdir()
+    exp.export(reg.collect(), step=1)  # warn_once path, no raise
+    exp.export(reg.collect(), step=2)  # repeat failure stays silent
+    assert path.is_dir()  # nothing clobbered the directory
+
+
+def test_histogram_quantile_degenerate_sample_counts():
+    """0 samples -> 0.0 (not NaN); 1 sample interpolates inside its own
+    bucket; +Inf-only clamps to the last finite edge."""
+    from deepspeed_tpu.telemetry.registry import histogram_quantile
+
+    reg = MetricsRegistry()
+    h = reg.histogram("t/ms", buckets=(1.0, 10.0, 100.0))
+    assert histogram_quantile(h, 0.5) == 0.0
+    assert histogram_quantile(h, 0.99) == 0.0
+    h.observe(5.0)
+    q = histogram_quantile(h, 0.99)
+    assert 1.0 <= q <= 10.0
+    h_inf = reg.histogram("t_inf/ms", buckets=(1.0, 10.0, 100.0))
+    h_inf.observe(1e9)  # lands in the +Inf bucket
+    assert histogram_quantile(h_inf, 0.99) == 100.0
+
+
+def test_snapshot_concurrent_with_remove_prefix():
+    """A scrape (snapshot / wire_snapshot) racing a replica retirement
+    (remove_prefix) must never throw — the hub scrapes on its own
+    thread while the autoscaler retires gauges on another."""
+    import threading
+    import time
+
+    from deepspeed_tpu.telemetry.registry import wire_snapshot
+
+    reg = MetricsRegistry()
+    reg.counter("fleet/requests_completed").inc()
+    h = reg.histogram("fleet/ttft_ms", buckets=(1.0, 10.0))
+    h.observe(2.0)
+    stop = threading.Event()
+    failures = []
+
+    def retire_loop():
+        i = 0
+        try:
+            while not stop.is_set():
+                for j in range(8):
+                    reg.gauge(f"fleet/replica{i}/g{j}").set(1.0)
+                reg.remove_prefix(f"fleet/replica{i}/")
+                i += 1
+        except Exception as e:  # pragma: no cover - the failure signal
+            failures.append(e)
+
+    t = threading.Thread(target=retire_loop)
+    t.start()
+    try:
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            snap = reg.snapshot()
+            # the stable series survive every interleaving
+            assert snap["fleet/requests_completed"] == 1.0
+            assert snap["fleet/ttft_ms/count"] == 1
+            entries = wire_snapshot(reg)
+            assert any(e["name"] == "fleet/ttft_ms" for e in entries)
+    finally:
+        stop.set()
+        t.join(5.0)
+    assert not failures, failures
+
+
+def test_render_prometheus_name_collision_keeps_first():
+    """prometheus_name() is lossy: two distinct registry names mapping
+    to one prom name must not interleave into a corrupt series — the
+    first claims the name, the rest drop into the suppressed-error
+    counter instead of silently merging."""
+    from deepspeed_tpu.telemetry import render_prometheus
+    from deepspeed_tpu.telemetry.registry import diagnostics_registry
+
+    before = (
+        diagnostics_registry()
+        .counter("internal/suppressed_errors/telemetry.prom_name_collision")
+        .value
+    )
+    entries = [
+        {"name": "a/b", "kind": "counter", "help": "", "value": 1.0},
+        {"name": "a.b", "kind": "counter", "help": "", "value": 2.0},
+        {"name": "a/b", "kind": "counter", "help": "", "value": 3.0,
+         "labels": {"node": "n0"}},
+    ]
+    text = render_prometheus(entries)
+    lines = [ln for ln in text.splitlines() if ln.startswith("a_b")]
+    # the claimed name keeps exporting (unlabeled + labeled sample);
+    # the colliding distinct name is gone
+    assert lines == ["a_b 1.0", 'a_b{node="n0"} 3.0'], lines
+    after = (
+        diagnostics_registry()
+        .counter("internal/suppressed_errors/telemetry.prom_name_collision")
+        .value
+    )
+    assert after == before + 1
