@@ -2371,6 +2371,280 @@ def smoke_chaos_net():
     }))
 
 
+def _router_failover_child():
+    """Hidden child entry for ``--smoke-router-failover``: build the
+    journal-armed socket fleet through the REAL production path
+    (``init_fleet`` detects the journal, plans adoption, adopts), open
+    the HTTP door, announce both on stdout, then serve until killed.
+    The parent SIGKILLs the first incarnation mid-traffic (the crash the
+    journal exists for) and reads the second incarnation's announcement
+    to pin the adoption."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import logging
+
+    import deepspeed_tpu
+    from deepspeed_tpu.serving import HTTPDoor
+
+    # stdout is the announce channel the parent parses: move the
+    # package logger's stream handler to stderr so adoption log lines
+    # cannot interleave with the JSON line
+    for handler in logging.getLogger("DeepSpeedTPU").handlers:
+        if isinstance(handler, logging.StreamHandler):
+            handler.setStream(sys.stderr)
+    spec = json.loads(
+        sys.argv[sys.argv.index("--router-failover-child") + 1]
+    )
+    router = deepspeed_tpu.init_fleet(nodes=spec["nodes"], config={
+        "serving": {
+            "backend": "socket",
+            "journal": {"enabled": True, "dir": spec["journal_dir"]},
+        },
+    })
+    door = HTTPDoor(router)
+    host, port = door.start()
+    snap = router.metrics.snapshot()
+    print(json.dumps({
+        "event": "serving", "host": host, "port": port,
+        "adopted": int(snap.get("fleet/adopted_replicas", 0)),
+    }), flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def smoke_router_failover():
+    """CI fast path (``python bench.py --smoke-router-failover``): the
+    durable control plane (docs/serving.md "Control-plane durability")
+    over REAL TCP — two stub node agents streaming one token per 50 ms,
+    a router child process with the journal armed, four greedy SSE
+    streams with Idempotency-Keys, then SIGKILL on the router
+    mid-traffic. A fresh router incarnation recovers the journal, adopts
+    BOTH nodes' live replicas, and every client retry (Idempotency-Key +
+    Last-Event-ID) replays its committed prefix and continues the same
+    generation. Pins: adoption count == 2, zero lost / zero duplicated
+    requests (node-side submit/complete counters stay at one per
+    request), bitwise greedy parity against the stub's pure-function
+    answer, event ids continuing exactly after each client's
+    Last-Event-ID, and >= 1 stream resumed mid-generation. The journal
+    directory is left under /tmp/ds_smoke_failover_* for the CI
+    artifact upload. Prints one JSON line; exits non-zero on any failed
+    check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import socket as socketlib
+    import tempfile
+
+    from deepspeed_tpu.serving.transport import NodeControlClient
+    from deepspeed_tpu.telemetry.registry import wire_scalars
+
+    extras = {}
+    tmp = tempfile.mkdtemp(prefix="ds_smoke_failover_", dir="/tmp")
+    journal_dir = os.path.join(tmp, "journal")
+
+    # one token per 50 ms: a 24-token answer is a ~1.2 s generation —
+    # a real mid-stream window to crash into. The long resume grace
+    # holds each node session (and its finished outbox) across the
+    # dead-router window, which includes a jax import in the child.
+    stub_spec = {"stub": {"token_delay_secs": 0.05}}
+    proc_a, addr_a = _launch_node(
+        "fa", stub_spec, lease_secs=60.0, resume_grace_secs=120.0,
+    )
+    proc_b, addr_b = _launch_node(
+        "fb", stub_spec, lease_secs=60.0, resume_grace_secs=120.0,
+    )
+    nodes = {
+        "fa": {"address": f"{addr_a[0]}:{addr_a[1]}", "replicas": ["r0"]},
+        "fb": {"address": f"{addr_b[0]}:{addr_b[1]}", "replicas": ["r0"]},
+    }
+    child_spec = json.dumps({"nodes": nodes, "journal_dir": journal_dir})
+
+    def launch_router():
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--router-failover-child", child_spec],
+            stdout=subprocess.PIPE, stderr=None, text=True,
+            env=dict(os.environ),
+        )
+        # the recovery incarnation logs adoption lines to stdout before
+        # announcing — skip anything that is not the announce JSON
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"router child exited before serving "
+                    f"(rc {proc.poll()})"
+                )
+            line = line.strip()
+            if line.startswith("{"):
+                info = json.loads(line)
+                if info.get("event") == "serving":
+                    return proc, info
+
+    n_tokens = 24
+    prompts = [[7, 100 + i * 17] for i in range(4)]
+
+    def stub_answer(p):
+        # StubWorkerEngine's pure function of the prompt — the bitwise
+        # parity reference needs no uncrashed run
+        return [(p[-1] + j + 1) % 1000 for j in range(n_tokens)]
+
+    def open_stream(host, port, i, last_event_id=None):
+        sock = socketlib.create_connection((host, port))
+        sock.settimeout(120.0)
+        body = json.dumps({
+            "prompt": prompts[i], "max_new_tokens": n_tokens,
+            "stream": True,
+        }).encode()
+        head = (f"POST /v1/generate HTTP/1.1\r\nHost: door\r\n"
+                f"Idempotency-Key: smoke-key-{i}\r\n")
+        if last_event_id is not None:
+            head += f"Last-Event-ID: {last_event_id}\r\n"
+        head += f"Content-Length: {len(body)}\r\n\r\n"
+        sock.sendall(head.encode() + body)
+        return sock
+
+    def parse_events(buf):
+        """SSE bytes -> ([(event_id, token_index, token)], done|None)."""
+        tokens, done, cur_id = [], None, None
+        for raw in buf.split(b"\n"):
+            if raw.startswith(b"id: "):
+                cur_id = int(raw[4:])
+            elif raw.startswith(b"data: "):
+                payload = json.loads(raw[6:])
+                if "t" in payload and "i" in payload:
+                    tokens.append((cur_id, payload["i"], payload["t"]))
+                    cur_id = None
+                elif "finish_reason" in payload:
+                    done = payload
+        return tokens, done
+
+    proc_r, info = launch_router()
+    try:
+        assert info["adopted"] == 0, info
+        host, port = info["host"], info["port"]
+        socks = [open_stream(host, port, i) for i in range(4)]
+        bufs = [b""] * 4
+        # read stream 0 until it is demonstrably mid-generation, then
+        # crash immediately — the other streams' prefixes are whatever
+        # the kernel buffered (possibly nothing; Last-Event-ID is then
+        # omitted on their retry and the replay starts at token 0)
+        while bufs[0].count(b"event: token") < 3:
+            chunk = socks[0].recv(4096)
+            assert chunk, "stream 0 ended before 3 tokens"
+            bufs[0] += chunk
+        t_crash = time.monotonic()
+        proc_r.kill()  # SIGKILL: no shutdown hooks, no journal flush
+        proc_r.wait(30)
+        for i, sock in enumerate(socks):
+            sock.settimeout(10.0)
+            try:
+                while True:
+                    chunk = sock.recv(4096)
+                    if not chunk:
+                        break
+                    bufs[i] += chunk
+            except OSError:
+                pass
+            sock.close()
+    except BaseException:
+        proc_r.kill()
+        for proc in (proc_a, proc_b):
+            proc.kill()
+        raise
+
+    prefixes = []
+    for i in range(4):
+        toks, done = parse_events(bufs[i])
+        assert done is None, (
+            f"stream {i} saw a terminal event before the crash", done,
+        )
+        # the delivered prefix is already bitwise-correct and contiguous
+        answer = stub_answer(prompts[i])
+        assert [t[1] for t in toks] == list(range(len(toks))), toks
+        assert all(t[0] == t[1] for t in toks), (
+            "id: fields diverged from token indices", toks,
+        )
+        assert [t[2] for t in toks] == answer[:len(toks)], (i, toks)
+        prefixes.append(toks)
+    assert len(prefixes[0]) >= 3
+
+    # ---- restart: recover, adopt, resume ------------------------------
+    proc_r2, info2 = launch_router()
+    try:
+        downtime = time.monotonic() - t_crash
+        assert info2["adopted"] == 2, (
+            "the restarted router did not adopt both node replicas",
+            info2,
+        )
+        host2, port2 = info2["host"], info2["port"]
+        resumed = 0
+        for i in range(4):
+            last_id = prefixes[i][-1][0] if prefixes[i] else None
+            if last_id is not None:
+                resumed += 1
+            sock = open_stream(host2, port2, i, last_event_id=last_id)
+            buf = b""
+            while b"event: done" not in buf:
+                chunk = sock.recv(65536)
+                assert chunk, f"resumed stream {i} ended without done"
+                buf += chunk
+            sock.close()
+            toks, done = parse_events(buf)
+            start = (last_id + 1) if last_id is not None else 0
+            assert [t[0] for t in toks] == list(range(start, n_tokens)), (
+                f"stream {i} replay ids did not continue after "
+                f"Last-Event-ID {last_id}", toks,
+            )
+            answer = stub_answer(prompts[i])
+            full = [t[2] for t in prefixes[i]] + [t[2] for t in toks]
+            assert full == answer, (
+                f"stream {i} spliced prefix + resume diverged", full,
+            )
+            assert done is not None and done["tokens"] == answer, done
+        assert resumed >= 1, "no stream was resumed mid-generation"
+
+        # zero lost / zero duplicated: each node-side stub replica saw
+        # every request exactly once — the adopted sessions carried the
+        # generations across the dead-router window with no re-submit
+        submitted = completed = 0
+        for addr in (addr_a, addr_b):
+            snap = NodeControlClient(addr).metrics_snapshot()
+            for entries in snap["replicas"].values():
+                scalars = wire_scalars(entries)
+                submitted += scalars.get("infer/requests_submitted", 0)
+                completed += scalars.get("infer/requests_completed", 0)
+        assert submitted == 4, (
+            f"{submitted} node-side submits for 4 requests — a lost "
+            "request was re-placed or a duplicate was generated"
+        )
+        assert completed == 4, (
+            f"{completed} node-side completions for 4 requests"
+        )
+        extras["adopted_replicas"] = 2
+        extras["streams_resumed"] = resumed
+        extras["prefix_tokens"] = len(prefixes[0])
+        extras["downtime_secs"] = round(downtime, 2)
+        extras["journal_dir"] = journal_dir
+        segs = [f for f in os.listdir(journal_dir)
+                if f.startswith("journal-")]
+        assert segs, "the journal directory holds no committed segments"
+        extras["journal_segments"] = len(segs)
+    finally:
+        proc_r2.kill()
+        proc_r2.wait(30)
+        for proc in (proc_a, proc_b):
+            proc.kill()
+            proc.wait(30)
+    # tmp is deliberately NOT removed: CI uploads the journal directory
+    # as an always() artifact for post-mortem on a failed run
+
+    print(json.dumps({
+        "metric": "smoke_router_failover",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": extras,
+    }))
+
+
 def smoke_autoscale():
     """CI fast path (``python bench.py --smoke-autoscale``): the SLO
     autoscaler's elastic loop over REAL TCP node fleets (docs/serving.md
@@ -3310,6 +3584,12 @@ def smoke_obs():
 
 
 def main():
+    if "--router-failover-child" in sys.argv:
+        _router_failover_child()
+        return
+    if "--smoke-router-failover" in sys.argv:
+        smoke_router_failover()
+        return
     if "--smoke" in sys.argv:
         smoke()
         return

@@ -823,6 +823,27 @@ class DeepSpeedConfig:
             hub_alerts, C.SERVING_HUB_ALERTS_SUPPRESSED_GROWTH,
             C.SERVING_HUB_ALERTS_SUPPRESSED_GROWTH_DEFAULT,
         )
+        jrn_dict = get_dict_param(srv_dict, C.SERVING_JOURNAL)
+        self.serving_journal_enabled = get_scalar_param(
+            jrn_dict, C.SERVING_JOURNAL_ENABLED,
+            C.SERVING_JOURNAL_ENABLED_DEFAULT,
+        )
+        self.serving_journal_dir = get_scalar_param(
+            jrn_dict, C.SERVING_JOURNAL_DIR,
+            C.SERVING_JOURNAL_DIR_DEFAULT,
+        )
+        self.serving_journal_fsync = get_scalar_param(
+            jrn_dict, C.SERVING_JOURNAL_FSYNC,
+            C.SERVING_JOURNAL_FSYNC_DEFAULT,
+        )
+        self.serving_journal_keep_segments = get_scalar_param(
+            jrn_dict, C.SERVING_JOURNAL_KEEP_SEGMENTS,
+            C.SERVING_JOURNAL_KEEP_SEGMENTS_DEFAULT,
+        )
+        self.serving_journal_max_inflight = get_scalar_param(
+            jrn_dict, C.SERVING_JOURNAL_MAX_INFLIGHT,
+            C.SERVING_JOURNAL_MAX_INFLIGHT_DEFAULT,
+        )
 
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
@@ -2316,6 +2337,50 @@ class DeepSpeedConfig:
                 f"be below {C.SERVING_HUB_ALERTS_SLOW_WINDOW_SECS} — the "
                 f"multiwindow burn rule needs a short and a long window"
             )
+        jr = f"{C.SERVING}.{C.SERVING_JOURNAL}"
+        jrn_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING), C.SERVING_JOURNAL
+        )
+        valid_jrn = {
+            C.SERVING_JOURNAL_ENABLED, C.SERVING_JOURNAL_DIR,
+            C.SERVING_JOURNAL_FSYNC, C.SERVING_JOURNAL_KEEP_SEGMENTS,
+            C.SERVING_JOURNAL_MAX_INFLIGHT,
+        }
+        unknown = set(jrn_dict) - valid_jrn
+        if unknown:
+            # a typo'd enabled would silently mean "no durability" — the
+            # operator learns only at the first router crash
+            raise DeepSpeedConfigError(
+                f"{jr}: unknown keys {sorted(unknown)}; valid: "
+                f"{sorted(valid_jrn)}"
+            )
+        for key, value in (
+            (C.SERVING_JOURNAL_ENABLED, self.serving_journal_enabled),
+            (C.SERVING_JOURNAL_FSYNC, self.serving_journal_fsync),
+        ):
+            if not isinstance(value, bool):
+                raise DeepSpeedConfigError(
+                    f"{jr}.{key} must be a boolean, got {value!r}"
+                )
+        jdir = self.serving_journal_dir
+        if not isinstance(jdir, str) or not jdir:
+            raise DeepSpeedConfigError(
+                f"{jr}.{C.SERVING_JOURNAL_DIR} must be a non-empty "
+                f"directory path, got {jdir!r}"
+            )
+        for key, value in (
+            (C.SERVING_JOURNAL_KEEP_SEGMENTS,
+             self.serving_journal_keep_segments),
+            (C.SERVING_JOURNAL_MAX_INFLIGHT,
+             self.serving_journal_max_inflight),
+        ):
+            if (
+                not isinstance(value, int) or isinstance(value, bool)
+                or value < 1
+            ):
+                raise DeepSpeedConfigError(
+                    f"{jr}.{key} must be an integer >= 1, got {value!r}"
+                )
 
     def _do_warning_check(self):
         if self.zero_enabled and not (self.fp16_enabled or self.bf16_enabled):

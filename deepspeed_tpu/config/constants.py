@@ -883,6 +883,32 @@ SERVING_HUB_ALERTS_BREAKER_FLOOD_DEFAULT = 3
 SERVING_HUB_ALERTS_SUPPRESSED_GROWTH = "suppressed_growth"
 SERVING_HUB_ALERTS_SUPPRESSED_GROWTH_DEFAULT = 10
 
+# "journal": the durable control plane (serving/journal.py,
+# docs/serving.md "Control-plane durability") — a write-ahead
+# fleet-state journal under ``dir``: node addresses, replica
+# memberships, fleet adapter registry, autoscaler target/cooldown,
+# brownout state, and a bounded in-flight request table, each mutation
+# committed (atomic tmp+fsync+rename snapshot segment) BEFORE it takes
+# effect. A restarting router finds the journal, re-dials node control
+# sessions, and adopts still-running generations instead of dropping
+# them. Disabled (the default) = zero-overhead passthrough: no journal
+# object, no directory, no write on any request path.
+SERVING_JOURNAL = "journal"
+SERVING_JOURNAL_ENABLED = "enabled"
+SERVING_JOURNAL_ENABLED_DEFAULT = False
+SERVING_JOURNAL_DIR = "dir"
+SERVING_JOURNAL_DIR_DEFAULT = "fleet_journal"
+# fsync=False trades durability-across-power-loss for latency; the
+# atomic rename still protects against torn segments either way
+SERVING_JOURNAL_FSYNC = "fsync"
+SERVING_JOURNAL_FSYNC_DEFAULT = True
+SERVING_JOURNAL_KEEP_SEGMENTS = "keep_segments"
+SERVING_JOURNAL_KEEP_SEGMENTS_DEFAULT = 3
+# ceiling on the journaled in-flight request table (oldest evicted
+# first) — bounds segment size under open-stream floods
+SERVING_JOURNAL_MAX_INFLIGHT = "max_inflight"
+SERVING_JOURNAL_MAX_INFLIGHT_DEFAULT = 256
+
 #############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,
 # which delegated model parallelism to an external mpu object)

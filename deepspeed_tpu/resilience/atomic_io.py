@@ -136,6 +136,18 @@ def atomic_write_text(path, text, fsync=True):
     atomic_write_bytes(path, text.encode("utf-8"), fsync=fsync)
 
 
+def torn_write_bytes(path, data, keep_fraction=0.5):
+    """Deliberately NON-atomic truncated write: the on-disk state a
+    crash mid-``write`` leaves behind (no tmp, no rename, a prefix of
+    the intended bytes). The counterpart to :func:`atomic_write_bytes`
+    for corruption testing — the ``journal.torn`` chaos site and the
+    checkpoint/journal corruption matrices produce torn files through
+    this one seam instead of each hand-rolling partial writes."""
+    keep = max(int(len(data) * float(keep_fraction)), 1)
+    with open(path, "wb") as f:
+        f.write(data[:keep])
+
+
 def read_bytes(path):
     with open(path, "rb") as f:
         return f.read()

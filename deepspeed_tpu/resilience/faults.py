@@ -127,6 +127,19 @@ KNOWN_FAULT_SITES = {
         "the receiver counts fleet/net_frames_corrupt and drops it; "
         "idempotent-RPC retry re-asks"
     ),
+    # -- durable control plane (serving/journal.py, docs/serving.md
+    # "Control-plane durability") ---------------------------------------
+    "router.crash": (
+        "SIGKILLs the router process at the monitor tick — the "
+        "router-host-death failure mode; the smoke's supervisor restarts "
+        "it and the fleet journal drives adoption"
+    ),
+    "journal.torn": (
+        "replaces one fleet-journal segment commit with a truncated "
+        "non-atomic write (args.keep_fraction, default 0.5) — recovery "
+        "must classify it CORRUPT and fall back to the previous valid "
+        "snapshot, never half-adopt"
+    ),
 }
 
 _RAISES = {

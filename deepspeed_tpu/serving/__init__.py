@@ -62,6 +62,12 @@ from .autoscaler import (
     SubprocessReplicaProvider,
 )
 from .http import HTTPDoor, serve_http
+from .journal import (
+    AdoptionPlan,
+    FleetJournal,
+    load_journal_state,
+    plan_adoption,
+)
 from .replica import (
     RPC_PROTOCOL_VERSION,
     InProcessReplica,
@@ -199,6 +205,52 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
 
     faults = build_fault_injector(cfg, registry=registry)
 
+    # durable control plane (journal.py, docs/serving.md "Control-plane
+    # durability"): disabled (the default) builds NOTHING — no journal
+    # object, no directory on disk, zero work on any request path. When
+    # armed and a prior incarnation left a journal behind, recover the
+    # newest valid snapshot and turn it into an adoption plan BEFORE
+    # replica construction so the router starts with the adopted
+    # sessions instead of dialing fresh ones over live generations.
+    journal = None
+    recovered = None
+    socket_kwargs = dict(
+        rpc_timeout=cfg.serving_rpc_timeout_secs,
+        rpc_retries=cfg.serving_rpc_retries,
+        rpc_backoff_secs=cfg.serving_rpc_backoff_secs,
+        connect_timeout=cfg.serving_socket_connect_timeout_secs,
+        connect_retries=cfg.serving_socket_connect_retries,
+        lease_secs=cfg.serving_socket_lease_secs,
+        reconnect_attempts=cfg.serving_socket_reconnect_attempts,
+        reconnect_backoff_secs=cfg.serving_socket_reconnect_backoff_secs,
+    )
+    if cfg.serving_journal_enabled:
+        from .journal import (
+            FleetJournal,
+            load_journal_state,
+            plan_adoption,
+        )
+
+        state, _recovery_info = load_journal_state(
+            cfg.serving_journal_dir, registry=registry
+        )
+        if state is not None:
+            recovered = plan_adoption(
+                state, registry=registry, fault_injector=faults,
+                socket_kwargs=socket_kwargs,
+                control_timeout=cfg.serving_socket_connect_timeout_secs,
+            )
+        journal = FleetJournal(
+            cfg.serving_journal_dir, registry=registry,
+            fault_injector=faults,
+            fsync=cfg.serving_journal_fsync,
+            keep_segments=cfg.serving_journal_keep_segments,
+            max_inflight=cfg.serving_journal_max_inflight,
+            state=state,
+        )
+        for node_name, block in (nodes or {}).items():
+            journal.record_node(node_name, block["address"])
+
     # SLO autoscaler (autoscaler.py, docs/serving.md "SLO autoscaling"):
     # built only when the block arms it — the disabled path constructs
     # NOTHING (no threads, no cost model, no per-tick work)
@@ -310,27 +362,31 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
             for i in range(cfg.serving_replicas)
         ]
     else:
+        adopted = {
+            r.replica_id: r
+            for r in (recovered.replicas if recovered is not None else ())
+        }
         replicas = []
         for node_name, block in nodes.items():
             address = block["address"]
             for rname in block.get("replicas") or ():
+                rid = f"{node_name}:{rname}"
+                if rid in adopted:
+                    # resume the prior incarnation's live node session
+                    # instead of dialing a fresh one over its still-
+                    # running generations
+                    replicas.append(adopted.pop(rid))
+                    continue
                 replicas.append(SocketReplica(
-                    f"{node_name}:{rname}", address, remote_name=rname,
-                    rpc_timeout=cfg.serving_rpc_timeout_secs,
-                    rpc_retries=cfg.serving_rpc_retries,
-                    rpc_backoff_secs=cfg.serving_rpc_backoff_secs,
-                    connect_timeout=cfg.serving_socket_connect_timeout_secs,
-                    connect_retries=cfg.serving_socket_connect_retries,
-                    lease_secs=cfg.serving_socket_lease_secs,
-                    reconnect_attempts=(
-                        cfg.serving_socket_reconnect_attempts
-                    ),
-                    reconnect_backoff_secs=(
-                        cfg.serving_socket_reconnect_backoff_secs
-                    ),
+                    rid, address, remote_name=rname,
                     registry=registry,
                     fault_injector=faults,
+                    **socket_kwargs,
                 ))
+        # journaled memberships absent from the restart's nodes map
+        # still carry live generations — adopt them rather than orphan
+        # their in-flight requests
+        replicas.extend(adopted.values())
         if not replicas:
             raise ValueError(
                 "the socket backend's nodes map names no replicas "
@@ -361,6 +417,8 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
         fault_injector=faults,
         autoscaler=autoscaler,
         hub=hub,
+        journal=journal,
+        recovered=recovered,
     )
     if start:
         router.start()
@@ -378,12 +436,14 @@ __all__ = [
     "AUTOSCALE_UP",
     "AdapterAffinity",
     "AdmissionController",
+    "AdoptionPlan",
     "Autoscaler",
     "AutoscalerPolicy",
     "BREAKER_CLOSED",
     "BREAKER_HALF_OPEN",
     "BREAKER_OPEN",
     "CircuitBreaker",
+    "FleetJournal",
     "FleetOverloaded",
     "FleetRequest",
     "FleetRouter",
@@ -408,5 +468,7 @@ __all__ = [
     "TelemetryHub",
     "TokenBucket",
     "init_fleet",
+    "load_journal_state",
+    "plan_adoption",
     "serve_http",
 ]

@@ -731,6 +731,69 @@ class Autoscaler:
         self._g_budget.set(1.0)
         return self
 
+    # -- durable control plane (journal.py) ------------------------------
+    def journal_snapshot(self):
+        """The autoscaler's durable half for the fleet journal: target,
+        cooldown anchor, hysteresis anchor, and the flap-budget evidence
+        — every monotonic stamp converted to wall clock, because a
+        monotonic reading is meaningless in the next process.
+        ``op_in_flight`` is deliberately transient: the op thread dies
+        with the router, and recovery re-provisions through the normal
+        tick instead of trusting a journaled promise."""
+        now_m = self._clock()
+        now_w = time.time()
+
+        def to_wall(stamp):
+            return (
+                None if stamp is None
+                else now_w - (now_m - float(stamp))
+            )
+
+        return {
+            "target": int(self.state.target),
+            "last_scale_unix": to_wall(self.state.last_scale_at),
+            "headroom_since_unix": to_wall(self.state.headroom_since),
+            "transitions": [
+                [to_wall(t), str(d)] for t, d in self.state.transitions
+            ],
+        }
+
+    def restore_journal(self, snap):
+        """Re-adopt a journaled snapshot (the reverse wall→monotonic
+        conversion) — the router's adoption completion calls this AFTER
+        :meth:`attach` anchored the target at the live count, so the
+        journaled target wins (re-clamped into [min, max]): a crash
+        mid-cooldown stays in cooldown, and flap evidence keeps
+        counting against the budget instead of resetting free."""
+        now_m = self._clock()
+        now_w = time.time()
+
+        def to_mono(stamp):
+            return (
+                None if stamp is None
+                else now_m - (now_w - float(stamp))
+            )
+
+        snap = dict(snap or {})
+        if "target" in snap:
+            self.state.target = min(
+                max(int(snap["target"]), self.policy.min_replicas),
+                self.policy.max_replicas,
+            )
+        self.state.last_scale_at = to_mono(snap.get("last_scale_unix"))
+        self.state.headroom_since = to_mono(
+            snap.get("headroom_since_unix")
+        )
+        self.state.transitions = tuple(
+            (to_mono(t), str(d))
+            for t, d in (snap.get("transitions") or ())
+        )
+        self.state.op_in_flight = False
+        gauge = getattr(self, "_g_target", None)
+        if gauge is not None:
+            gauge.set(self.state.target)
+        return self
+
     # -- the tick --------------------------------------------------------
     def tick(self, now=None):
         """One evaluation, rate-limited to ``interval_secs``; returns

@@ -73,11 +73,13 @@ registry and export through the same sinks (docs/observability.md).
 """
 
 import asyncio
+import collections
 import hmac
 import json
 import math
 import threading
 import time
+import uuid
 
 from ..inference.scheduler import (
     REJECT_CAPACITY,
@@ -124,10 +126,17 @@ class _RequestTooLarge(Exception):
     it would mistake for a network fault and retry)."""
 
 
-def _sse(event, payload):
+def _sse(event, payload, event_id=None):
+    """One SSE frame. ``event_id`` (the absolute token index on
+    ``token`` events) writes the ``id:`` field, which browsers and SSE
+    clients echo back as ``Last-Event-ID`` on reconnect — the resume
+    cursor the door's replay path consumes."""
+    head = f"event: {event}\n"
+    if event_id is not None:
+        head += f"id: {int(event_id)}\n"
     return (
-        f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode("utf-8")
-    )
+        head + f"data: {json.dumps(payload)}\n\n"
+    ).encode("utf-8")
 
 
 class HTTPDoor:
@@ -139,7 +148,7 @@ class HTTPDoor:
     def __init__(self, router, host="127.0.0.1", port=0, *,
                  max_buffer_bytes=65536, overrun_policy="drop",
                  poll_interval=0.002, registry=None, auth_token=None,
-                 hub=None):
+                 hub=None, idempotency_cache_size=256):
         if overrun_policy not in OVERRUN_POLICIES:
             raise ValueError(
                 f"unknown overrun_policy {overrun_policy!r}; valid: "
@@ -180,6 +189,28 @@ class HTTPDoor:
             help="streams dropped by the overrun policy: the client "
                  "drained slower than its tokens arrived",
         )
+        self._m_resumed = reg.counter(
+            "door/streams_resumed",
+            help="SSE streams resumed by a client retry "
+                 "(Idempotency-Key attach, replaying from Last-Event-ID)",
+        )
+        self._m_idem_replays = reg.counter(
+            "door/idempotent_replays",
+            help="POSTs answered from the idempotency cache's terminal "
+                 "result instead of re-running the generation",
+        )
+        # bounded terminal-result cache (Idempotency-Key dedup): the
+        # door's half of exactly-once delivery — a retried POST whose
+        # first attempt already finished replays the SAME result. Only
+        # touched from the event-loop thread, so no lock.
+        self.idempotency_cache_size = max(int(idempotency_cache_size), 1)
+        self._idem_lru = collections.OrderedDict()
+        # graceful restart (docs/serving.md "Control-plane durability"):
+        # armed by graceful_restart() / SIGTERM — every open stream
+        # emits a terminal ``restart`` event carrying its resume token
+        # before the door closes, and /readyz flips to 503 "restarting"
+        self._restart_event = asyncio.Event()
+        self._restart_retry_after = 1
         self._loop = None
         self._server = None
         self._thread = None
@@ -253,6 +284,54 @@ class HTTPDoor:
             self._thread = None
         self._loop = None
 
+    # -- graceful restart (docs/serving.md) -----------------------------
+    def graceful_restart(self, retry_after=1):
+        """Arm the restart drain: ``/readyz`` answers 503 "restarting"
+        immediately, and every open SSE stream emits one terminal
+        ``restart`` event — carrying its resume token (the request's
+        idempotency key + the last delivered event id) and a
+        ``retry_after_secs`` hint — then closes WITHOUT cancelling its
+        fleet request: the node keeps decoding, and the client's retry
+        re-attaches (this life) or adopts through the journal (the
+        next). The caller still owns the actual process exit."""
+        self._restart_retry_after = max(int(retry_after), 1)
+        loop = self._loop
+        if loop is not None:
+            loop.call_soon_threadsafe(self._restart_event.set)
+        else:
+            self._restart_event.set()
+        return self
+
+    def install_restart_signal(self, signals=("SIGTERM",),
+                               retry_after=1):
+        """Wire :meth:`graceful_restart` to process signals (main
+        thread only — elsewhere the cooperative call still works).
+        Returns self."""
+        import signal as _signal
+
+        def _on_signal(_signum, _frame):
+            logger.warning(
+                "door: restart signal received — draining open streams "
+                "with resume tokens"
+            )
+            self.graceful_restart(retry_after=retry_after)
+
+        for name in signals:
+            sig = getattr(_signal, name, None)
+            if sig is None:
+                continue
+            try:
+                _signal.signal(sig, _on_signal)
+            except ValueError as e:
+                # not the main thread: the signal cannot install; the
+                # cooperative graceful_restart() path remains
+                count_suppressed("serving.door_restart_signal", e)
+        return self
+
+    @property
+    def restarting(self):
+        return self._restart_event.is_set()
+
     @property
     def address(self):
         return self._host, self._port
@@ -285,6 +364,14 @@ class HTTPDoor:
             if method == "GET" and target == "/healthz":
                 await self._respond_json(writer, 200, self._health())
             elif method == "GET" and target == "/readyz":
+                if self._restart_event.is_set():
+                    # restarting: flip NOT-ready before the last stream
+                    # closes, so the LB steers new traffic away first
+                    await self._respond_json(
+                        writer, 503,
+                        {"ready": False, "reasons": ["restarting"]},
+                    )
+                    return
                 # readiness costs per-replica snapshot RPCs: keep the
                 # event loop (and every open stream) out of them
                 ready, reasons = await asyncio.get_event_loop(
@@ -534,7 +621,6 @@ class HTTPDoor:
         )
 
     async def _generate(self, reader, writer, headers, body):
-        del headers
         loop = asyncio.get_event_loop()
         try:
             prompt, tenant, priority, stream, kwargs = (
@@ -543,35 +629,154 @@ class HTTPDoor:
         except ValueError as e:
             await self._respond_json(writer, 400, {"error": str(e)})
             return
-        t_recv = time.monotonic()
-        try:
-            # submit can block on a replica's bounded admission queue:
-            # keep the event loop (and every other stream) out of it
-            fleet_req = await loop.run_in_executor(
-                None,
-                lambda: self.router.submit(
-                    prompt, tenant=tenant, priority=priority, **kwargs
-                ),
-            )
-        except RequestRejected as e:
-            status = STATUS_BY_REASON.get(e.reason, 503)
-            await self._respond_json(
-                writer, status, {"error": str(e), "reason": e.reason},
-                retry_after_secs=getattr(e, "retry_after_secs", None),
-            )
-            return
-        except (ValueError, TypeError) as e:
-            await self._respond_json(writer, 400, {"error": str(e)})
-            return
         greedy = not kwargs.get("temperature")
+        # resume headers (docs/serving.md "Control-plane durability"):
+        # Idempotency-Key names the request across retries; Last-Event-ID
+        # (the standard SSE reconnect cursor — the last ``id:`` the
+        # client saw, i.e. the last absolute token index delivered) asks
+        # the replay to start after it
+        idem_key = headers.get("idempotency-key") or None
+        start_at = 0
+        last_event_id = headers.get("last-event-id")
+        if last_event_id is not None:
+            try:
+                start_at = int(last_event_id) + 1
+            except ValueError:
+                await self._respond_json(writer, 400, {
+                    "error": "malformed Last-Event-ID header "
+                             "(expected the last token index)",
+                })
+                return
+        fleet_req = None
+        resumed = False
+        if idem_key is not None:
+            cached = self._idem_lru.get(idem_key)
+            if cached is not None:
+                # the first attempt already finished: replay the SAME
+                # terminal result — never a second generation
+                self._idem_lru.move_to_end(idem_key)
+                self._m_idem_replays.inc()
+                if stream:
+                    await self._replay_terminal(writer, cached, start_at)
+                else:
+                    await self._respond_json(writer, 200, cached)
+                return
+            live = self.router.find_inflight(idem_key)
+            if live is not None:
+                # unknown-but-in-flight: attach to the live generation
+                # (the crash-adoption case included — the journaled key
+                # rode the descriptor into the restored fleet request)
+                fleet_req = live
+                resumed = True
+        t_recv = time.monotonic()
+        if fleet_req is None:
+            submit_key = idem_key
+            if submit_key is None and stream:
+                # auto-mint a key for streams: it becomes the resume
+                # token the graceful-restart event hands back, so even
+                # clients that sent none can reconnect
+                submit_key = f"auto-{uuid.uuid4().hex}"
+            idem_key = submit_key
+            try:
+                # submit can block on a replica's bounded admission
+                # queue: keep the event loop (and every other stream)
+                # out of it
+                fleet_req = await loop.run_in_executor(
+                    None,
+                    lambda: self.router.submit(
+                        prompt, tenant=tenant, priority=priority,
+                        idempotency_key=submit_key, **kwargs
+                    ),
+                )
+            except RequestRejected as e:
+                status = STATUS_BY_REASON.get(e.reason, 503)
+                await self._respond_json(
+                    writer, status, {"error": str(e), "reason": e.reason},
+                    retry_after_secs=getattr(e, "retry_after_secs", None),
+                )
+                return
+            except (ValueError, TypeError) as e:
+                await self._respond_json(writer, 400, {"error": str(e)})
+                return
+        if resumed and not greedy and fleet_req.reroutes > 0:
+            # a SAMPLED generation that re-placed (its replica died, or
+            # it orphaned through a router crash) re-drew the sequence:
+            # the prefix the client already holds cannot be resumed —
+            # fail honestly instead of splicing two generations
+            payload = {
+                "error": "resumed a sampled stream that was re-routed; "
+                         "the delivered prefix cannot be continued — "
+                         "retry the request fresh",
+                "finish_reason": "rerouted_sampling",
+            }
+            if stream:
+                await self._respond_sse_error(writer, payload)
+            else:
+                await self._respond_json(writer, 502, payload)
+            return
         if stream:
             await self._stream_response(
-                writer, reader, fleet_req, t_recv, greedy=greedy
+                writer, reader, fleet_req, t_recv, greedy=greedy,
+                start_at=start_at, resumed=resumed, idem_key=idem_key,
             )
         else:
-            await self._unary_response(writer, reader, fleet_req)
+            await self._unary_response(
+                writer, reader, fleet_req, idem_key=idem_key
+            )
 
-    async def _unary_response(self, writer, reader, fleet_req):
+    async def _replay_terminal(self, writer, payload, start_at):
+        """Stream-shaped replay of a cached terminal result: the token
+        events after ``start_at`` (each with its ``id:``), then the same
+        ``done`` frame the first attempt delivered."""
+        writer.write((
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/event-stream\r\n"
+            "Cache-Control: no-store\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode("latin-1"))
+        tokens = payload.get("tokens") or []
+        for i in range(max(int(start_at), 0), len(tokens)):
+            writer.write(_sse(
+                "token", {"i": i, "t": int(tokens[i])}, event_id=i
+            ))
+        writer.write(_sse("done", payload))
+        try:
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    async def _respond_sse_error(self, writer, payload):
+        """A stream that fails before any token: SSE-shaped so the
+        client's event parser sees the typed error, not a broken
+        connection it would blindly retry."""
+        writer.write((
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/event-stream\r\n"
+            "Cache-Control: no-store\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode("latin-1"))
+        writer.write(_sse("error", payload))
+        try:
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    def _note_terminal(self, idem_key, fleet_req):
+        """Cache a successful terminal result under its idempotency key
+        (bounded LRU): the replay source for retried POSTs. Error /
+        cancelled finishes are NOT cached — the client's retry should
+        re-run those."""
+        if idem_key is None or fleet_req.finish_reason in (
+            "error", "cancelled",
+        ):
+            return
+        self._idem_lru[idem_key] = self._done_payload(fleet_req)
+        self._idem_lru.move_to_end(idem_key)
+        while len(self._idem_lru) > self.idempotency_cache_size:
+            self._idem_lru.popitem(last=False)
+
+    async def _unary_response(self, writer, reader, fleet_req,
+                              idem_key=None):
         # same hangup watch as the stream path: an abandoned unary
         # request must free its slot within one decode step too, not
         # decode its whole budget for nobody
@@ -607,6 +812,7 @@ class HTTPDoor:
                          f"{fleet_req.reroutes} re-route(s))",
             })
             return
+        self._note_terminal(idem_key, fleet_req)
         await self._respond_json(writer, 200, self._done_payload(fleet_req))
 
     @staticmethod
@@ -621,12 +827,18 @@ class HTTPDoor:
         }
 
     async def _stream_response(self, writer, reader, fleet_req, t_recv,
-                               greedy=True):
+                               greedy=True, start_at=0, resumed=False,
+                               idem_key=None):
         """The SSE loop: poll the replica-side handle and flush each new
-        token the moment the scheduler finishes it. The three exits:
-        done (terminal event), client disconnect (cancel — the slot
-        frees within one decode step), buffer overrun under the drop
-        policy (cancel, same path)."""
+        token the moment the scheduler finishes it. The exits: done
+        (terminal event), client disconnect (cancel — the slot frees
+        within one decode step), buffer overrun under the drop policy
+        (cancel, same path), and a graceful restart (terminal
+        ``restart`` event with the resume token; the fleet request is
+        deliberately NOT cancelled — the node keeps decoding and the
+        client's retry re-attaches). A resumed stream starts emitting at
+        ``start_at`` (the client's Last-Event-ID + 1): earlier indices
+        were already delivered."""
         transport = writer.transport
         try:
             transport.set_write_buffer_limits(high=self.max_buffer_bytes)
@@ -644,11 +856,34 @@ class HTTPDoor:
         # request) — poll it as a task instead of blocking on it
         hangup = asyncio.ensure_future(reader.read(64))
         self._m_open.inc(1)
-        sent = 0
+        if resumed:
+            self._m_resumed.inc()
+        sent = max(int(start_at), 0)
         first_at = None
         last_inner = None
         try:
             while True:
+                if self._restart_event.is_set():
+                    writer.write(_sse("restart", {
+                        "finish_reason": "restart",
+                        "retry_after_secs": self._restart_retry_after,
+                        "resume": {
+                            "idempotency_key": idem_key,
+                            "last_event_id": (
+                                sent - 1 if sent > 0 else None
+                            ),
+                        },
+                    }))
+                    try:
+                        await writer.drain()
+                    except (ConnectionError, OSError):
+                        pass
+                    logger.info(
+                        "door: stream for fleet request %s handed its "
+                        "resume token (restart drain)",
+                        fleet_req.request_id,
+                    )
+                    return
                 if hangup.done():
                     try:
                         stray = hangup.result()
@@ -705,9 +940,15 @@ class HTTPDoor:
                 while sent < len(tokens):
                     if first_at is None:
                         first_at = time.monotonic()
-                        self._m_ttft.observe((first_at - t_recv) * 1e3)
+                        if not resumed:
+                            # a resumed stream's "first" token is a
+                            # replay — it would poison the TTFT series
+                            self._m_ttft.observe(
+                                (first_at - t_recv) * 1e3
+                            )
                     writer.write(_sse(
-                        "token", {"i": sent, "t": int(tokens[sent])}
+                        "token", {"i": sent, "t": int(tokens[sent])},
+                        event_id=sent,
                     ))
                     sent += 1
                     if not await self._flush_stream(writer, fleet_req):
@@ -720,6 +961,7 @@ class HTTPDoor:
                             "finish_reason": fleet_req.finish_reason,
                         }))
                     else:
+                        self._note_terminal(idem_key, fleet_req)
                         writer.write(_sse(
                             "done", self._done_payload(fleet_req)
                         ))

@@ -411,6 +411,20 @@ class NodeServer:
                 session = _Session(client, name, engine)
                 self._sessions[key] = session
         with session.lock:
+            if hello.get("replay"):
+                # adoption resume (docs/serving.md "Control-plane
+                # durability"): a RESTARTED router presents the
+                # journaled client token with empty request handles —
+                # rewind every tracked request's sent counter so the
+                # watch loop re-emits the committed token prefix from
+                # index 0 (absolute indices make the re-emit idempotent
+                # for an ordinary client; for the adopted one it IS the
+                # prefix)
+                session.tracked = {
+                    rpc_id: (req, announced, 0)
+                    for rpc_id, (req, announced, _sent)
+                    in session.tracked.items()
+                }
             # the authoritative "node remembers these" list: in-flight
             # requests PLUS anything that finished while the client was
             # away — its ``finished`` event still sits in the outbox, and

@@ -352,6 +352,10 @@ class RpcReplicaBase(ReplicaBase):
         self._write_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._rpc_ids = iter(range(1, 1 << 62)).__next__
+        # high-water mark of minted rpc ids: the fleet journal records
+        # it with the session descriptor so a restarted router can
+        # re-base above every id this incarnation ever used
+        self._rpc_seq = 0
         self._outstanding = {}   # rpc_id -> RemoteRequest
         self._replies = {}       # rpc_id -> reply payload
         self._expected = set()   # rpc_ids with a live reply waiter
@@ -388,6 +392,28 @@ class RpcReplicaBase(ReplicaBase):
                 reason=REJECT_DRAINING,
             )
         return ReplicaRPCError(f"replica {self.replica_id} {detail}")
+
+    def _mint_rpc_id(self):
+        """Mint the next rpc id and advance the high-water mark (the
+        journal's re-base evidence). Atomic under the GIL — both the
+        iterator step and the monotone hwm write are single ops."""
+        rpc_id = self._rpc_ids()
+        self._rpc_seq = rpc_id
+        return rpc_id
+
+    def _rebase_rpc_ids(self, base):
+        """Restart id minting ABOVE ``base``: an adopted node session
+        still tracks the previous incarnation's rpc ids, and a new
+        submit reusing one would cross-wire the node's in-flight table
+        onto the wrong request."""
+        base = int(base)
+        self._rpc_ids = iter(range(base + 1, 1 << 62)).__next__
+        self._rpc_seq = base
+
+    @property
+    def rpc_seq(self):
+        """Highest rpc id minted by this incarnation (journal surface)."""
+        return self._rpc_seq
 
     def _reset_rpc_state(self):
         """Called at (re)start: stale RPC state from a previous
@@ -522,7 +548,7 @@ class RpcReplicaBase(ReplicaBase):
 
     def _call(self, msg, timeout=None):
         """Send an op expecting a ``reply`` event; returns the reply."""
-        rpc_id = self._rpc_ids()
+        rpc_id = self._mint_rpc_id()
         msg = dict(msg, id=rpc_id)
         with self._reply_cond:
             self._expected.add(rpc_id)
@@ -577,7 +603,7 @@ class RpcReplicaBase(ReplicaBase):
         return msg
 
     def submit(self, prompt_tokens, max_new_tokens=32, **kwargs):
-        rpc_id = self._rpc_ids()
+        rpc_id = self._mint_rpc_id()
         req = RemoteRequest(rpc_id, prompt_tokens, max_new_tokens)
         with self._state_lock:
             self._outstanding[rpc_id] = req

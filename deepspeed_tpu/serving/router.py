@@ -36,6 +36,8 @@ the engines use.
 
 import itertools
 import math
+import os
+import signal
 import threading
 import time
 
@@ -278,6 +280,54 @@ class FleetRequest:
         self.finish_reason = reason
         self._done.set()
 
+    @classmethod
+    def _reseed_ids(cls, floor):
+        """Continue the door's request-id sequence past a recovered
+        journal's high-water mark — adopted ids and new ids must never
+        collide (the journal's in-flight table and the door's
+        idempotency index both key on them)."""
+        cls._ids = itertools.count(int(floor) + 1)
+
+    @classmethod
+    def _restore(cls, request_id, entry):
+        """Rebuild a fleet request from its journaled descriptor (the
+        adoption path): the EXPLICIT journaled id instead of a minted
+        one, re-route budget already charged, and the end-to-end
+        deadline re-anchored from its journaled wall-clock form."""
+        req = cls.__new__(cls)
+        req.request_id = int(request_id)
+        req.prompt_tokens = [int(t) for t in entry.get("prompt") or ()]
+        req.tenant = entry.get("tenant", "default")
+        req.kwargs = dict(entry.get("kwargs") or {})
+        req.trace_ctx = None
+        req.tokens = []
+        req.finish_reason = None
+        req.replica_id = entry.get("replica")
+        req.reroutes = int(entry.get("reroutes", 0))
+        req.submitted_at = time.monotonic()
+        deadline_unix = entry.get("deadline_unix")
+        req.deadline_at = (
+            time.monotonic() + (float(deadline_unix) - time.time())
+            if deadline_unix is not None else None
+        )
+        req._done = threading.Event()
+        return req
+
+
+class _OrphanHandle:
+    """Stand-in inner handle for a journaled in-flight request whose
+    replica could NOT be adopted (dead node, replica left the roster):
+    already dead-on-arrival, so the monitor's outstanding sweep re-places
+    it through the ordinary re-route budget — the same path a replica
+    death in THIS life takes."""
+
+    done = True
+    finish_reason = _FINISH_ERROR
+    first_token_at = None
+
+    def __init__(self):
+        self.tokens = []
+
 
 # ---------------------------------------------------------------------------
 # router
@@ -297,7 +347,8 @@ class FleetRouter:
                  breaker_backoff_secs=0.5, breaker_backoff_max_secs=30.0,
                  zombie_secs=0.0, zombie_restart_budget=2,
                  brownout_queue_ratio=None, brownout_max_new_tokens=16,
-                 fault_injector=None, autoscaler=None, hub=None):
+                 fault_injector=None, autoscaler=None, hub=None,
+                 journal=None, recovered=None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         from ..telemetry.manager import register_serving_metrics
@@ -320,6 +371,35 @@ class FleetRouter:
         self._faults = (
             fault_injector if fault_injector is not None else NULL_INJECTOR
         )
+        # durable control plane (journal.py, docs/serving.md
+        # "Control-plane durability"): None = feature off, no journal
+        # files, zero write-path work. ``recovered`` is an AdoptionPlan
+        # from plan_adoption(); start() completes it — until the first
+        # full telemetry refresh after that, readiness() reports
+        # "recovering" so an external LB holds traffic off a fleet whose
+        # adopted state is still settling.
+        self._journal = journal
+        self._recovered = recovered
+        self._recovering = recovered is not None
+        self._last_autoscaler_snap = None
+        # door idempotency: key -> live FleetRequest, so a retried POST
+        # attaches to the in-flight generation instead of re-running it
+        # (terminal results replay from the door's own LRU, http.py)
+        self._idem_index = {}
+        if recovered is not None and recovered.state is not None:
+            # adopted ids and freshly minted ids share one sequence
+            FleetRequest._reseed_ids(
+                recovered.state.get("request_seq", -1)
+            )
+            # the journaled fleet-wide adapter registry replays into the
+            # restart/add_replica paths — adopted node engines still hold
+            # their weights; a replica REBUILT after adoption must re-hear
+            # the loads exactly as in the previous life
+            self._adapter_registry_seed = dict(
+                recovered.state.get("adapters") or {}
+            )
+        else:
+            self._adapter_registry_seed = {}
         # per-replica circuit breakers (breaker.py): fed by submit-path
         # outcomes, filtered on in _candidates — an open replica costs
         # placement nothing instead of a doomed submit + re-route.
@@ -388,7 +468,7 @@ class FleetRouter:
         # rebuilds — a rolling restart must not silently shed the tenants'
         # weights (docs/adapters.md). Targeted loads (replica_ids=...)
         # stay the caller's business.
-        self._adapter_registry = {}
+        self._adapter_registry = dict(self._adapter_registry_seed)
         self._draining = False
         self._stop = threading.Event()
         self._monitor = None
@@ -436,6 +516,7 @@ class FleetRouter:
         self._zombie_restarts = reg.counter("fleet/zombie_restarts")
         self._brownout_gauge = reg.gauge("fleet/brownout")
         self._browned_out = reg.counter("fleet/requests_browned_out")
+        self._adopted_gauge = reg.gauge("fleet/adopted_replicas")
         # the SLO autoscaler (autoscaler.py): None = feature off, zero
         # overhead, no new threads — the monitor tick checks and moves on
         self._autoscaler = autoscaler
@@ -451,17 +532,145 @@ class FleetRouter:
     # -- lifecycle ------------------------------------------------------
     def start(self):
         """Start every replica (engines build, drivers spin up) and the
-        monitor thread; returns self."""
+        monitor thread; returns self. A router built over an adoption
+        plan (``recovered``) completes the adoption here: the replica
+        starts above resumed their journaled node sessions, so their
+        pre-registered in-flight handles bind into the outstanding table
+        before the monitor's first sweep can look."""
         for rid in self._order:
             self._replicas[rid].start()
         with self._lock:
             self._routable.update(self._order)
+        self._complete_adoption()
+        if self._journal is not None:
+            # write-ahead the live memberships: each replica's session
+            # descriptor (client token, rpc high-water mark) is what the
+            # NEXT router life presents to resume the node session
+            for rid in self._order:
+                self._journal_replica(rid)
+            if self._brownout:
+                self._journal.set_brownout(True)
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="ds-fleet-monitor", daemon=True
         )
         self._monitor.start()
         self.refresh_telemetry()
         return self
+
+    def _complete_adoption(self):
+        """Finish the crash-recovery adoption (docs/serving.md
+        "Control-plane durability"): probation-arm the adopted replicas'
+        breakers, bind the nodes' resumed in-flight handles to restored
+        fleet requests, park un-adoptable descriptors as dead-on-arrival
+        orphans for the re-route sweep, and replay journaled brownout /
+        autoscaler state. Runs once, from start(), after the replicas
+        resumed their node sessions."""
+        plan, self._recovered = self._recovered, None
+        if plan is None:
+            return
+        state = plan.state or {}
+        # brownout replays FIRST: the adopted node engines kept serving
+        # while the router was dead and must re-hear the degrade toggle
+        # before traffic lands (the next refresh recomputes the real
+        # fill ratio and exits the band if the queue drained meanwhile)
+        if state.get("brownout") and self.brownout_queue_ratio is not None:
+            with self._brownout_lock:
+                self._brownout = True
+                self._brownout_gauge.set(1.0)
+            for rid in self._order:
+                if rid not in self._evicted:
+                    self._set_replica_brownout(rid, True)
+        # adopted replicas re-earn trust through the half-open probation
+        # window: journaled breaker counts are deliberately NOT restored
+        # (the new life's first request IS the probe)
+        adopted = [
+            rid for rid in plan.adopted_ids if rid in self._replicas
+        ]
+        for rid in adopted:
+            breaker = self._breakers.get(rid)
+            if breaker is not None:
+                breaker.begin_probation()
+        self._adopted_gauge.set(len(adopted))
+        # bind each adopted replica's pre-registered handles into the
+        # outstanding table: completions that finished while the router
+        # was dead DELIVER from the node outbox on the first sweep;
+        # requests the node forgot fail-finished at resume and re-route
+        bound = set()
+        for replica in plan.replicas:
+            rid = replica.replica_id
+            if rid not in self._replicas:
+                continue
+            handles = replica.adopted_handles()
+            for req_id, entry in sorted(plan.inflight.items()):
+                if str(entry.get("replica")) != str(rid):
+                    continue
+                inner = handles.get(entry.get("rpc_id"))
+                if inner is None:
+                    continue
+                fleet_req = FleetRequest._restore(req_id, entry)
+                with self._lock:
+                    self._outstanding[req_id] = (fleet_req, inner, rid)
+                    if entry.get("idem"):
+                        self._idem_index[entry["idem"]] = fleet_req
+                bound.add(req_id)
+        # descriptors with no adopted handle (dead node, replica left
+        # the roster): dead-on-arrival — the monitor's sweep re-places
+        # them under the ordinary ``max_reroutes`` budget
+        orphans = 0
+        for req_id, entry in sorted(plan.inflight.items()):
+            if req_id in bound:
+                continue
+            fleet_req = FleetRequest._restore(req_id, entry)
+            with self._lock:
+                self._outstanding[req_id] = (
+                    fleet_req, _OrphanHandle(), entry.get("replica")
+                )
+                if entry.get("idem"):
+                    self._idem_index[entry["idem"]] = fleet_req
+            orphans += 1
+        for rid, reason in plan.lost_replicas:
+            logger.warning(
+                "fleet journal: membership %s NOT adopted (%s); its "
+                "in-flight requests re-place", rid, reason,
+            )
+            if self._journal is not None:
+                self._journal.forget_replica(rid)
+        if self._autoscaler is not None and state.get("autoscaler"):
+            self._autoscaler.restore_journal(state["autoscaler"])
+        logger.info(
+            "fleet journal: adopted %d replica session(s), restored %d "
+            "in-flight request(s) (%d orphaned to re-route)",
+            len(adopted), len(bound) + orphans, orphans,
+        )
+
+    def _journal_replica(self, rid):
+        """Write-ahead one replica's membership + live session handle
+        (client token, rpc-id high-water mark) — what the next router
+        life presents to resume the node session. Replicas without a
+        socket address journal as non-adoptable memberships."""
+        if self._journal is None:
+            return
+        replica = self._replicas.get(rid)
+        if replica is None:
+            return
+        self._journal.record_replica(
+            rid,
+            node=getattr(replica, "node_id", None),
+            address=getattr(replica, "address", None),
+            remote_name=getattr(replica, "remote_name", None),
+            client=getattr(replica, "client_token", None),
+            rpc_seq=getattr(replica, "rpc_seq", 0),
+        )
+
+    def find_inflight(self, idempotency_key):
+        """The fleet request holding ``idempotency_key`` — the door's
+        attach path for a retried POST: a live request means "attach to
+        the in-flight generation", a finished one means "replay its
+        terminal result" (the crash-recovery case where the first
+        attempt completed before the client retried), None means the key
+        was never seen (or aged out) and the POST runs fresh."""
+        with self._lock:
+            return self._idem_index.get(str(idempotency_key))
 
     def shutdown(self, timeout=30.0):
         """Stop the monitor, shut every replica down, and fail-finish
@@ -500,6 +709,10 @@ class FleetRouter:
             orphans = [fr for fr, _inner, _rid in self._outstanding.values()]
             self._outstanding.clear()
         for fr in orphans:
+            if self._journal is not None:
+                # a graceful shutdown's cancellations are terminal: the
+                # next life must not adopt (and re-run) them
+                self._journal.close_request(fr.request_id)
             self._trace_finish_root(fr, _FINISH_CANCELLED)
             fr._finish(fr.tokens, _FINISH_CANCELLED)
         if self._preemption is not None:
@@ -611,6 +824,9 @@ class FleetRouter:
             self._routable.add(replica_id)
             self._force_failed.discard(replica_id)
         self._progress.pop(replica_id, None)
+        # a rebuilt socket replica minted a FRESH session (new client
+        # token, rpc ids from 1): the journal must carry the new handle
+        self._journal_replica(replica_id)
         self.refresh_telemetry()
         return True
 
@@ -695,6 +911,7 @@ class FleetRouter:
         self._progress.pop(rid, None)
         if self._brownout:
             self._set_replica_brownout(rid, True)
+        self._journal_replica(rid)
         logger.info(
             "fleet: replica %s registered%s (%d live)", rid,
             " behind its half-open probation probe" if probation else "",
@@ -730,6 +947,11 @@ class FleetRouter:
                 "anyway (outstanding requests will re-route)",
                 replica_id, wait_idle_timeout,
             )
+        if self._journal is not None:
+            # write-ahead: the membership leaves the journal BEFORE the
+            # router forgets it — a crash mid-removal must not adopt a
+            # replica the autoscaler already owns the teardown of
+            self._journal.forget_replica(replica_id)
         with self._lock:
             self._replicas.pop(replica_id, None)
             if replica_id in self._order:
@@ -788,6 +1010,11 @@ class FleetRouter:
                 e.partial = dict(results)
                 raise
         if fleet_wide:
+            if self._journal is not None:
+                # write-ahead: a crash between the journal commit and the
+                # registry write re-registers on recovery (idempotent);
+                # the reverse order would silently shed tenants' weights
+                self._journal.record_adapter(name, kwargs)
             self._adapter_registry[name] = dict(kwargs)
         self._adapter_loads.inc(len(results))
         self.refresh_telemetry()
@@ -798,6 +1025,8 @@ class FleetRouter:
         non-evicted) replicas; replicas refusing (live requests) raise.
         Returns ``{replica_id: freed pool row}``."""
         if replica_ids is None:
+            if self._journal is not None:
+                self._journal.forget_adapter(name)
             self._adapter_registry.pop(name, None)
             replica_ids = [
                 rid for rid in self._order if rid not in self._evicted
@@ -813,8 +1042,15 @@ class FleetRouter:
         return results
 
     # -- submission -----------------------------------------------------
-    def submit(self, prompt_tokens, tenant="default", priority=0, **kwargs):
+    def submit(self, prompt_tokens, tenant="default", priority=0,
+               idempotency_key=None, **kwargs):
         """Admit + place one request; returns a :class:`FleetRequest`.
+
+        ``idempotency_key`` (the door's ``Idempotency-Key`` header)
+        registers the request in the router's in-flight index so a
+        retried POST can attach to the live generation via
+        :meth:`find_inflight`, and rides the journal descriptor so the
+        attach survives a router crash.
 
         Raises :class:`RateLimited` (tenant bucket empty),
         :class:`FleetOverloaded` (no replica can take it / pressure shed
@@ -908,8 +1144,38 @@ class FleetRouter:
                 "every routable replica rejected the request at its own "
                 "door (queues full)"
             )
+        if self._journal is not None:
+            # write-ahead the placement BEFORE the outstanding insert: a
+            # crash from here on finds the descriptor and adopts (or
+            # re-places) the request; a crash before here never admitted
+            # it, so the client's retry re-runs it — exactly-once either
+            # way. Never per token: this is the request's one open write.
+            self._journal.open_request(
+                fleet_req.request_id,
+                prompt=fleet_req.prompt_tokens,
+                tenant=fleet_req.tenant,
+                kwargs=fleet_req.kwargs,
+                replica_id=rid,
+                rpc_id=getattr(inner, "rpc_id", None),
+                idempotency_key=idempotency_key,
+                deadline_unix=(
+                    time.time()
+                    + (fleet_req.deadline_at - time.monotonic())
+                    if fleet_req.deadline_at is not None else None
+                ),
+            )
         with self._lock:
             self._outstanding[fleet_req.request_id] = (fleet_req, inner, rid)
+            if idempotency_key is not None:
+                if len(self._idem_index) >= 4096:
+                    # lazy bound: drop finished entries before growing
+                    # (the door's LRU owns terminal replay; this index
+                    # only needs the LIVE attach targets)
+                    self._idem_index = {
+                        k: r for k, r in self._idem_index.items()
+                        if not r.done
+                    }
+                self._idem_index[str(idempotency_key)] = fleet_req
         if self._stop.is_set():
             # raced shutdown's outstanding sweep: the monitor is gone and
             # nobody will ever sweep this entry — fail it NOW so result()
@@ -917,6 +1183,8 @@ class FleetRouter:
             # scheduler's own raced-shutdown path)
             with self._lock:
                 self._outstanding.pop(fleet_req.request_id, None)
+            if self._journal is not None:
+                self._journal.close_request(fleet_req.request_id)
             fleet_req._finish(fleet_req.tokens, _FINISH_CANCELLED)
             self._rejected.inc()
             raise RequestRejected(
@@ -939,6 +1207,8 @@ class FleetRouter:
             entry = self._outstanding.pop(fleet_req.request_id, None)
         if entry is None:
             return False
+        if self._journal is not None:
+            self._journal.close_request(fleet_req.request_id)
         _fr, inner, rid = entry
         replica = self._replicas.get(rid)
         do_cancel = getattr(replica, "cancel_request", None)
@@ -1220,6 +1490,10 @@ class FleetRouter:
         """(under self._brownout_lock) export + propagate one brownout
         edge; transitions are rare, so holding the lock across the
         replica toggle RPCs keeps every observer consistent."""
+        if self._journal is not None:
+            # write-ahead: a router that dies mid-brownout restarts
+            # degraded instead of serving full budgets into a full queue
+            self._journal.set_brownout(active)
         self._brownout_gauge.set(1.0 if active else 0.0)
         logger.warning(
             "fleet: brownout %s (queue fill ratio %.3f vs threshold "
@@ -1270,6 +1544,17 @@ class FleetRouter:
             self._stop.wait(self._monitor_interval)
 
     def _tick(self):
+        if self._faults.enabled and (
+            self._faults.fire("router.crash") is not None
+        ):
+            # chaos site router.crash: the router HOST dies — not an
+            # exception, a SIGKILL, so no finally block or atexit runs
+            # and only the journal + the nodes' durable sessions remain
+            logger.warning(
+                "FAULT router.crash: SIGKILLing the router process "
+                "(pid %d)", os.getpid(),
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
         if (
             self._preemption is not None
             and self._preemption.armed
@@ -1285,6 +1570,14 @@ class FleetRouter:
         if self._autoscaler is not None:
             try:
                 self._autoscaler.tick()
+                if self._journal is not None:
+                    # journal-on-change: the autoscaler's durable half
+                    # (target / cooldown / flap evidence) commits only
+                    # when it actually moved — ticks are hot, scales rare
+                    snap = self._autoscaler.journal_snapshot()
+                    if snap != self._last_autoscaler_snap:
+                        self._last_autoscaler_snap = snap
+                        self._journal.set_autoscaler(snap)
             except Exception as e:
                 # a broken autoscaler must not take the zombie/eviction
                 # sweeps down with it
@@ -1415,6 +1708,11 @@ class FleetRouter:
             if inner.finish_reason in _TERMINAL_REASONS:
                 with self._lock:
                     self._outstanding.pop(req_id, None)
+                if self._journal is not None:
+                    # terminal BEFORE delivery: a crash between this
+                    # close and _finish re-delivers from the node outbox
+                    # (idempotent), never re-runs the generation
+                    self._journal.close_request(req_id)
                 ctx = fleet_req.trace_ctx
                 traced = self.tracer.enabled and ctx is not None
                 first = getattr(inner, "first_token_at", None)
@@ -1458,6 +1756,8 @@ class FleetRouter:
         if fleet_req.reroutes >= self.max_reroutes:
             with self._lock:
                 self._outstanding.pop(req_id, None)
+            if self._journal is not None:
+                self._journal.close_request(req_id)
             self._trace_finish_root(fleet_req, _FINISH_ERROR, inner=inner)
             fleet_req._finish(fleet_req.tokens, _FINISH_ERROR)
             return
@@ -1469,6 +1769,8 @@ class FleetRouter:
                 # a fresh full-budget generation somewhere else
                 with self._lock:
                     self._outstanding.pop(req_id, None)
+                if self._journal is not None:
+                    self._journal.close_request(req_id)
                 self._trace_finish_root(
                     fleet_req, "deadline", inner=inner
                 )
@@ -1482,6 +1784,8 @@ class FleetRouter:
             if self._stop.is_set() or self._draining or fleet_dead:
                 with self._lock:
                     self._outstanding.pop(req_id, None)
+                if self._journal is not None:
+                    self._journal.close_request(req_id)
                 self._trace_finish_root(
                     fleet_req, _FINISH_ERROR, inner=inner
                 )
@@ -1506,6 +1810,14 @@ class FleetRouter:
                 attrs={"replica": rid, "attempt": fleet_req.reroutes},
             )
         self._rerouted.inc()
+        if self._journal is not None:
+            # the descriptor follows the request to its new placement:
+            # a crash after this adopts the NEW session's rpc id
+            self._journal.move_request(
+                req_id, replica_id=rid,
+                rpc_id=getattr(inner, "rpc_id", None),
+                reroutes=fleet_req.reroutes,
+            )
         with self._lock:
             # a cancel() can land between placement and this re-insert:
             # the fleet request is already finished "cancelled" then, so
@@ -1626,6 +1938,11 @@ class FleetRouter:
         self._ttft_p99.set(histogram_quantile(self._ttft, 0.99))
         self._last_refresh = self._clock()
         self._refreshes += 1
+        if self._recovering and self._recovered is None:
+            # first FULL refresh after adoption completed: every adopted
+            # replica answered a live snapshot above, so the fleet's
+            # load picture is real again — stop advertising "recovering"
+            self._recovering = False
         if self._telemetry is not None and self._telemetry.enabled:
             self._telemetry.export(step=self._refreshes)
 
@@ -1638,6 +1955,11 @@ class FleetRouter:
         health — an LB should stop routing here BEFORE requests shed.
         Liveness is ``/healthz``'s job; this is about taking traffic."""
         reasons = []
+        if self._recovering:
+            # crash-recovery adoption in progress (or not yet refreshed):
+            # the adopted fleet's load picture is stale — an LB should
+            # let the previous traffic settle before routing here
+            reasons.append("recovering")
         if self._stop.is_set() or self._draining:
             reasons.append("draining")
         if self._brownout:
@@ -1654,6 +1976,18 @@ class FleetRouter:
         """The attached SLO autoscaler (autoscaler.py), or None when
         the feature is off (zero-overhead passthrough)."""
         return self._autoscaler
+
+    @property
+    def journal(self):
+        """The attached fleet-state journal (journal.py), or None when
+        serving.journal is off (no files, zero write-path work)."""
+        return self._journal
+
+    @property
+    def recovering(self):
+        """True from adoption start until the first full telemetry
+        refresh after it — mirrored as readiness() reason "recovering"."""
+        return self._recovering
 
     @property
     def replica_ids(self):

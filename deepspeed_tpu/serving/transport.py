@@ -63,6 +63,7 @@ from ..telemetry.registry import MetricsRegistry, count_suppressed
 from ..utils.logging import logger
 from .replica import (
     RPC_PROTOCOL_VERSION,
+    RemoteRequest,
     ReplicaRPCError,
     RpcReplicaBase,
     _FINISH_ERROR,
@@ -225,6 +226,43 @@ class SocketReplica(RpcReplicaBase):
         self._last_pong = 0.0
         self._client = None
         self.node_id = None
+        # armed by adopt_session (journal.py recovery): the next start()
+        # resumes a previous incarnation's node session instead of
+        # minting a fresh client token
+        self._adopted = None
+        self._adopted_handles = {}
+        self._replay_on_connect = False
+
+    # -- adoption (journal.py "Control-plane durability") ----------------
+    def adopt_session(self, client, *, rpc_base, entries=()):
+        """Arm the next :meth:`start` to RESUME a previous incarnation's
+        node session: present the journaled ``client`` token (the
+        node's session key), re-base rpc-id minting above ``rpc_base``
+        (the journaled incarnation's block — a new submit must never
+        collide with an id the node still tracks), and pre-register a
+        :class:`~.replica.RemoteRequest` per journaled in-flight entry
+        (``{"rpc_id", "prompt", "max_new_tokens"}``) so the node's
+        outbox replay lands in real handles the moment the session
+        re-binds. Entries the node no longer remembers fail-finish at
+        the welcome reconcile — the router re-routes them."""
+        self._adopted = {
+            "client": str(client),
+            "rpc_base": int(rpc_base),
+            "entries": [dict(e) for e in entries],
+        }
+        return self
+
+    def adopted_handles(self):
+        """``{rpc_id: RemoteRequest}`` for the entries the last adopted
+        start() pre-registered (the router binds these into its
+        outstanding table)."""
+        return dict(self._adopted_handles)
+
+    @property
+    def client_token(self):
+        """The live session's client token — what the journal records
+        and a restarted router presents to resume this node session."""
+        return self._client
 
     # -- connection management ------------------------------------------
     def start(self, start_timeout=None):
@@ -235,11 +273,33 @@ class SocketReplica(RpcReplicaBase):
         self._shutdown_requested = False
         self._gone = False
         self._reset_rpc_state()
-        # a fresh incarnation mints a fresh client token: rpc ids restart
-        # from 1, so resuming a PREVIOUS incarnation's node session would
-        # cross-wire its orphan events onto new requests
-        self._client = f"{os.getpid():x}-{uuid.uuid4().hex[:8]}"
-        self._connect(resume=False)
+        adopted, self._adopted = self._adopted, None
+        self._adopted_handles = {}
+        if adopted is not None:
+            # adoption: resume the journaled session under its own
+            # client token; the node replays tracked tokens from index
+            # 0 (the idempotent absolute-index append dedups) and
+            # flushes buffered finished events — completions that
+            # finished while the router was dead DELIVER, not re-run
+            self._client = adopted["client"]
+            self._rebase_rpc_ids(adopted["rpc_base"])
+            with self._state_lock:
+                for entry in adopted["entries"]:
+                    req = RemoteRequest(
+                        entry["rpc_id"], entry.get("prompt") or (),
+                        entry.get("max_new_tokens", 32),
+                    )
+                    self._outstanding[entry["rpc_id"]] = req
+                    self._adopted_handles[entry["rpc_id"]] = req
+            self._replay_on_connect = True
+            self._connect(resume=True)
+        else:
+            # a fresh incarnation mints a fresh client token: rpc ids
+            # restart from 1, so resuming a PREVIOUS incarnation's node
+            # session would cross-wire its orphan events onto new
+            # requests
+            self._client = f"{os.getpid():x}-{uuid.uuid4().hex[:8]}"
+            self._connect(resume=False)
         timeout = (
             self._connect_timeout if start_timeout is None
             else float(start_timeout)
@@ -275,11 +335,19 @@ class SocketReplica(RpcReplicaBase):
                     self.address, timeout=self._connect_timeout
                 )
                 sock.settimeout(self._connect_timeout)
-                sock.sendall(encode_frame({
+                hello = {
                     "op": "hello", "proto": RPC_PROTOCOL_VERSION,
                     "client": self._client, "replica": self.remote_name,
                     "resume": bool(resume),
-                }))
+                }
+                if self._replay_on_connect:
+                    # adoption resume: ask the node to re-emit every
+                    # tracked request's tokens from index 0 — this
+                    # incarnation's handles start empty, and the
+                    # committed prefix must stream again (absolute
+                    # indices make the re-emit idempotent)
+                    hello["replay"] = True
+                sock.sendall(encode_frame(hello))
                 rfile = sock.makefile("rb")
                 deadline = time.monotonic() + self._connect_timeout
                 got_ready = False
@@ -322,6 +390,9 @@ class SocketReplica(RpcReplicaBase):
                 with self._write_lock:
                     self._sock, self._rfile = sock, rfile
                 self._last_pong = time.monotonic()
+                # replay is a one-shot adoption ask: ordinary reconnects
+                # resume from the session's own sent counters
+                self._replay_on_connect = False
                 if self._reader is None or not self._reader.is_alive():
                     self._reader = threading.Thread(
                         target=self._read_loop,

@@ -381,6 +381,11 @@ class StubWorkerEngine:
         from ..resilience.faults import build_fault_injector_from_dict
 
         self.delay_secs = float(stub_spec.get("delay_secs", 0.0))
+        # token_delay_secs > 0 switches to INCREMENTAL emission: one
+        # token appended per interval, so streaming/resume paths (the
+        # door's SSE, journal adoption's prefix replay) see a real
+        # mid-generation window without paying a jax decode
+        self.token_delay_secs = float(stub_spec.get("token_delay_secs", 0.0))
         self.hang = bool(stub_spec.get("hang", False))
         fi = (config.get("resilience") or {}).get("fault_injection") or {}
 
@@ -445,12 +450,35 @@ class StubWorkerEngine:
             self._m_submitted.inc()
             self._m_active.set(len(self._active))
         if not self.hang:
-            timer = threading.Timer(
-                self.delay_secs, self._complete, args=(req,)
-            )
-            timer.daemon = True
-            timer.start()
+            if self.token_delay_secs > 0:
+                t = threading.Thread(
+                    target=self._stream_tokens, args=(req,),
+                    name="ds-stub-stream", daemon=True,
+                )
+                t.start()
+            else:
+                timer = threading.Timer(
+                    self.delay_secs, self._complete, args=(req,)
+                )
+                timer.daemon = True
+                timer.start()
         return req
+
+    def _stream_tokens(self, req):
+        """Incremental mode: append one pending token per interval (the
+        poller streams each the moment it lands), then finish. A cancel
+        mid-stream stops the emission with the partial answer."""
+        time.sleep(self.delay_secs)
+        for token in list(req._pending):
+            if req.done:
+                return
+            time.sleep(self.token_delay_secs)
+            if req.done:
+                return
+            req.tokens.append(token)
+            if req.first_token_at is None:
+                req.first_token_at = time.monotonic()
+        self._complete(req)
 
     def _complete(self, req):
         req._finish()

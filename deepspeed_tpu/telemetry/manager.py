@@ -192,6 +192,18 @@ SERVING_METRICS = (
     ("gauge", "door/open_streams", "SSE token streams currently open on the door"),
     ("histogram", "door/stream_ttft_ms", "door-observed time to first streamed token event (request receipt to the first SSE token flush)"),
     ("counter", "door/client_disconnects", "streams abandoned by the client before completion; their fleet requests cancel and the replica slot frees within one decode step"),
+    # durable control plane (docs/serving.md "Control-plane
+    # durability"): the fleet-state journal + crash-recovery envelope.
+    # fleet/journal_* counters register dynamically when the journal
+    # block arms (the disabled fleet builds no journal and exports
+    # nothing): journal_writes (segments committed), journal_recoveries
+    # (startups that adopted a prior incarnation's snapshot),
+    # journal_corruptions (segments rejected by the checksum/decode
+    # walk), journal_inflight_evicted (in-flight descriptors dropped
+    # past serving.journal.max_inflight).
+    ("gauge", "fleet/adopted_replicas", "replicas adopted from a prior router incarnation's journal at the last recovery (0 after a cold start)"),
+    ("counter", "door/streams_resumed", "SSE streams re-attached by a reconnecting client via Idempotency-Key + Last-Event-ID (the committed prefix replayed from the event id forward)"),
+    ("counter", "door/idempotent_replays", "requests answered from the door's idempotency cache without re-submitting to the fleet (terminal result replayed verbatim)"),
 )
 
 

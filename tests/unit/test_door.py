@@ -81,6 +81,18 @@ def _expected(prompt, n):
     return [(base + i + 1) % 1000 for i in range(n)]
 
 
+def _streams_closed(router, timeout=2.0):
+    """Wait for door/open_streams to settle at 0. The handler thread
+    decrements the gauge in its ``finally`` AFTER the client has already
+    read the terminal frame, so an immediate snapshot races it."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if router.metrics.snapshot().get("door/open_streams") == 0:
+            return True
+        time.sleep(0.005)
+    return False
+
+
 def _fleet(step_secs=0.02, **router_kw):
     engines = []
 
@@ -182,7 +194,7 @@ def test_first_sse_event_arrives_before_generation_completes():
         }
         snap = router.metrics.snapshot()
         assert snap["door/stream_ttft_ms/count"] >= 1
-        assert snap["door/open_streams"] == 0
+        assert _streams_closed(router), "open_streams gauge never closed"
     finally:
         door.shutdown()
         router.shutdown()
@@ -214,7 +226,7 @@ def test_client_disconnect_frees_slot_within_one_decode_step():
         )
         snap = router.metrics.snapshot()
         assert snap["door/client_disconnects"] == 1
-        assert snap["door/open_streams"] == 0
+        assert _streams_closed(router), "open_streams gauge never closed"
     finally:
         door.shutdown()
         router.shutdown()

@@ -1,0 +1,1165 @@
+"""Durable control plane tests (deepspeed_tpu/serving/journal.py,
+docs/serving.md "Control-plane durability"): the write-ahead segment
+protocol (checksummed envelopes, atomic latest pointer, newest-valid
+recovery over a full corruption matrix), the journal's mutation
+ordering and bounded in-flight table, adoption planning against both
+injected fakes and REAL loopback node sessions (bitwise prefix replay,
+finished-while-dead delivery, forgotten-entry fail-finish), the
+router's crash-recovery cycle end to end, and the door's resume
+surface (SSE ``id:`` fields, ``Last-Event-ID`` replay, the
+Idempotency-Key LRU, graceful restart).
+
+Everything is jax-free: node-backed tests host worker.py's
+StubWorkerEngine (answers are a pure function of the prompt, so
+exactly-once and bitwise-resume are assertable), door tests drive a
+host-side harness around the real ContinuousBatchingScheduler."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.inference.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.resilience import atomic_io
+from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+from deepspeed_tpu.serving import (
+    Autoscaler,
+    FleetJournal,
+    FleetRouter,
+    HTTPDoor,
+    InProcessReplica,
+    init_fleet,
+    load_journal_state,
+    plan_adoption,
+)
+from deepspeed_tpu.serving.journal import (
+    JOURNAL_CORRUPT,
+    JOURNAL_MISSING,
+    JOURNAL_VALID,
+    LATEST_FILE,
+    RPC_ID_INCARNATION_BLOCK,
+    list_segments,
+    verify_segment,
+)
+from deepspeed_tpu.serving.node import NodeServer
+from deepspeed_tpu.serving.transport import NodeControlClient, SocketReplica
+from deepspeed_tpu.telemetry.registry import MetricsRegistry, wire_scalars
+
+
+def _expected_answer(prompt, max_new):
+    """StubWorkerEngine's deterministic answer (worker.py)."""
+    base = prompt[-1] if prompt else 0
+    return [(base + i + 1) % 1000 for i in range(max_new)]
+
+
+def _node(replicas=("r0",), *, delay=0.02, token_delay=0.0,
+          node_id="n0", lease_secs=5.0, resume_grace_secs=10.0):
+    spec = {
+        "node_id": node_id,
+        "replicas": {
+            name: {"stub": {
+                "delay_secs": delay, "token_delay_secs": token_delay,
+            }}
+            for name in replicas
+        },
+        "lease_secs": lease_secs,
+        "resume_grace_secs": resume_grace_secs,
+    }
+    return NodeServer(spec)
+
+
+def _replica(node, name="r0", *, rid=None, **kw):
+    host, port = node.address
+    return SocketReplica(
+        rid or f"{node.node_id}:{name}", (host, port), remote_name=name,
+        rpc_timeout=2.0, rpc_retries=1, rpc_backoff_secs=0.01,
+        reconnect_backoff_secs=0.02, reconnect_attempts=3, **kw,
+    )
+
+
+_SOCKET_KW = dict(
+    rpc_timeout=2.0, rpc_retries=1, rpc_backoff_secs=0.01,
+    reconnect_backoff_secs=0.02, reconnect_attempts=3,
+)
+
+
+def _crash_replica(replica):
+    """Sever a socket replica the way a SIGKILLed router would: no bye
+    frame, no reconnect — the node's session survives (disconnected)
+    into its resume grace, exactly what a restarted router adopts."""
+    replica._shutdown_requested = True
+    replica._hb_stop.set()
+    replica._abort_connection("simulated router crash")
+    for t in (replica._heartbeat, replica._reader):
+        if t is not None:
+            t.join(5.0)
+
+
+def _node_scalars(node, name="r0"):
+    snap = NodeControlClient(node.address).metrics_snapshot()
+    return wire_scalars(snap["replicas"][name])
+
+
+# ---------------------------------------------------------------------------
+# segment protocol: checksummed envelopes, the recovery walk
+# ---------------------------------------------------------------------------
+def test_segment_roundtrip_valid(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.set_brownout(True)
+    name = list_segments(str(tmp_path))[0]
+    verdict, payload, reason = verify_segment(str(tmp_path / name))
+    assert verdict == JOURNAL_VALID and reason == "ok"
+    assert payload == j.state()
+    assert payload["brownout"] is True
+
+
+def test_segment_payload_tamper_is_corrupt(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.set_brownout(False)
+    name = list_segments(str(tmp_path))[0]
+    path = tmp_path / name
+    env = json.loads(path.read_bytes())
+    env["payload"]["brownout"] = True  # flip a field, keep the old sha
+    path.write_bytes(json.dumps(env).encode())
+    verdict, payload, reason = verify_segment(str(path))
+    assert verdict == JOURNAL_CORRUPT and payload is None
+    assert "checksum" in reason
+
+
+def test_segment_truncated_is_corrupt(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.set_brownout(True)
+    name = list_segments(str(tmp_path))[0]
+    path = str(tmp_path / name)
+    atomic_io.torn_write_bytes(path, atomic_io.read_bytes(path), 0.5)
+    verdict, payload, _reason = verify_segment(path)
+    assert verdict == JOURNAL_CORRUPT and payload is None
+
+
+def test_segment_format_version_mismatch_is_corrupt(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.set_brownout(True)
+    name = list_segments(str(tmp_path))[0]
+    path = tmp_path / name
+    env = json.loads(path.read_bytes())
+    env["format_version"] = 99
+    path.write_bytes(json.dumps(env).encode())
+    verdict, _payload, reason = verify_segment(str(path))
+    assert verdict == JOURNAL_CORRUPT and "format_version" in reason
+
+
+def test_segment_absent_is_missing(tmp_path):
+    verdict, payload, _ = verify_segment(str(tmp_path / "journal-x.json"))
+    assert verdict == JOURNAL_MISSING and payload is None
+
+
+def test_list_segments_newest_first_ignores_strangers(tmp_path):
+    for name in ("journal-00000002.json", "journal-00000010.json",
+                 "notes.txt", "journal-abc.json", LATEST_FILE):
+        (tmp_path / name).write_text("x")
+    assert list_segments(str(tmp_path)) == [
+        "journal-00000010.json", "journal-00000002.json",
+    ]
+
+
+def test_load_missing_directory(tmp_path):
+    payload, info = load_journal_state(str(tmp_path / "never"))
+    assert payload is None
+    assert info == {"status": "missing", "segment": None, "corrupt": []}
+
+
+def test_load_recovers_newest_and_counts(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.set_brownout(True)
+    j.record_node("n0", ("127.0.0.1", 4242))
+    reg = MetricsRegistry()
+    payload, info = load_journal_state(str(tmp_path), registry=reg)
+    assert info["status"] == "recovered" and info["corrupt"] == []
+    assert payload["brownout"] is True
+    assert payload["nodes"] == {"n0": ["127.0.0.1", 4242]}
+    assert reg.counter("fleet/journal_recoveries").value == 1
+    assert reg.counter("fleet/journal_corruptions").value == 0
+
+
+def test_load_stale_latest_falls_back(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.set_brownout(True)
+    j.set_brownout(False)
+    newest = list_segments(str(tmp_path))[0]
+    os.unlink(tmp_path / newest)  # latest now points at a ghost
+    reg = MetricsRegistry()
+    payload, info = load_journal_state(str(tmp_path), registry=reg)
+    assert info["status"] == "recovered"
+    assert LATEST_FILE in info["corrupt"]
+    assert payload["brownout"] is True  # the surviving older snapshot
+    assert reg.counter("fleet/journal_corruptions").value == 1
+
+
+def test_load_torn_newest_falls_back_whole(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.record_adapter("fr", {"rank": 8})
+    j.record_adapter("de", {"rank": 16})
+    newest = list_segments(str(tmp_path))[0]
+    path = str(tmp_path / newest)
+    atomic_io.torn_write_bytes(path, atomic_io.read_bytes(path), 0.4)
+    payload, info = load_journal_state(str(tmp_path))
+    assert info["status"] == "recovered"
+    assert info["corrupt"] == [newest]
+    # the PREVIOUS snapshot adopted whole — never a half-adopt of the
+    # torn one
+    assert payload["adapters"] == {"fr": {"rank": 8}}
+
+
+def test_load_all_corrupt_starts_cold_loudly(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.set_brownout(True)
+    j.set_brownout(False)
+    for name in list_segments(str(tmp_path)):
+        (tmp_path / name).write_bytes(b"\x00 not json at all")
+    reg = MetricsRegistry()
+    payload, info = load_journal_state(str(tmp_path), registry=reg)
+    assert payload is None and info["status"] == "cold"
+    assert len(info["corrupt"]) == 2
+    assert reg.counter("fleet/journal_corruptions").value == 2
+    assert reg.counter("fleet/journal_recoveries").value == 0
+
+
+def test_load_non_object_payload_is_corrupt(tmp_path):
+    # a well-formed envelope whose payload is not a dict must not adopt
+    (tmp_path / "journal-00000001.json").write_bytes(b'{"a": 1}')
+    payload, info = load_journal_state(str(tmp_path))
+    assert payload is None and info["status"] == "cold"
+
+
+# ---------------------------------------------------------------------------
+# FleetJournal: mutation ordering, bounds, incarnations
+# ---------------------------------------------------------------------------
+def test_every_mutation_is_durable_before_return(tmp_path):
+    reg = MetricsRegistry()
+    j = FleetJournal(tmp_path, fsync=False, registry=reg)
+    mutations = [
+        lambda: j.record_node("n0", ("127.0.0.1", 1000)),
+        lambda: j.record_replica("n0:r0", node="n0",
+                                 address=("127.0.0.1", 1000),
+                                 remote_name="r0", client="c1", rpc_seq=3),
+        lambda: j.record_adapter("fr", {"rank": 8}),
+        lambda: j.set_brownout(True),
+        lambda: j.set_autoscaler({"target": 2}),
+        lambda: j.open_request(5, prompt=[1], tenant="t",
+                               kwargs={"max_new_tokens": 4},
+                               replica_id="n0:r0", rpc_id=7),
+        lambda: j.move_request(5, replica_id="n0:r1", rpc_id=9, reroutes=1),
+        lambda: j.close_request(5),
+        lambda: j.forget_adapter("fr"),
+        lambda: j.forget_replica("n0:r0"),
+    ]
+    for i, mutate in enumerate(mutations, start=1):
+        mutate()
+        # the newest on-disk segment is the post-mutation state: the
+        # write happened BEFORE the mutator returned
+        name = list_segments(str(tmp_path))[0]
+        verdict, payload, _ = verify_segment(str(tmp_path / name))
+        assert verdict == JOURNAL_VALID
+        assert payload == j.state()
+        assert atomic_io.read_text(j.latest_path()).strip() == name
+    assert reg.counter("fleet/journal_writes").value == len(mutations)
+
+
+def test_record_node_accepts_host_port_string(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.record_node("n0", "10.0.0.9:7001")
+    assert j.state()["nodes"] == {"n0": ["10.0.0.9", 7001]}
+
+
+def test_inflight_open_move_close_descriptor_shape(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.open_request(41, prompt=[7, 9], tenant="acme",
+                   kwargs={"max_new_tokens": 8}, replica_id="a",
+                   rpc_id=3, idempotency_key="k1", reroutes=0)
+    st = j.state()
+    assert st["request_seq"] == 41
+    assert st["inflight"]["41"] == {
+        "prompt": [7, 9], "tenant": "acme",
+        "kwargs": {"max_new_tokens": 8}, "replica": "a", "rpc_id": 3,
+        "idem": "k1", "deadline_unix": None, "reroutes": 0,
+    }
+    j.move_request(41, replica_id="b", rpc_id=11, reroutes=1)
+    entry = j.state()["inflight"]["41"]
+    assert (entry["replica"], entry["rpc_id"], entry["reroutes"]) == (
+        "b", 11, 1,
+    )
+    j.close_request(41)
+    assert j.state()["inflight"] == {}
+    assert j.state()["request_seq"] == 41  # the high-water mark stays
+
+
+def test_inflight_bound_evicts_oldest_counted(tmp_path):
+    reg = MetricsRegistry()
+    j = FleetJournal(tmp_path, fsync=False, max_inflight=2, registry=reg)
+    for rid in (1, 2, 3):
+        j.open_request(rid, prompt=[rid], tenant="t",
+                       kwargs={}, replica_id="a", rpc_id=rid)
+    assert sorted(j.state()["inflight"]) == ["2", "3"]
+    assert reg.counter("fleet/journal_inflight_evicted").value == 1
+
+
+def test_keep_segments_prunes_history(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False, keep_segments=2)
+    for i in range(5):
+        j.set_brownout(i % 2 == 0)
+    names = list_segments(str(tmp_path))
+    assert names == ["journal-00000005.json", "journal-00000004.json"]
+
+
+def test_recovered_journal_bumps_incarnation_and_seq(tmp_path):
+    j1 = FleetJournal(tmp_path, fsync=False)
+    assert j1.incarnation == 1
+    j1.set_brownout(True)
+    j1.record_adapter("fr", {"rank": 4})
+    state, info = load_journal_state(str(tmp_path))
+    assert info["status"] == "recovered"
+    j2 = FleetJournal(tmp_path, fsync=False, state=state)
+    assert j2.incarnation == 2
+    assert j2.state()["brownout"] is True
+    assert j2.state()["adapters"] == {"fr": {"rank": 4}}
+    j2.set_brownout(False)
+    # the sequence continues PAST the previous life's segments — history
+    # stays inspectable, never overwritten
+    assert j2.seq == 3
+    assert list_segments(str(tmp_path))[0] == "journal-00000003.json"
+
+
+def test_journal_torn_fault_site_recovers_previous(tmp_path):
+    faults = FaultInjector(
+        [FaultSpec("journal.torn", after=1, times=1,
+                   args={"keep_fraction": 0.3}, seed=0)], seed=0,
+    )
+    j = FleetJournal(tmp_path, fsync=False, fault_injector=faults)
+    j.set_brownout(True)    # commit 1: clean
+    j.set_brownout(False)   # commit 2: torn mid-write
+    assert faults.injected["journal.torn"] == 1
+    torn = "journal-00000002.json"
+    assert verify_segment(str(tmp_path / torn))[0] == JOURNAL_CORRUPT
+    payload, info = load_journal_state(str(tmp_path))
+    assert info["status"] == "recovered" and torn in info["corrupt"]
+    assert payload["brownout"] is True  # the pre-torn snapshot
+
+
+def test_autoscaler_and_brownout_roundtrip(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    j.set_autoscaler({"target": 3, "last_scale_unix": 123.0})
+    j.set_brownout(True)
+    state, _ = load_journal_state(str(tmp_path))
+    assert state["autoscaler"] == {"target": 3, "last_scale_unix": 123.0}
+    assert state["brownout"] is True
+    j.set_autoscaler(None)
+    assert j.state()["autoscaler"] is None
+
+
+# ---------------------------------------------------------------------------
+# plan_adoption: the decision matrix (injected transport fakes)
+# ---------------------------------------------------------------------------
+def _fake_ctl(rosters, dials=None):
+    """A NodeControlClient stand-in: ``rosters`` maps address tuples to
+    replica-name lists; a missing address refuses the dial."""
+    class _Ctl:
+        def __init__(self, address, **_kw):
+            self.address = tuple(address)
+            if dials is not None:
+                dials.append(self.address)
+
+        def node_info(self):
+            roster = rosters.get(self.address)
+            if roster is None:
+                raise OSError("connection refused")
+            return {"replicas": list(roster)}
+    return _Ctl
+
+
+class _FakeReplica:
+    def __init__(self, replica_id, address, *, remote_name=None,
+                 registry=None, fault_injector=None, **kw):
+        self.replica_id = replica_id
+        self.address = tuple(address)
+        self.remote_name = remote_name
+        self.kw = kw
+        self.adopt = None
+
+    def adopt_session(self, client, *, rpc_base, entries=()):
+        self.adopt = {
+            "client": client, "rpc_base": rpc_base,
+            "entries": list(entries),
+        }
+        return self
+
+
+def _journal_state(**over):
+    state = {
+        "format_version": 1, "seq": 4, "incarnation": 2,
+        "written_unix": 0.0, "nodes": {}, "replicas": {}, "adapters": {},
+        "brownout": False, "autoscaler": None, "request_seq": -1,
+        "inflight": {},
+    }
+    state.update(over)
+    return state
+
+
+def _membership(node="n0", port=7000, remote="r0", client="tok-1",
+                rpc_seq=5):
+    return {
+        "node": node, "address": ["127.0.0.1", port],
+        "remote_name": remote, "client": client, "rpc_seq": rpc_seq,
+    }
+
+
+def test_adoption_arms_surviving_replicas(tmp_path):
+    state = _journal_state(
+        nodes={"n0": ["127.0.0.1", 7000]},
+        replicas={"n0:r0": _membership(), "n0:r1": _membership(remote="r1")},
+        inflight={
+            "10": {"prompt": [3], "kwargs": {"max_new_tokens": 6},
+                   "tenant": "t", "replica": "n0:r0", "rpc_id": 4,
+                   "idem": None, "deadline_unix": None, "reroutes": 0},
+            "11": {"prompt": [5], "kwargs": {}, "tenant": "t",
+                   "replica": "n0:r0", "rpc_id": 5, "idem": None,
+                   "deadline_unix": None, "reroutes": 0},
+        },
+    )
+    plan = plan_adoption(
+        state, socket_kwargs={"rpc_timeout": 9.0},
+        node_control_client=_fake_ctl({("127.0.0.1", 7000): ["r0", "r1"]}),
+        socket_replica=_FakeReplica,
+    )
+    assert sorted(plan.adopted_ids) == ["n0:r0", "n0:r1"]
+    assert plan.lost_replicas == []
+    assert plan.inflight == {10: state["inflight"]["10"],
+                             11: state["inflight"]["11"]}
+    r0 = next(r for r in plan.replicas if r.replica_id == "n0:r0")
+    assert r0.adopt["client"] == "tok-1"
+    assert r0.adopt["rpc_base"] == 2 * RPC_ID_INCARNATION_BLOCK
+    assert r0.adopt["entries"] == [
+        {"rpc_id": 4, "prompt": [3], "max_new_tokens": 6},
+        {"rpc_id": 5, "prompt": [5], "max_new_tokens": 32},
+    ]
+    assert r0.kw == {"rpc_timeout": 9.0}
+    r1 = next(r for r in plan.replicas if r.replica_id == "n0:r1")
+    assert r1.adopt["entries"] == []
+
+
+def test_adoption_dead_node_reports_lost(tmp_path):
+    state = _journal_state(replicas={"n0:r0": _membership()})
+    plan = plan_adoption(
+        state, node_control_client=_fake_ctl({}),
+        socket_replica=_FakeReplica,
+    )
+    assert plan.replicas == []
+    assert plan.lost_replicas == [("n0:r0", "node n0 dead")]
+
+
+def test_adoption_replica_left_roster_reports_lost(tmp_path):
+    state = _journal_state(replicas={"n0:r0": _membership(remote="r9")})
+    plan = plan_adoption(
+        state,
+        node_control_client=_fake_ctl({("127.0.0.1", 7000): ["r0"]}),
+        socket_replica=_FakeReplica,
+    )
+    assert plan.replicas == []
+    assert plan.lost_replicas == [
+        ("n0:r0", "replica 'r9' left node n0's roster"),
+    ]
+
+
+def test_adoption_non_socket_membership_is_lost(tmp_path):
+    state = _journal_state(replicas={"0": {
+        "node": None, "address": None, "remote_name": None,
+        "client": None, "rpc_seq": 0,
+    }})
+    plan = plan_adoption(
+        state, node_control_client=_fake_ctl({}),
+        socket_replica=_FakeReplica,
+    )
+    assert plan.replicas == []
+    assert plan.lost_replicas == [
+        ("0", "not a socket replica (dies with the router)"),
+    ]
+
+
+def test_adoption_dials_each_node_once(tmp_path):
+    dials = []
+    state = _journal_state(
+        nodes={"n0": ["127.0.0.1", 7000]},
+        replicas={
+            "n0:r0": _membership(), "n0:r1": _membership(remote="r1"),
+            "n0:r2": _membership(remote="r2"),
+        },
+    )
+    plan_adoption(
+        state,
+        node_control_client=_fake_ctl(
+            {("127.0.0.1", 7000): ["r0", "r1", "r2"]}, dials,
+        ),
+        socket_replica=_FakeReplica,
+    )
+    assert dials == [("127.0.0.1", 7000)]
+
+
+def test_adoption_prefers_journaled_node_address(tmp_path):
+    # the nodes table is authoritative: a membership journaled against
+    # an older node address follows the node's CURRENT address
+    dials = []
+    state = _journal_state(
+        nodes={"n0": ["127.0.0.1", 8000]},
+        replicas={"n0:r0": _membership(port=7000)},
+    )
+    plan = plan_adoption(
+        state,
+        node_control_client=_fake_ctl({("127.0.0.1", 8000): ["r0"]}, dials),
+        socket_replica=_FakeReplica,
+    )
+    assert dials == [("127.0.0.1", 8000)]
+    assert plan.adopted_ids == ["n0:r0"]
+
+
+# ---------------------------------------------------------------------------
+# adoption over REAL loopback node sessions
+# ---------------------------------------------------------------------------
+def test_adopted_session_replays_prefix_bitwise():
+    """The resume pin: tokens already streamed to the dead incarnation
+    re-emit from absolute index 0 into the adopted handle — the full
+    answer is bitwise the stub's pure function, no gap, no dup."""
+    node = _node(token_delay=0.05)
+    node.start()
+    rep1 = _replica(node)
+    rep2 = None
+    try:
+        rep1.start()
+        req1 = rep1.submit([7], max_new_tokens=12)
+        deadline = time.monotonic() + 10.0
+        while len(req1.tokens) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(req1.tokens) >= 3, "stub never started streaming"
+        client = rep1.client_token
+        _crash_replica(rep1)
+        rep2 = _replica(node)
+        rep2.adopt_session(client, rpc_base=2 * RPC_ID_INCARNATION_BLOCK,
+                           entries=[{"rpc_id": req1.rpc_id, "prompt": [7],
+                                     "max_new_tokens": 12}])
+        rep2.start()
+        handle = rep2.adopted_handles()[req1.rpc_id]
+        assert handle.result(20.0) == _expected_answer([7], 12)
+        assert handle.finish_reason == "max_new_tokens"
+        # exactly-once: the node ran ONE generation across both lives
+        scalars = _node_scalars(node)
+        assert scalars["infer/requests_submitted"] == 1
+        assert scalars["infer/requests_completed"] == 1
+    finally:
+        if rep2 is not None:
+            rep2.shutdown()
+        node.shutdown()
+
+
+def test_finished_while_dead_delivers_from_outbox():
+    """A generation that completed between the crash and the adoption
+    DELIVERS from the node's outbox — never re-runs."""
+    node = _node(delay=0.2)
+    node.start()
+    rep1 = _replica(node)
+    rep2 = None
+    try:
+        rep1.start()
+        req1 = rep1.submit([9], max_new_tokens=4)
+        client = rep1.client_token
+        _crash_replica(rep1)  # crash BEFORE the 0.2s generation lands
+        deadline = time.monotonic() + 10.0
+        while (
+            _node_scalars(node).get("infer/requests_completed", 0) < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        rep2 = _replica(node)
+        rep2.adopt_session(client, rpc_base=2 * RPC_ID_INCARNATION_BLOCK,
+                           entries=[{"rpc_id": req1.rpc_id, "prompt": [9],
+                                     "max_new_tokens": 4}])
+        rep2.start()
+        handle = rep2.adopted_handles()[req1.rpc_id]
+        assert handle.result(15.0) == _expected_answer([9], 4)
+        assert _node_scalars(node)["infer/requests_submitted"] == 1
+    finally:
+        if rep2 is not None:
+            rep2.shutdown()
+        node.shutdown()
+
+
+def test_adopted_entry_node_forgot_fail_finishes():
+    """An adopted descriptor the node does not remember (its session
+    was reaped, or it never landed) fail-finishes at the welcome
+    reconcile — the router's re-route path, never a silent hang."""
+    node = _node()
+    node.start()
+    rep = _replica(node)
+    try:
+        rep.adopt_session("ghost-client", rpc_base=RPC_ID_INCARNATION_BLOCK,
+                          entries=[{"rpc_id": 77, "prompt": [1],
+                                    "max_new_tokens": 4}])
+        rep.start()
+        handle = rep.adopted_handles()[77]
+        deadline = time.monotonic() + 10.0
+        while not handle.done and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert handle.done and handle.finish_reason == "error"
+        assert rep.alive  # the replica itself is healthy for new work
+        assert rep.submit([2], max_new_tokens=2).result(10.0) == (
+            _expected_answer([2], 2)
+        )
+    finally:
+        rep.shutdown()
+        node.shutdown()
+
+
+def test_router_crash_recovery_cycle_end_to_end(tmp_path):
+    """The tentpole in miniature, in-process: router 1 journals its
+    fleet and dies mid-generation (no shutdown, no cancels); router 2
+    recovers the journal, adopts the live node session, reports
+    "recovering" until its first full refresh, and the request finishes
+    bitwise with the node having run exactly one generation."""
+    node = _node(token_delay=0.05, node_id="nA")
+    node.start()
+    router2 = None
+    try:
+        j1 = FleetJournal(tmp_path, fsync=False)
+        j1.record_node("nA", node.address)
+        rep1 = _replica(node, rid="nA:r0")
+        router1 = FleetRouter([rep1], monitor_interval=0.02, journal=j1)
+        router1.start()
+        req = router1.submit([5], max_new_tokens=14,
+                             idempotency_key="cycle-key")
+        assert j1.state()["inflight"], "submit did not journal its open"
+        # crash: stop the monitor cold and sever the socket — no bye,
+        # no outstanding sweep, no journal closes
+        router1._stop.set()
+        router1._monitor.join(5.0)
+        _crash_replica(rep1)
+
+        state, info = load_journal_state(str(tmp_path))
+        assert info["status"] == "recovered"
+        plan = plan_adoption(state, socket_kwargs=_SOCKET_KW)
+        assert plan.adopted_ids == ["nA:r0"]
+        j2 = FleetJournal(tmp_path, fsync=False, state=state)
+        router2 = FleetRouter(
+            plan.replicas, monitor_interval=0.02, journal=j2,
+            recovered=plan,
+        )
+        assert router2.recovering
+        ready, reasons = router2.readiness()
+        assert not ready and "recovering" in reasons
+        router2.start()
+        assert not router2.recovering  # first full refresh ran in start()
+        assert router2.metrics.gauge("fleet/adopted_replicas").value == 1
+        adopted_req = router2.find_inflight("cycle-key")
+        assert adopted_req is not None
+        assert adopted_req.request_id == req.request_id
+        assert adopted_req.result(30.0) == _expected_answer([5], 14)
+        assert adopted_req.finish_reason == "max_new_tokens"
+        # terminal close left the next life's journal clean
+        assert j2.state()["inflight"] == {}
+        # adopted replicas re-earn trust via half-open probation, and
+        # exactly one generation ever ran on the node
+        scalars = _node_scalars(node)
+        assert scalars["infer/requests_submitted"] == 1
+        assert scalars["infer/requests_completed"] == 1
+    finally:
+        if router2 is not None:
+            router2.shutdown()
+        node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# router-level journaling (in-process replicas)
+# ---------------------------------------------------------------------------
+class _HostEngine:
+    """test_door's scheduler harness: each decode step yields prev + 1
+    per slot, paced by ``step_secs`` (jax-free)."""
+
+    prefill_len = 16
+    paged = False
+    speculative = False
+
+    def __init__(self, step_secs=0.01):
+        self.step_secs = float(step_secs)
+        self._last = {}
+        self.scheduler = None
+
+    def prefill_request(self, slot, prompt_tokens, temperature):
+        del temperature
+        first = (int(prompt_tokens[-1]) + 1) % 1000
+        self._last[slot] = first
+        return first
+
+    def decode_tokens(self, active_slots):
+        time.sleep(self.step_secs)
+        out = []
+        for slot in active_slots:
+            nxt = (self._last.get(slot, 0) + 1) % 1000
+            self._last[slot] = nxt
+            out.append(nxt)
+        return out
+
+    def submit(self, prompt_tokens, **kwargs):
+        return self.scheduler.submit(prompt_tokens, **kwargs)
+
+    def load_snapshot(self):
+        return self.scheduler.load_snapshot()
+
+    def serve_forever(self):
+        self.scheduler.serve_forever(idle_sleep=0.001)
+
+    def close(self):
+        self.scheduler.shutdown()
+
+
+def _make_engine(step_secs=0.01, num_slots=4):
+    engine = _HostEngine(step_secs=step_secs)
+    engine.scheduler = ContinuousBatchingScheduler(
+        engine, num_slots=num_slots, max_seq_len=512, queue_depth=16,
+        queue_timeout=0.0, eos_token_id=None, temperature=0.0,
+        registry=MetricsRegistry(),
+    )
+    return engine
+
+
+def _fleet(step_secs=0.01, n_replicas=1, **router_kw):
+    def factory():
+        return _make_engine(step_secs=step_secs)
+
+    replicas = [
+        InProcessReplica(str(i), factory) for i in range(n_replicas)
+    ]
+    return FleetRouter(
+        replicas, monitor_interval=0.005, **router_kw
+    ).start()
+
+
+def test_disabled_journal_builds_nothing(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    router = init_fleet(engine_factory=_make_engine, config={})
+    try:
+        assert router.journal is None
+        assert router.submit([3], max_new_tokens=2).result(10.0) == [4, 5]
+        # the disabled contract: no journal directory, no files, ever
+        assert "fleet_journal" not in os.listdir(tmp_path)
+    finally:
+        router.shutdown()
+
+
+def test_router_journals_membership_and_request_lifecycle(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False)
+    router = _fleet(n_replicas=2, journal=j)
+    try:
+        members = j.state()["replicas"]
+        assert sorted(members) == ["0", "1"]
+        # in-process replicas journal as non-adoptable (address None):
+        # they die with the router, and recovery rebuilds them cold
+        assert members["0"]["address"] is None
+        req = router.submit([7], max_new_tokens=40,
+                            idempotency_key="life-key")
+        entry = j.state()["inflight"].get(str(req.request_id))
+        assert entry is not None and entry["idem"] == "life-key"
+        assert router.find_inflight("life-key") is req
+        assert req.result(15.0) == _expected_answer([7], 40)
+        deadline = time.monotonic() + 5.0
+        while j.state()["inflight"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert j.state()["inflight"] == {}
+        assert router.remove_replica("1")
+        assert sorted(j.state()["replicas"]) == ["0"]
+    finally:
+        router.shutdown()
+
+
+def test_recovered_request_seq_reseeds_new_ids(tmp_path):
+    plan = plan_adoption(
+        _journal_state(request_seq=41),
+        node_control_client=_fake_ctl({}), socket_replica=_FakeReplica,
+    )
+    router = _fleet(recovered=plan)
+    try:
+        req = router.submit([1], max_new_tokens=1)
+        assert req.request_id >= 42
+    finally:
+        router.shutdown()
+
+
+def _orphan_state(reroutes=0):
+    return _journal_state(
+        request_seq=7,
+        replicas={"gone": _membership(node="nX", port=1)},
+        inflight={"7": {
+            "prompt": [5], "tenant": "default",
+            "kwargs": {"max_new_tokens": 4}, "replica": "gone",
+            "rpc_id": 3, "idem": "orph-key", "deadline_unix": None,
+            "reroutes": reroutes,
+        }},
+    )
+
+
+def test_orphaned_inflight_re_places_within_budget(tmp_path):
+    """A journaled request whose replica could not be adopted re-places
+    through the ordinary re-route budget and completes elsewhere."""
+    plan = plan_adoption(
+        _orphan_state(), node_control_client=_fake_ctl({}),
+        socket_replica=_FakeReplica,
+    )
+    assert plan.lost_replicas == [("gone", "node nX dead")]
+    router = _fleet(max_reroutes=2, recovered=plan)
+    try:
+        req = router.find_inflight("orph-key")
+        assert req is not None and req.request_id == 7
+        assert req.result(20.0) == _expected_answer([5], 4)
+        assert req.reroutes == 1
+    finally:
+        router.shutdown()
+
+
+def test_orphan_past_reroute_budget_fails_honestly(tmp_path):
+    plan = plan_adoption(
+        _orphan_state(reroutes=2), node_control_client=_fake_ctl({}),
+        socket_replica=_FakeReplica,
+    )
+    router = _fleet(max_reroutes=2, recovered=plan)
+    try:
+        req = router.find_inflight("orph-key")
+        with pytest.raises(RuntimeError, match="error"):
+            req.result(20.0)
+        assert req.finish_reason == "error"
+    finally:
+        router.shutdown()
+
+
+def test_adopted_brownout_replays_then_first_refresh_reevaluates(tmp_path):
+    """A journaled brownout restarts DEGRADED (the adopted engines
+    re-hear the toggle before traffic lands); the first refresh then
+    recomputes the real fill ratio and — with the queue empty — exits
+    the band. The journal's segment history pins both edges in order."""
+    plan = plan_adoption(
+        _journal_state(brownout=True),
+        node_control_client=_fake_ctl({}), socket_replica=_FakeReplica,
+    )
+    j = FleetJournal(tmp_path, fsync=False, keep_segments=50,
+                     state=plan.state)
+    router = _fleet(recovered=plan, journal=j, brownout_queue_ratio=0.9)
+    try:
+        flags = []
+        for name in reversed(list_segments(str(tmp_path))):
+            _v, payload, _r = verify_segment(str(tmp_path / name))
+            if not flags or flags[-1] != payload["brownout"]:
+                flags.append(payload["brownout"])
+        assert flags == [True, False]
+        assert router.metrics.gauge("fleet/brownout").value == 0.0
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler durable half
+# ---------------------------------------------------------------------------
+def test_autoscaler_journal_snapshot_roundtrip():
+    a = Autoscaler(None, min_replicas=1, max_replicas=8)
+    a.state.target = 3
+    now = a._clock()
+    a.state.last_scale_at = now - 5.0
+    a.state.headroom_since = None
+    a.state.transitions = ((now - 10.0, "up"), (now - 2.0, "down"))
+    snap = a.journal_snapshot()
+    assert snap["target"] == 3 and snap["headroom_since_unix"] is None
+
+    b = Autoscaler(None, min_replicas=1, max_replicas=8)
+    b.state.op_in_flight = True
+    b.restore_journal(snap)
+    assert b.state.target == 3
+    assert b.state.op_in_flight is False  # transient, never journaled
+    assert abs((b._clock() - b.state.last_scale_at) - 5.0) < 0.5
+    assert [d for _t, d in b.state.transitions] == ["up", "down"]
+    assert abs((b._clock() - b.state.transitions[0][0]) - 10.0) < 0.5
+
+
+def test_autoscaler_restore_clamps_target_to_policy():
+    a = Autoscaler(None, min_replicas=1, max_replicas=8)
+    a.state.target = 6
+    snap = a.journal_snapshot()
+    b = Autoscaler(None, min_replicas=1, max_replicas=2)
+    b.restore_journal(snap)
+    assert b.state.target == 2
+
+
+# ---------------------------------------------------------------------------
+# the door's resume surface
+# ---------------------------------------------------------------------------
+def _door(router, **kw):
+    door = HTTPDoor(router, **kw)
+    host, port = door.start()
+    return door, host, port
+
+
+def _http_json(host, port, method, target, payload=None, headers=None):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=30.0)
+    body = None if payload is None else json.dumps(payload)
+    conn.request(method, target, body, headers or {})
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp, (json.loads(raw) if raw else None)
+
+
+def _sse_request(host, port, payload, headers=None):
+    sock = socket.create_connection((host, port))
+    body = json.dumps(payload).encode()
+    head = b"POST /v1/generate HTTP/1.1\r\nHost: door\r\n"
+    for k, v in (headers or {}).items():
+        head += f"{k}: {v}\r\n".encode()
+    sock.sendall(head + b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+    sock.settimeout(30.0)
+    return sock
+
+
+def _read_until(sock, marker, buf=b""):
+    while marker not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+def _events(buf):
+    """Parse SSE frames out of a raw response: [(event, id, data)]."""
+    body = buf.split(b"\r\n\r\n", 1)[1] if b"\r\n\r\n" in buf else buf
+    out = []
+    for block in body.decode("utf-8", "replace").split("\n\n"):
+        ev = eid = data = None
+        for line in block.split("\n"):
+            if line.startswith("event: "):
+                ev = line[len("event: "):]
+            elif line.startswith("id: "):
+                eid = int(line[len("id: "):])
+            elif line.startswith("data: "):
+                data = json.loads(line[len("data: "):])
+        if ev is not None:
+            out.append((ev, eid, data))
+    return out
+
+
+def test_sse_token_events_carry_absolute_index_ids():
+    router = _fleet()
+    door, host, port = _door(router)
+    try:
+        sock = _sse_request(host, port, {
+            "prompt": [3], "max_new_tokens": 5, "stream": True,
+        })
+        buf = _read_until(sock, b"event: done")
+        sock.close()
+        tokens = [e for e in _events(buf) if e[0] == "token"]
+        assert [eid for _ev, eid, _d in tokens] == [0, 1, 2, 3, 4]
+        assert [d["i"] for _ev, _eid, d in tokens] == [0, 1, 2, 3, 4]
+        assert [d["t"] for _ev, _eid, d in tokens] == _expected_answer(
+            [3], 5,
+        )
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_last_event_id_replays_terminal_bitwise():
+    router = _fleet()
+    door, host, port = _door(router)
+    try:
+        sock = _sse_request(
+            host, port,
+            {"prompt": [8], "max_new_tokens": 6, "stream": True},
+            headers={"Idempotency-Key": "rk-1"},
+        )
+        _read_until(sock, b"event: done")
+        sock.close()
+        # reconnect as an SSE client would: same key, the last id seen
+        sock = _sse_request(
+            host, port,
+            {"prompt": [8], "max_new_tokens": 6, "stream": True},
+            headers={"Idempotency-Key": "rk-1", "Last-Event-ID": "2"},
+        )
+        buf = _read_until(sock, b"event: done")
+        sock.close()
+        events = _events(buf)
+        tokens = [e for e in events if e[0] == "token"]
+        answer = _expected_answer([8], 6)
+        assert [eid for _ev, eid, _d in tokens] == [3, 4, 5]
+        assert [d["t"] for _ev, _eid, d in tokens] == answer[3:]
+        done = next(d for ev, _eid, d in events if ev == "done")
+        assert done["tokens"] == answer
+        assert door._m_idem_replays.value == 1
+        # the replay never re-submitted: one routed request total
+        assert router.metrics.counter("fleet/requests_routed").value == 1
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_malformed_last_event_id_is_400():
+    router = _fleet()
+    door, host, port = _door(router)
+    try:
+        resp, out = _http_json(
+            host, port, "POST", "/v1/generate",
+            {"prompt": [1], "max_new_tokens": 2},
+            headers={"Last-Event-ID": "three"},
+        )
+        assert resp.status == 400
+        assert "Last-Event-ID" in out["error"]
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_unary_idempotent_replay_runs_once():
+    router = _fleet()
+    door, host, port = _door(router)
+    try:
+        payload = {"prompt": [4], "max_new_tokens": 3, "stream": False}
+        headers = {"Idempotency-Key": "uk-1"}
+        resp1, out1 = _http_json(
+            host, port, "POST", "/v1/generate", payload, headers,
+        )
+        resp2, out2 = _http_json(
+            host, port, "POST", "/v1/generate", payload, headers,
+        )
+        assert resp1.status == resp2.status == 200
+        assert out1 == out2
+        assert out1["tokens"] == _expected_answer([4], 3)
+        assert door._m_idem_replays.value == 1
+        assert router.metrics.counter("fleet/requests_routed").value == 1
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_idempotency_cache_is_bounded_lru():
+    router = _fleet()
+    door, host, port = _door(router, idempotency_cache_size=2)
+    try:
+        for key in ("ka", "kb", "kc"):
+            _http_json(
+                host, port, "POST", "/v1/generate",
+                {"prompt": [2], "max_new_tokens": 2, "stream": False},
+                {"Idempotency-Key": key},
+            )
+        assert list(door._idem_lru) == ["kb", "kc"]
+        # the evicted key re-runs (greedy: bitwise the same answer)
+        resp, out = _http_json(
+            host, port, "POST", "/v1/generate",
+            {"prompt": [2], "max_new_tokens": 2, "stream": False},
+            {"Idempotency-Key": "ka"},
+        )
+        assert resp.status == 200
+        assert out["tokens"] == _expected_answer([2], 2)
+        assert door._m_idem_replays.value == 0
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_retried_stream_attaches_to_inflight_generation():
+    router = _fleet(step_secs=0.05)
+    door, host, port = _door(router)
+    try:
+        first = _sse_request(
+            host, port,
+            {"prompt": [6], "max_new_tokens": 12, "stream": True},
+            headers={"Idempotency-Key": "at-1"},
+        )
+        _read_until(first, b"event: token")
+        # a second POST with the key while the first still streams:
+        # attach, don't re-run
+        second = _sse_request(
+            host, port,
+            {"prompt": [6], "max_new_tokens": 12, "stream": True},
+            headers={"Idempotency-Key": "at-1"},
+        )
+        buf2 = _read_until(second, b"event: done")
+        second.close()
+        _read_until(first, b"event: done")
+        first.close()
+        tokens = [e for e in _events(buf2) if e[0] == "token"]
+        assert [eid for _ev, eid, _d in tokens] == list(range(12))
+        assert [d["t"] for _ev, _eid, d in tokens] == _expected_answer(
+            [6], 12,
+        )
+        assert door._m_resumed.value == 1
+        assert router.metrics.counter("fleet/requests_routed").value == 1
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_resumed_sampled_stream_after_reroute_fails_honestly():
+    router = _fleet(step_secs=0.05)
+    door, host, port = _door(router)
+    try:
+        req = router.submit([5], max_new_tokens=8, temperature=0.5,
+                            idempotency_key="smp-1")
+        req.reroutes = 1  # as if its replica died and it re-placed
+        resp, out = _http_json(
+            host, port, "POST", "/v1/generate",
+            {"prompt": [5], "max_new_tokens": 8, "temperature": 0.5,
+             "stream": False},
+            {"Idempotency-Key": "smp-1"},
+        )
+        assert resp.status == 502
+        assert out["finish_reason"] == "rerouted_sampling"
+        req.result(15.0)
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
+def test_graceful_restart_hands_resume_tokens_and_flips_readyz():
+    router = _fleet(step_secs=0.05)
+    door, host, port = _door(router)
+    try:
+        sock = _sse_request(host, port, {
+            "prompt": [9], "max_new_tokens": 16, "stream": True,
+        })
+        buf = _read_until(sock, b"event: token")
+        assert not door.restarting
+        door.graceful_restart(retry_after=3)
+        buf = _read_until(sock, b"event: restart", buf)
+        sock.close()
+        events = _events(buf)
+        restart = next(d for ev, _eid, d in events if ev == "restart")
+        assert restart["finish_reason"] == "restart"
+        assert restart["retry_after_secs"] == 3
+        resume = restart["resume"]
+        # the door auto-minted the key, so even a keyless client can
+        # come back; last_event_id names the last delivered token
+        assert resume["idempotency_key"].startswith("auto-")
+        delivered = [eid for ev, eid, _d in events if ev == "token"]
+        assert resume["last_event_id"] == delivered[-1]
+        resp, out = _http_json(host, port, "GET", "/readyz")
+        assert resp.status == 503 and out["reasons"] == ["restarting"]
+        # the fleet request was NOT cancelled: the generation finishes
+        # and the resume token redeems it in full
+        live = router.find_inflight(resume["idempotency_key"])
+        assert live is not None
+        assert live.result(20.0) == _expected_answer([9], 16)
+        resp, out = _http_json(
+            host, port, "POST", "/v1/generate",
+            {"prompt": [9], "max_new_tokens": 16, "stream": False},
+            {"Idempotency-Key": resume["idempotency_key"]},
+        )
+        assert resp.status == 200
+        assert out["tokens"] == _expected_answer([9], 16)
+    finally:
+        door.shutdown()
+        router.shutdown()
