@@ -1316,6 +1316,66 @@ def bench_infer():
         f"{cold_ttft:.1f}ms (x{speedup:.2f})"
     )
 
+    # ---- host-tier churn (docs/inference.md "Host-memory spill tier"):
+    # a templated working set 4x the device pool revisited round-robin.
+    # Tier OFF, every revisit re-prefills (the pages were evicted);
+    # tier ON, evictions spill D2H and revisits promote H2D, so the
+    # prefix hit rate must hold >= 2x the tier-off run — at FLAT device
+    # kv_cache_bytes (the tier buys hit rate with host RAM, not HBM).
+    def build_churn(tier):
+        block = {
+            "max_batch_slots": 2, "max_seq_len": 256, "prefill_len": 128,
+            "sampling": {"greedy": True}, "kv_block_size": 32,
+            "kv_pool_blocks": 12,
+            "prefix_cache": {"suffix_buckets": [16, 32, 64, 128]},
+        }
+        if tier:
+            block["host_tier"] = {
+                "enabled": True, "share_group": f"bench-churn-{tier}",
+            }
+        return deepspeed_tpu.init_inference(
+            model=model, model_parameters=params,
+            config={"inference": block},
+        )
+
+    N_TEMPLATES = 24  # x 2 pages each = 48 pages: 4x the 12-page pool
+
+    def churn_rate(engine):
+        templates = [prompt(64, 1000 + i) for i in range(N_TEMPLATES)]
+        for i, t in enumerate(templates):  # pass 1: genuinely cold
+            engine.generate([t + prompt(8, 2000 + i)], max_new_tokens=2)
+        before = engine.kv_snapshot()
+        for i, t in enumerate(templates):  # pass 2: the revisit sweep
+            engine.generate([t + prompt(8, 3000 + i)], max_new_tokens=2)
+        after = engine.kv_snapshot()
+        hits = after["prefix_hits"] - before["prefix_hits"]
+        lookups = hits + (after["prefix_misses"] - before["prefix_misses"])
+        return hits / max(lookups, 1), after
+
+    churn_off = build_churn(tier=False)
+    rate_off, _ = churn_rate(churn_off)
+    bytes_off = int(
+        churn_off.metrics.gauge("infer/kv_cache_bytes").value
+    )
+    churn_off.close()
+    churn_on = build_churn(tier=True)
+    rate_on, snap_on = churn_rate(churn_on)
+    bytes_on = int(churn_on.metrics.gauge("infer/kv_cache_bytes").value)
+    churn_on.close()
+    assert bytes_on == bytes_off, (
+        f"host tier grew device KV bytes ({bytes_off} -> {bytes_on})"
+    )
+    assert rate_on >= 2 * rate_off or (rate_off == 0 and rate_on >= 0.5), (
+        f"tier-on churn hit rate {rate_on:.2f} is not >= 2x the tier-off "
+        f"rate {rate_off:.2f} on a 4x-pool working set"
+    )
+    log(
+        f"churn (4x-pool working set): prefix hit rate {rate_off:.2f} "
+        f"tier-off -> {rate_on:.2f} tier-on at flat kv_cache_bytes "
+        f"({bytes_on}); {snap_on.get('host_tier_spills', 0)} spills, "
+        f"{snap_on.get('host_tier_promotions', 0)} promotions"
+    )
+
     # ---- speculative decoding at batch 1 (docs/inference.md
     # "Speculative decoding"): the draft/target pair is CONSTRUCTED to
     # agree — the draft carries the target's first DRAFT_LAYERS blocks
@@ -1427,6 +1487,16 @@ def bench_infer():
                 "cold_ttft_ms": round(cold_ttft, 3),
                 "hit_ttft_ms": round(hit_ttft, 3),
                 "ttft_speedup": round(speedup, 2),
+            },
+            "spill_churn": {
+                "templates": N_TEMPLATES,
+                "hit_rate_tier_off": round(rate_off, 3),
+                "hit_rate_tier_on": round(rate_on, 3),
+                "kv_cache_bytes": bytes_on,
+                "host_tier_spills": int(snap_on.get("host_tier_spills", 0)),
+                "host_tier_promotions": int(
+                    snap_on.get("host_tier_promotions", 0)
+                ),
             },
             "speculative": {
                 "decode_tokens_per_sec_batch1": round(tps_spec, 2),
@@ -1598,6 +1668,197 @@ def smoke_infer_paged():
             "pool_reclaimed": int(
                 snap.get("infer/kv_blocks_reclaimed", 0)
             ),
+        },
+    }))
+
+
+def smoke_spill():
+    """CI fast path (``python bench.py --smoke-spill``): the host-memory
+    spill tier (docs/inference.md "Host-memory spill tier") on a tiny
+    CPU fleet — two co-hosted paged engines sharing one tier. Asserts:
+
+      - SPILL: evicted refcount-0 prefix pages park D2H
+        (host_tier/spills) instead of dropping;
+      - PROMOTE + PARITY: a chain-hash hit promotes them H2D and the
+        decode is BITWISE identical to the cold serve;
+      - PEER: the co-hosted second engine's FIRST templated request is
+        a peer-promoted prefix HIT (host_tier/peer_fetches), bitwise
+        equal to the first engine's output;
+      - PREEMPT: under lazy page growth an over-committed pair finishes
+        with >= 1 preemption cycle, zero lost requests, bitwise equal
+        to an unpressured run;
+      - ADAPTER: an adapter evicted by pool pressure auto-loads from
+        the host tier on the next submit, bitwise equal to an
+        always-resident engine;
+      - TELEMETRY: the host_tier/* catalog lands in the Prometheus
+        textfile export.
+
+    Prints one JSON line and exits non-zero on any failed check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.adapters import init_lora_params
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    tmp = tempfile.mkdtemp(prefix="ds_smoke_spill_")
+    cfg = GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False,
+    )
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    ids0 = jnp.asarray(rng.integers(0, 128, (1, 8)), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids0, ids0,
+    )["params"]
+
+    def prompt(n, seed):
+        return [int(t) for t in
+                np.random.default_rng(seed).integers(0, 128, n)]
+
+    def build(block, adapters=None, telemetry=False, name="a"):
+        base = {"max_batch_slots": 4, "max_seq_len": 48, "prefill_len": 32,
+                "kv_block_size": 8, "sampling": {"greedy": True}}
+        base.update(block)
+        config = {"inference": base}
+        if adapters is not None:
+            config["adapters"] = adapters
+        if telemetry:
+            config["telemetry"] = {
+                "enabled": True,
+                "output_path": os.path.join(tmp, "telemetry"),
+                "job_name": f"smoke_spill_{name}",
+                "exporters": ["prometheus"],
+                "watchdog": {"enabled": False},
+            }
+        return deepspeed_tpu.init_inference(
+            model=model, model_parameters=params, config=config,
+        )
+
+    # ---- spill -> promote -> bitwise parity (engine A) ----------------
+    a = build({"kv_pool_blocks": 6,
+               "host_tier": {"enabled": True, "share_group": "smoke"}},
+              telemetry=True, name="a")
+    b = build({"kv_pool_blocks": 6,
+               "host_tier": {"enabled": True, "share_group": "smoke"}},
+              name="b")
+    assert a.host_tier is b.host_tier, "co-hosted engines must share one tier"
+    template = prompt(16, 7)  # two full 8-token pages once registered
+    cold_out = a.generate([template + prompt(4, 8)], max_new_tokens=4)[0]
+    assert a.block_pool.cached_blocks == 2
+    churn = [a.submit(prompt(8, 20 + i), max_new_tokens=8) for i in range(3)]
+    a.scheduler.run_until_idle()
+    assert all(len(r.result(0)) == 8 for r in churn)
+    snap_a = a.kv_snapshot()
+    assert snap_a["host_tier_spills"] >= 2, (
+        f"evicted prefix pages did not spill: {snap_a}"
+    )
+    hot_out = a.generate([template + prompt(4, 8)], max_new_tokens=4)[0]
+    snap_a = a.kv_snapshot()
+    assert snap_a["host_tier_promotions"] >= 1, snap_a
+    assert hot_out == cold_out, "promoted pages diverged from the cold serve"
+
+    # ---- peer promotion: B's FIRST templated request ------------------
+    peer_out = b.generate([template + prompt(4, 8)], max_new_tokens=4)[0]
+    snap_b = b.kv_snapshot()
+    assert snap_b["host_tier_peer_fetches"] >= 1, (
+        f"first templated request on the co-hosted engine was not "
+        f"peer-promoted: {snap_b}"
+    )
+    assert snap_b["prefix_hits"] >= 1, snap_b
+    assert peer_out == cold_out, "peer-promoted decode diverged"
+
+    # ---- one preemption cycle under lazy growth -----------------------
+    lazy = build({
+        "kv_pool_blocks": 4, "max_batch_slots": 2,
+        "host_tier": {"enabled": True, "share_group": "smoke-lazy",
+                      "lazy_alloc": True},
+    }, name="lazy")
+    ref = build({"kv_pool_blocks": 12, "max_batch_slots": 2}, name="ref")
+    pressured = [prompt(8, 60), prompt(8, 61)]
+    rs = [lazy.submit(p, max_new_tokens=16) for p in pressured]
+    lazy.scheduler.run_until_idle()
+    outs = [r.result(0) for r in rs]
+    assert all(len(o) == 16 for o in outs), "preemption lost tokens"
+    snap_l = lazy.kv_snapshot()
+    assert snap_l["host_tier_preemptions"] >= 1, (
+        f"over-committed pair finished without a preemption cycle: "
+        f"{snap_l}"
+    )
+    unpressured = [ref.generate([p], max_new_tokens=16)[0]
+                   for p in pressured]
+    assert outs == unpressured, (
+        "suffix-resumed decode diverged from the unpressured run"
+    )
+
+    # ---- adapter auto-load from the host tier -------------------------
+    def synth(seed):
+        ada = init_lora_params(
+            jax.tree_util.tree_map(np.asarray, params), 2,
+            rng=jax.random.PRNGKey(seed),
+        )
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(
+                jax.random.normal(
+                    jax.random.fold_in(jax.random.PRNGKey(seed), x.size),
+                    x.shape,
+                ) * 0.2, np.float32,
+            ),
+            ada,
+        )
+
+    ad = build({"prefill_len": 16,
+                "host_tier": {"enabled": True, "share_group": "smoke-ad"}},
+               adapters={"enabled": True, "rank": 2, "pool_slots": 2},
+               name="ad")
+    ad_ref = build({"prefill_len": 16},
+                   adapters={"enabled": True, "rank": 2, "pool_slots": 2},
+                   name="adref")
+    sa, sb, sc = synth(1), synth(2), synth(3)
+    ad.load_adapter("t-a", adapter_state=sa)
+    ad.load_adapter("t-b", adapter_state=sb)
+    ad.generate([prompt(6, 4)], max_new_tokens=2, adapter="t-a")  # t-b idles
+    ad.load_adapter("t-c", adapter_state=sc)  # evicts t-b -> spills D2H
+    assert ad.host_tier.contains("adapter/t-b"), "evicted adapter not parked"
+    auto_out = ad.generate([prompt(6, 5)], max_new_tokens=6,
+                           adapter="t-b")[0]
+    assert "t-b" in ad.adapter_registry.loaded, "auto-load did not land"
+    ad_ref.load_adapter("t-b", adapter_state=sb)
+    ref_out = ad_ref.generate([prompt(6, 5)], max_new_tokens=6,
+                              adapter="t-b")[0]
+    assert auto_out == ref_out, "auto-loaded adapter diverged"
+
+    # ---- telemetry: host_tier/* catalog in the prom export ------------
+    a.close()
+    b.close()
+    lazy.close()
+    ref.close()
+    ad.close()
+    ad_ref.close()
+    prom = open(
+        os.path.join(tmp, "telemetry", "smoke_spill_a", "metrics.prom")
+    ).read()
+    for stream in ("host_tier_spills", "host_tier_promotions",
+                   "host_tier_occupancy_bytes"):
+        assert stream in prom, f"{stream} missing from the prom sink"
+
+    print(json.dumps({
+        "metric": "smoke_host_spill_tier",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": {
+            "spills": int(snap_a["host_tier_spills"]),
+            "promotions": int(snap_a["host_tier_promotions"]),
+            "peer_fetches": int(snap_b["host_tier_peer_fetches"]),
+            "preemptions": int(snap_l["host_tier_preemptions"]),
+            "adapter_auto_loaded": True,
+            "bitwise_parity": True,
         },
     }))
 
@@ -3601,6 +3862,9 @@ def main():
         return
     if "--smoke-infer-paged" in sys.argv:
         smoke_infer_paged()
+        return
+    if "--smoke-spill" in sys.argv:
+        smoke_spill()
         return
     if "--smoke-spec" in sys.argv:
         smoke_spec()

@@ -27,7 +27,6 @@ No jax imports — refcount exactness is unit-tested without a device.
 """
 
 import collections
-import itertools
 import threading
 
 IDENTITY_ADAPTER = 0  # pool row 0: all-zeros A/B — the no-adapter id
@@ -72,7 +71,7 @@ class AdapterPool:
         # pages cached under an adapter's OLD weights never match after a
         # reload with new weights (inference/engine.py)
         self._generation = {}
-        self._gen_counter = itertools.count(1)
+        self._next_gen = 1
         self.loads = 0
         self.evictions = 0
         self.requests = {}  # name -> submissions carrying this adapter
@@ -103,16 +102,23 @@ class AdapterPool:
             return self._active.get(name, 0)
 
     # -- load / evict ---------------------------------------------------
-    def assign(self, name):
+    def assign(self, name, generation=None):
         """Slot index for (re)loading ``name``: its current index when
         already loaded (a reload — new generation, same row), else a free
         slot, else the LRU idle adapter's slot (evicting it). Raises
         :class:`AdapterPoolFull` when every slot is pinned by live
-        requests. Returns ``(index, evicted_name_or_None)``."""
-        with self._lock:
-            return self._assign_locked(name)
+        requests. Returns ``(index, evicted_name_or_None)``.
 
-    def _assign_locked(self, name):
+        ``generation`` restores a specific load generation instead of
+        minting a fresh one — the host-tier auto-load path re-installs a
+        spilled adapter's ORIGINAL weights, so its original generation
+        (and therefore its salted prefix pages) must stay valid. The
+        counter fast-forwards past any restored value so a later true
+        reload still mints a strictly newer generation."""
+        with self._lock:
+            return self._assign_locked(name, generation)
+
+    def _assign_locked(self, name, generation=None):
         evicted = None
         if name in self._index:
             idx = self._index[name]
@@ -129,7 +135,13 @@ class AdapterPool:
         else:
             raise AdapterPoolFull(self.n_slots)
         self._index[name] = idx
-        self._generation[name] = next(self._gen_counter)
+        if generation is None:
+            generation = self._next_gen
+            self._next_gen += 1
+        else:
+            generation = int(generation)
+            self._next_gen = max(self._next_gen, generation + 1)
+        self._generation[name] = generation
         if name not in self._idle_lru and self._active.get(name, 0) == 0:
             self._idle_lru[name] = None
         self.loads += 1

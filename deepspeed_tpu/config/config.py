@@ -546,6 +546,30 @@ class DeepSpeedConfig:
             pc_dict, C.INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS,
             C.INFERENCE_PREFIX_CACHE_SUFFIX_BUCKETS_DEFAULT,
         )
+        # host_tier block — raw dict kept for the unknown-key check (a
+        # typo'd "lazy_alloc" must not silently mean "default off")
+        ht_dict = get_dict_param(inf_dict, C.INFERENCE_HOST_TIER)
+        self._inference_host_tier_raw = ht_dict
+        self.inference_host_tier_enabled = get_scalar_param(
+            ht_dict, C.INFERENCE_HOST_TIER_ENABLED,
+            C.INFERENCE_HOST_TIER_ENABLED_DEFAULT,
+        )
+        self.inference_host_tier_max_bytes = get_scalar_param(
+            ht_dict, C.INFERENCE_HOST_TIER_MAX_BYTES,
+            C.INFERENCE_HOST_TIER_MAX_BYTES_DEFAULT,
+        )
+        self.inference_host_tier_peer_sharing = get_scalar_param(
+            ht_dict, C.INFERENCE_HOST_TIER_PEER_SHARING,
+            C.INFERENCE_HOST_TIER_PEER_SHARING_DEFAULT,
+        )
+        self.inference_host_tier_share_group = get_scalar_param(
+            ht_dict, C.INFERENCE_HOST_TIER_SHARE_GROUP,
+            C.INFERENCE_HOST_TIER_SHARE_GROUP_DEFAULT,
+        )
+        self.inference_host_tier_lazy_alloc = get_scalar_param(
+            ht_dict, C.INFERENCE_HOST_TIER_LAZY_ALLOC,
+            C.INFERENCE_HOST_TIER_LAZY_ALLOC_DEFAULT,
+        )
         ckpt_dict = get_dict_param(inf_dict, C.INFERENCE_CHECKPOINT)
         self.inference_checkpoint_load_dir = get_scalar_param(
             ckpt_dict, C.INFERENCE_CHECKPOINT_LOAD_DIR,
@@ -1625,6 +1649,72 @@ class DeepSpeedConfig:
                     f"ascending non-empty list of integers >= 1 (each a "
                     f"compiled suffix-prefill width) or null (auto "
                     f"ladder), got {buckets!r}"
+                )
+        ht = f"{C.INFERENCE}.{C.INFERENCE_HOST_TIER}"
+        known_ht = {
+            C.INFERENCE_HOST_TIER_ENABLED,
+            C.INFERENCE_HOST_TIER_MAX_BYTES,
+            C.INFERENCE_HOST_TIER_PEER_SHARING,
+            C.INFERENCE_HOST_TIER_SHARE_GROUP,
+            C.INFERENCE_HOST_TIER_LAZY_ALLOC,
+        }
+        unknown_ht = set(self._inference_host_tier_raw) - known_ht
+        if unknown_ht:
+            # a typo'd "lazy_alloc" must not silently mean "default off"
+            raise DeepSpeedConfigError(
+                f"{ht}: unknown keys {sorted(unknown_ht)}; valid: "
+                f"{sorted(known_ht)}"
+            )
+        if not isinstance(self.inference_host_tier_enabled, bool):
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.INFERENCE_HOST_TIER_ENABLED} must be a boolean, "
+                f"got {self.inference_host_tier_enabled!r}"
+            )
+        mb = self.inference_host_tier_max_bytes
+        if not isinstance(mb, int) or isinstance(mb, bool) or mb < 1:
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.INFERENCE_HOST_TIER_MAX_BYTES} must be an "
+                f"integer >= 1 (host-RAM byte budget for parked "
+                f"pages/rows), got {mb!r}"
+            )
+        if not isinstance(self.inference_host_tier_peer_sharing, bool):
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.INFERENCE_HOST_TIER_PEER_SHARING} must be a "
+                f"boolean, got {self.inference_host_tier_peer_sharing!r}"
+            )
+        group = self.inference_host_tier_share_group
+        if not isinstance(group, str) or not group:
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.INFERENCE_HOST_TIER_SHARE_GROUP} must be a "
+                f"non-empty string naming the process-level share group, "
+                f"got {group!r}"
+            )
+        if not isinstance(self.inference_host_tier_lazy_alloc, bool):
+            raise DeepSpeedConfigError(
+                f"{ht}.{C.INFERENCE_HOST_TIER_LAZY_ALLOC} must be a "
+                f"boolean, got {self.inference_host_tier_lazy_alloc!r}"
+            )
+        if self.inference_host_tier_enabled:
+            if bs == 0 and not self.adapters_enabled:
+                raise DeepSpeedConfigError(
+                    f"{ht} has nothing to spill: enable the paged KV "
+                    f"cache ({C.INFERENCE_KV_BLOCK_SIZE} > 0) and/or "
+                    f"adapters ({C.ADAPTERS}.{C.ADAPTERS_ENABLED})"
+                )
+        if self.inference_host_tier_lazy_alloc:
+            if not self.inference_host_tier_enabled:
+                raise DeepSpeedConfigError(
+                    f"{ht}.{C.INFERENCE_HOST_TIER_LAZY_ALLOC} requires "
+                    f"the tier ({C.INFERENCE_HOST_TIER_ENABLED}: true): "
+                    f"a preempted request's pages park in host RAM, not "
+                    f"the trash"
+                )
+            if bs == 0:
+                raise DeepSpeedConfigError(
+                    f"{ht}.{C.INFERENCE_HOST_TIER_LAZY_ALLOC} requires "
+                    f"the paged cache: growth and preemption happen at "
+                    f"page granularity (set {C.INFERENCE_KV_BLOCK_SIZE} "
+                    f"> 0)"
                 )
 
     def _check_adapters(self):

@@ -619,6 +619,38 @@ INFERENCE_SPECULATIVE_K = "k"
 INFERENCE_SPECULATIVE_K_DEFAULT = 4
 INFERENCE_SPECULATIVE_DRAFT_CHECKPOINT = "draft_checkpoint"
 INFERENCE_SPECULATIVE_DRAFT_CHECKPOINT_DEFAULT = ""
+# Host-memory spill tier (docs/inference.md "Host-memory spill tier"):
+# treats HBM as a cache over host DRAM. Refcount-0 prefix pages evicted
+# by the BlockPool LRU — and adapter rows evicted by the AdapterPool —
+# are copied D2H into a byte-budgeted host LRU instead of dropped, and
+# promoted back H2D on a chain-hash / name hit (vLLM swap tier +
+# S-LoRA host paging, PAPERS.md). Requires something spillable: the
+# paged KV cache (kv_block_size > 0) and/or adapters.
+INFERENCE_HOST_TIER = "host_tier"
+INFERENCE_HOST_TIER_ENABLED = "enabled"
+INFERENCE_HOST_TIER_ENABLED_DEFAULT = False
+# Host-RAM byte budget for parked pages/rows; LRU past it.
+INFERENCE_HOST_TIER_MAX_BYTES = "max_bytes"
+INFERENCE_HOST_TIER_MAX_BYTES_DEFAULT = 1 << 28  # 256 MiB
+# Share one tier across every engine in this process (the node agent
+# hosts all its replicas' engines in one process, so this is same-host
+# peer sharing: one tenant's warm template/adapter warms the fleet).
+# False => a private tier per engine.
+INFERENCE_HOST_TIER_PEER_SHARING = "peer_sharing"
+INFERENCE_HOST_TIER_PEER_SHARING_DEFAULT = True
+# Named share-group for peer sharing (engines sharing a group share a
+# tier and its byte budget). Lets tests / co-hosted tenants isolate.
+INFERENCE_HOST_TIER_SHARE_GROUP = "share_group"
+INFERENCE_HOST_TIER_SHARE_GROUP_DEFAULT = "node"
+# Lazy page growth + preemption (replaces worst-case admission
+# reservation): admission reserves only the PROMPT's pages, decode grows
+# a slot one page at a time, and under pool pressure the scheduler
+# preempts the most-recently-admitted request — its full pages register
+# (so they park in the LRU / spill to the host tier) and it resumes
+# suffix-only with zero lost work. Requires the tier and the paged
+# cache.
+INFERENCE_HOST_TIER_LAZY_ALLOC = "lazy_alloc"
+INFERENCE_HOST_TIER_LAZY_ALLOC_DEFAULT = False
 # Optional checkpoint to serve from: loaded through the resilience
 # verified-load path (manifest check + host-side parse + newest-valid
 # fallback) before params pin to device shardings.

@@ -12,6 +12,11 @@ runtime/staging input pipeline), docs/inference.md. Four layers:
   paging.py    — the host-side page allocator behind the paged layout:
                  free list, prefix-hash registry, refcounts, LRU
                  eviction — cross-request prefix caching lives here.
+  host_tier.py — the host-RAM spill tier under the allocator: evicted
+                 KV prefix pages and LoRA adapter rows park D2H
+                 (checksummed) and promote back asynchronously; one
+                 tier instance is shared by every engine in a process
+                 share group, so co-hosted replicas warm each other.
   sampling.py  — jitted greedy/temperature/top-k/top-p sampling with
                  explicit PRNG-key threading.
   engine.py /  — ``init_inference()``: verified param load, device
@@ -32,6 +37,7 @@ from .decode import (
     write_prefill_to_pool,
 )
 from .engine import InferenceEngine, init_inference
+from .host_tier import HostTier, PromotionHandle
 from .paging import NULL_BLOCK, BlockPool, PoolExhausted, hash_full_blocks
 from .sampling import sample_tokens
 from .scheduler import (
@@ -53,6 +59,8 @@ __all__ = [
     "REJECT_OVERLOAD",
     "REJECT_RATE_LIMIT",
     "REJECT_REASONS",
+    "HostTier",
+    "PromotionHandle",
     "KVCache",
     "KVPool",
     "NULL_BLOCK",

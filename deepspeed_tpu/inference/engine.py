@@ -32,7 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..adapters.pool import AdapterPool, AdapterUnavailable
+from ..adapters.pool import AdapterPool, AdapterPoolFull, AdapterUnavailable
 from ..config import constants as C
 from ..config.config import DeepSpeedConfig, DeepSpeedConfigError
 from ..models.gpt2 import (
@@ -43,7 +43,7 @@ from ..models.gpt2 import (
 from ..parallel import mesh as mesh_lib
 from ..telemetry.manager import build_telemetry, register_inference_metrics
 from ..telemetry.registry import MetricsRegistry
-from ..utils.logging import log_dist
+from ..utils.logging import log_dist, logger
 from .decode import (
     gpt2_decode_step,
     gpt2_decode_step_paged,
@@ -363,6 +363,41 @@ class InferenceEngine:
                 enabled=True, registry=self.metrics
             ),
         )
+
+        # ---- host-memory spill tier (docs/inference.md "Host-memory
+        # spill tier") ---------------------------------------------------
+        # HBM as a cache over host DRAM: evicted prefix pages and adapter
+        # rows park D2H (keyed by chain hash / adapter name) and promote
+        # back on a hit. peer_sharing joins the process-level share-group
+        # tier — the node agent hosts all its replicas' engines in one
+        # process, so co-hosted engines warm each other.
+        self.host_tier = None
+        self.lazy_kv_alloc = False
+        if cfg.inference_host_tier_enabled:
+            import uuid as _uuid
+
+            from .host_tier import HostTier
+
+            self._tier_client_id = f"engine-{_uuid.uuid4().hex[:8]}"
+            place_fn = jax.device_put
+            if cfg.inference_host_tier_peer_sharing:
+                self.host_tier = HostTier.shared(
+                    cfg.inference_host_tier_share_group,
+                    max_bytes=cfg.inference_host_tier_max_bytes,
+                    place_fn=place_fn,
+                )
+            else:
+                self.host_tier = HostTier(
+                    max_bytes=cfg.inference_host_tier_max_bytes,
+                    place_fn=place_fn,
+                )
+            self.host_tier.retain()
+            self.lazy_kv_alloc = bool(
+                cfg.inference_host_tier_lazy_alloc
+            ) and self.paged
+            from ..telemetry.manager import register_host_tier_metrics
+
+            register_host_tier_metrics(self.metrics)
         params = model_parameters
         self.loaded_tag = None
         if cfg.inference_checkpoint_load_dir:
@@ -407,9 +442,13 @@ class InferenceEngine:
             )
             self._cache_sharding = KVPool(k=pool_sharding, v=pool_sharding)
             # host-side allocator: page free list, prefix-hash registry,
-            # refcounts, eviction LRU (inference/paging.py)
+            # refcounts, eviction LRU (inference/paging.py). With the
+            # host tier armed, evicted registered pages spill D2H
+            # instead of dropping (docs/inference.md "Host-memory spill
+            # tier").
             self.block_pool = BlockPool(
-                self.kv_pool_blocks, self.kv_block_size
+                self.kv_pool_blocks, self.kv_block_size,
+                spill_fn=self._spill_kv_page if self.host_tier else None,
             )
             self._block_tables = np.zeros(
                 (self.num_slots, self.blocks_per_slot), np.int32
@@ -455,6 +494,12 @@ class InferenceEngine:
             self.adapter_registry = AdapterPool(self.adapter_pool_slots)
             self._slot_adapters = np.zeros(self.num_slots, np.int32)
             self._slot_adapter_names = {}  # slot -> adapter name
+            # name -> load generation, mirrored at assign time: the
+            # registry pops an evicted tenant's generation before assign
+            # returns, but the host-tier spill must park the ORIGINAL
+            # generation with the rows (the auto-load restore keeps the
+            # evicted adapter's salted prefix pages valid)
+            self._adapter_generations = {}
             # checkpoint-load template, built lazily from target SHAPES
             # (adapter_host_template) and cached — shapes never change
             self._adapter_template = None
@@ -567,6 +612,33 @@ class InferenceEngine:
                 key, temp, **self._sampling_statics,
             )
         )
+        if self.host_tier is not None:
+            # host-tier copy programs, all with TRACED indices so the
+            # thousandth spill/promotion compiles nothing new:
+            #   page gather  — one page's [L, bs, heads, hd] k/v rows D2H
+            #   page scatter — a promoted page's rows back into the pool
+            #   row gather   — an evicted adapter's A/B rows D2H
+            if self.paged:
+                self._jit_page_gather = jax.jit(
+                    lambda pool, idx: (pool.k[:, idx], pool.v[:, idx])
+                )
+
+                def _page_scatter(pool, idx, k_rows, v_rows):
+                    return KVPool(
+                        k=pool.k.at[:, idx].set(k_rows.astype(pool.k.dtype)),
+                        v=pool.v.at[:, idx].set(v_rows.astype(pool.v.dtype)),
+                    )
+
+                self._jit_page_scatter = jax.jit(
+                    _page_scatter,
+                    donate_argnums=(0,) if donate_cache else (),
+                )
+            if self.multi_lora:
+                self._jit_adapter_row_gather = jax.jit(
+                    lambda pool, idx: jax.tree_util.tree_map(
+                        lambda p: p[:, idx], pool
+                    )
+                )
 
         # ---- speculative decoding state (docs/inference.md) -----------
         # the draft rides its own CONTIGUOUS cache (it shares nothing —
@@ -751,6 +823,26 @@ class InferenceEngine:
                 "adapters/requests"
             )
 
+        # ---- host_tier/* metric streams (docs/observability.md) -------
+        if self.host_tier is not None:
+            self._ht_occupancy = self.metrics.gauge(
+                "host_tier/occupancy_bytes"
+            )
+            self._ht_entries = self.metrics.gauge("host_tier/entries")
+            self._ht_spills = self.metrics.counter("host_tier/spills")
+            self._ht_promotions = self.metrics.counter(
+                "host_tier/promotions"
+            )
+            self._ht_peer_fetches = self.metrics.counter(
+                "host_tier/peer_fetches"
+            )
+            self._ht_preemptions = self.metrics.counter(
+                "host_tier/preemptions"
+            )
+            self._ht_copy_faults = self.metrics.counter(
+                "host_tier/copy_faults"
+            )
+
         # ---- scheduler ------------------------------------------------
         self.scheduler = ContinuousBatchingScheduler(
             self,
@@ -838,6 +930,18 @@ class InferenceEngine:
         total = min(int(prompt_len) + int(max_new_tokens), self.max_seq_len)
         return self.block_pool.blocks_for(total)
 
+    def kv_blocks_needed_now(self, prompt_len, max_new_tokens):
+        """Pages admission actually reserves: the worst case by default;
+        under ``host_tier.lazy_alloc`` only the PROMPT's pages — decode
+        grows the slot one page at a time (ensure_decode_capacity) and
+        the scheduler preempts under pressure instead of gating
+        admission on tokens that may never be generated."""
+        if self.lazy_kv_alloc:
+            return self.block_pool.blocks_for(
+                min(int(prompt_len), self.max_seq_len)
+            )
+        return self.kv_blocks_needed(prompt_len, max_new_tokens)
+
     def kv_blocks_available(self):
         """Pages an admission could obtain right now (free + evictable
         cached): the REJECT_CAPACITY gate's denominator."""
@@ -856,7 +960,7 @@ class InferenceEngine:
         if not self.paged:
             return 0
         plen = len(prompt_tokens)
-        needed = self.kv_blocks_needed(plen, max_new_tokens)
+        needed = self.kv_blocks_needed_now(plen, max_new_tokens)
         # cheap pressure short-circuit BEFORE the O(prompt) hash chain: a
         # deferred request retries here every step, and even a full
         # prefix hit (at most the prompt's full pages minus one) cannot
@@ -878,18 +982,35 @@ class InferenceEngine:
             prefix_len, shared = self.block_pool.match_prefix(
                 prompt_tokens, hashes=hashes
             )
-            if prefix_len and self._suffix_bucket(
-                plen - prefix_len, prefix_len
-            ) is None:
-                # no compiled suffix width fits this (suffix, prefix)
-                # pair — e.g. a small user-configured bucket list, or a
-                # bucket that would pad past max_seq_len and clamp its
-                # garbage rows into the slot's REAL last page: fall back
-                # to the always-correct cold full prefill (a miss, not a
-                # hit — the pages still share on the next request)
-                self.block_pool.release(shared)
-                prefix_len, shared = 0, []
         else:
+            prefix_len, shared = 0, []
+        # host-tier promotion: extend the device match with the
+        # contiguous run of SPILLED pages parked under the same chain
+        # (possibly by a peer engine). Promoted pages land in freshly
+        # allocated private pages — the tier saves the prefill COMPUTE,
+        # not the allocation — then register so they share like any
+        # cached prefix.
+        promoted = []
+        if self.prefix_cache_enabled and self.host_tier is not None:
+            promoted = self._promote_chain(hashes, len(shared), plen)
+        while promoted:
+            # the combined prefix still needs a compiled suffix width;
+            # shrink the promotion until one fits (the device-only match
+            # re-checks below)
+            pl = (len(shared) + len(promoted)) * self.kv_block_size
+            if self._suffix_bucket(plen - pl, pl) is not None:
+                break
+            promoted.pop()
+        if not promoted and prefix_len and self._suffix_bucket(
+            plen - prefix_len, prefix_len
+        ) is None:
+            # no compiled suffix width fits this (suffix, prefix)
+            # pair — e.g. a small user-configured bucket list, or a
+            # bucket that would pad past max_seq_len and clamp its
+            # garbage rows into the slot's REAL last page: fall back
+            # to the always-correct cold full prefill (a miss, not a
+            # hit — the pages still share on the next request)
+            self.block_pool.release(shared)
             prefix_len, shared = 0, []
         try:
             private = self.block_pool.alloc(needed - len(shared))
@@ -897,6 +1018,25 @@ class InferenceEngine:
             if shared:
                 self.block_pool.release(shared)
             raise
+        if promoted:
+            # scatter the parked rows H2D into the first promoted-count
+            # private pages (placement was staged asynchronously; the
+            # stager overlaps page i+1's device_put with page i's
+            # scatter), then publish their hashes — later requests share
+            # them like any device-cached prefix
+            for i, (h, (k_rows, v_rows), peer) in enumerate(promoted):
+                self._cache = self._jit_page_scatter(
+                    self._cache, jnp.int32(private[i]), k_rows, v_rows
+                )
+                self._ht_promotions.inc()
+                if peer:
+                    self._ht_peer_fetches.inc()
+            self.block_pool.register_prefix(
+                prompt_tokens,
+                [private[i] for i in range(len(promoted))],
+                hashes=[h for h, _, _ in promoted],
+            )
+            prefix_len = (len(shared) + len(promoted)) * self.kv_block_size
         if self.prefix_cache_enabled:
             (self._prefix_hits if prefix_len else self._prefix_misses).inc()
         blocks = shared + private
@@ -909,14 +1049,178 @@ class InferenceEngine:
         self._sync_pool_metrics()
         return prefix_len
 
-    def release_slot(self, slot):
+    # -- host-tier seams (docs/inference.md "Host-memory spill tier") ---
+    def _ht_fault_mode(self):
+        """Consult the ``host_tier.copy`` chaos site at a copy seam.
+        Returns None (no fault), "oserror" (skip the copy — a spill is
+        dropped, a promotion reads cold), or "garble" (park a corrupted
+        payload for the checksum walk to catch). Counted either way."""
+        spec = self.resilience.faults.fire("host_tier.copy")
+        if spec is None:
+            return None
+        self._ht_copy_faults.inc()
+        return spec.args.get("mode", "oserror")
+
+    def _spill_kv_page(self, block_id, chain_hash):
+        """BlockPool eviction seam: park the evicted registered page's
+        device k/v rows in the host tier D2H while they are still
+        intact (the allocator frees the id right after). Never raises —
+        a failed spill degrades to the tier-less behavior (the page
+        drops) and serving continues."""
+        corrupt = False
+        mode = self._ht_fault_mode()
+        if mode == "garble":
+            corrupt = True
+        elif mode is not None:
+            logger.warning(
+                "host-tier spill of page %d skipped (injected "
+                "host_tier.copy fault): the page drops as without the "
+                "tier", block_id,
+            )
+            return
+        k_rows, v_rows = self._jit_page_gather(
+            self._cache, jnp.int32(block_id)
+        )
+        stored = self.host_tier.put(
+            chain_hash,
+            (np.asarray(k_rows), np.asarray(v_rows)),
+            meta={"kind": "kv"},
+            origin=self._tier_client_id,
+            corrupt=corrupt,
+        )
+        if stored:
+            self._ht_spills.inc()
+
+    def _promote_chain(self, hashes, start, plen):
+        """Fetch the contiguous run of spilled pages extending the
+        device prefix match at page index ``start``. Every fetch is
+        staged on the tier's async worker first (the WindowStager
+        device_put pattern), then consumed in order — page i+1's H2D
+        placement overlaps page i's scatter. Returns a list of
+        ``(chain_hash, (k_rows, v_rows), is_peer_fetch)``; any failure
+        (chaos fault, checksum drop, raced eviction, geometry mismatch)
+        truncates the run — the remainder re-prefills cold, wrong pages
+        are never served."""
+        tier = self.host_tier
+        eligible = hashes or []
+        if eligible and plen == len(eligible) * self.kv_block_size:
+            # same N-1 rule as match_prefix: the whole prompt can never
+            # be served from cache
+            eligible = eligible[:-1]
+        handles = []
+        for h in eligible[start:]:
+            if not tier.contains(h):
+                break
+            mode = self._ht_fault_mode()
+            if mode is not None:
+                logger.warning(
+                    "host-tier promotion truncated (injected "
+                    "host_tier.copy fault): the remaining prefix "
+                    "re-prefills cold"
+                )
+                break
+            handle = tier.fetch_async(h, requester=self._tier_client_id)
+            if handle is None:
+                break
+            handles.append((h, handle))
+        out, failed = [], False
+        # one page's [L, bs, heads, hd] rows — the pool minus the page axis
+        k_shape = (self._cache.k.shape[0],) + tuple(self._cache.k.shape[2:])
+        for h, handle in handles:
+            if failed:
+                try:
+                    handle.result()  # drain to unpin the tier entry
+                except Exception:
+                    pass
+                continue
+            try:
+                k_rows, v_rows = handle.result()
+            except Exception:
+                self._ht_copy_faults.inc()
+                logger.warning(
+                    "host-tier promotion of %s failed at placement; "
+                    "falling back to cold prefill", h,
+                )
+                failed = True
+                continue
+            if tuple(k_rows.shape) != k_shape:
+                # a peer with different pool geometry parked this entry
+                failed = True
+                continue
+            out.append((h, (k_rows, v_rows), handle.peer))
+        return out
+
+    def ensure_decode_capacity(self, active_slots):
+        """Lazy page growth (host_tier.lazy_alloc): before a decode
+        step, extend every active slot's page list to cover the rows
+        the step will write (one token, or the speculative burst).
+        Raises :class:`paging.PoolExhausted` when the pool cannot grow a
+        slot even after evicting every cached page — the scheduler
+        preempts and retries."""
+        if not (self.paged and self.lazy_kv_alloc):
+            return
+        budget = (self.spec_k + 1) if self.speculative else 1
+        for slot in active_slots:
+            blocks = self._slot_blocks.get(slot)
+            if blocks is None:
+                continue
+            required = self.block_pool.blocks_for(
+                min(int(self._lengths[slot]) + budget, self.max_seq_len)
+            )
+            while len(blocks) < required:
+                new = self.block_pool.alloc(1)
+                self._block_tables[slot][len(blocks)] = new[0]
+                blocks.extend(new)
+        self._sync_pool_metrics()
+
+    def count_preemption(self):
+        """Scheduler hook: one request preempted under page pressure
+        (its pages parked, the request re-queued for suffix resume)."""
+        if self.host_tier is not None:
+            self._ht_preemptions.inc()
+
+    def _register_decode_pages(self, slot, final_tokens):
+        """Decode-page chain hashing: extend the prefix registry to the
+        full pages this request COMPLETED DURING DECODE, so generated
+        continuations become shareable/spillable prefixes — and a
+        preempted request's resume (prompt + tokens so far) matches
+        everything but its final partial page. Runs before the slot's
+        pages release (the pages must still be live) and before its
+        adapter pin drops (the chain salt needs the adapter identity)."""
+        if not self.prefix_cache_enabled or self._brownout:
+            return
+        blocks = self._slot_blocks.get(slot)
+        if not blocks:
+            return
+        # cache rows hold prompt + tokens[:-1] (the final sampled
+        # token's k/v is never written): exactly the first _lengths rows
+        valid = [int(t) for t in final_tokens][: int(self._lengths[slot])]
+        n_full = len(valid) // self.kv_block_size
+        if n_full <= 0:
+            return
+        self.block_pool.register_prefix(
+            valid, blocks[:n_full],
+            hashes=hash_full_blocks(
+                valid, self.kv_block_size, salt=self._adapter_salt(slot)
+            ),
+        )
+
+    def release_slot(self, slot, final_tokens=None):
         """Return a finished/evicted request's pages to the pool (shared
         prefix pages decref; full prompt pages stay cached for the next
         request with that prefix) and NULL its block-table row so the
         dead slot's ride-along decode writes sink into the sacrificial
         page instead of pages the pool may hand to someone else. Also
         drops the slot's adapter pin (its id resets to the identity, so
-        the dead slot's ride-along gathers read the zero rows)."""
+        the dead slot's ride-along gathers read the zero rows).
+
+        ``final_tokens`` (prompt + generated tokens, scheduler-provided)
+        arms decode-page chain hashing: the request's full pages —
+        including ones completed during decode — register before release
+        so they park in the LRU (and spill to the host tier) instead of
+        dropping."""
+        if self.paged and final_tokens is not None:
+            self._register_decode_pages(slot, final_tokens)
         if self.multi_lora:
             name = self._slot_adapter_names.pop(slot, None)
             if name is not None:
@@ -938,24 +1242,42 @@ class InferenceEngine:
         if pool.reclaimed > self._reclaimed_synced:
             self._kv_reclaimed.inc(pool.reclaimed - self._reclaimed_synced)
             self._reclaimed_synced = pool.reclaimed
+        if self.host_tier is not None:
+            self._ht_occupancy.set(self.host_tier.occupancy_bytes)
+            self._ht_entries.set(self.host_tier.entries)
 
     def kv_snapshot(self):
         """Pool/prefix-cache state for ``load_snapshot()`` — the numbers
         the fleet router's placement and per-replica gauges read."""
         if not self.paged:
-            return {}
-        hits = self._prefix_hits.value
-        misses = self._prefix_misses.value
-        return {
-            "kv_blocks_total": self.block_pool.num_blocks,
-            "kv_blocks_free": self.block_pool.available_blocks,
-            "kv_blocks_used": self.block_pool.used_blocks,
-            "prefix_hits": hits,
-            "prefix_misses": misses,
-            "prefix_hit_rate": (
-                hits / (hits + misses) if hits + misses else 0.0
-            ),
-        }
+            out = {}
+        else:
+            hits = self._prefix_hits.value
+            misses = self._prefix_misses.value
+            out = {
+                "kv_blocks_total": self.block_pool.num_blocks,
+                "kv_blocks_free": self.block_pool.available_blocks,
+                "kv_blocks_used": self.block_pool.used_blocks,
+                "prefix_hits": hits,
+                "prefix_misses": misses,
+                "prefix_hit_rate": (
+                    hits / (hits + misses) if hits + misses else 0.0
+                ),
+            }
+        if self.host_tier is not None:
+            # the engine's own counters plus the (possibly peer-shared)
+            # tier's occupancy — the fleet router mirrors these to
+            # fleet/replica{i}/host_tier_* gauges
+            out.update({
+                "host_tier_occupancy_bytes": self.host_tier.occupancy_bytes,
+                "host_tier_entries": self.host_tier.entries,
+                "host_tier_spills": self._ht_spills.value,
+                "host_tier_promotions": self._ht_promotions.value,
+                "host_tier_peer_fetches": self._ht_peer_fetches.value,
+                "host_tier_preemptions": self._ht_preemptions.value,
+                "host_tier_copy_faults": self._ht_copy_faults.value,
+            })
+        return out
 
     # -- multi-tenant LoRA adapters (docs/adapters.md) ------------------
     def _require_multi_lora(self):
@@ -1052,6 +1374,20 @@ class InferenceEngine:
                     f"pool rows {want[0]}/{want[1]} (model/rank mismatch?)"
                 )
         idx, evicted = self.adapter_registry.assign(name)
+        if evicted is not None:
+            # park the outgoing tenant's rows D2H while they are still
+            # in the pool (the write below overwrites — and on TPU
+            # donates — row idx); a later submit for the evicted name
+            # auto-loads from the tier instead of failing
+            self._spill_adapter_row(evicted, idx)
+        # an explicit (re)load carries FRESH weights under a NEW
+        # generation: any tier copy of the old weights is stale — and its
+        # salted prefix pages unreachable — so drop it
+        if self.host_tier is not None:
+            self.host_tier.discard(f"adapter/{name}")
+        self._adapter_generations[name] = (
+            self.adapter_registry.generation_of(name)
+        )
         self._adapter_pool = self._jit_pool_write(
             self._adapter_pool,
             {t: (jnp.asarray(a), jnp.asarray(b))
@@ -1075,29 +1411,153 @@ class InferenceEngine:
 
     def unload_adapter(self, name):
         """Explicitly evict ``name`` (refused while live requests decode
-        against it); frees its pool row for the next load."""
+        against it); frees its pool row for the next load. An explicit
+        unload is intentional removal: any host-tier copy drops too, so
+        the tenant cannot silently resurrect through auto-load."""
         self._require_multi_lora()
         idx = self.adapter_registry.remove(name)
+        self._adapter_generations.pop(name, None)
+        if self.host_tier is not None:
+            self.host_tier.discard(f"adapter/{name}")
         self._adapter_evictions.inc()
         self._adapter_occupancy.set(self.adapter_registry.used_slots)
         return idx
 
+    def _spill_adapter_row(self, name, idx):
+        """Park an evicted adapter's pool rows (still at row ``idx``) in
+        the host tier D2H, keyed ``adapter/<name>`` with its load
+        generation — the auto-load restore re-installs the SAME weights
+        under the SAME generation, so the tenant's salted prefix pages
+        stay valid. Never raises (chaos or copy failure drops the park;
+        the adapter is then simply gone, as without the tier)."""
+        if self.host_tier is None:
+            return
+        mode = self._ht_fault_mode()
+        if mode is not None and mode != "garble":
+            logger.warning(
+                "host-tier spill of adapter %r skipped (injected "
+                "host_tier.copy fault)", name,
+            )
+            return
+        generation = self._adapter_generations.get(name)
+        targets = sorted(self._adapter_pool)
+        rows = self._jit_adapter_row_gather(
+            self._adapter_pool, jnp.int32(idx)
+        )
+        arrays = []
+        for t in targets:
+            a, b = rows[t]
+            arrays.extend((np.asarray(a), np.asarray(b)))
+        stored = self.host_tier.put(
+            f"adapter/{name}",
+            arrays,
+            meta={
+                "kind": "adapter",
+                "generation": generation,
+                "targets": targets,
+            },
+            origin=self._tier_client_id,
+            corrupt=(mode == "garble"),
+        )
+        if stored:
+            self._ht_spills.inc()
+
+    def _auto_load_adapter_from_tier(self, name):
+        """Re-install a spilled adapter from the host tier. Returns
+        "loaded" (now resident, original generation restored),
+        "deferred" (the tier holds it but every pool slot is pinned by
+        live requests — retry when traffic drains, exactly like a KV
+        page shortfall), or False (not in the tier / promotion failed —
+        the adapter is genuinely unavailable)."""
+        if not self.multi_lora or self.host_tier is None:
+            return False
+        key = f"adapter/{name}"
+        if not self.host_tier.contains(key):
+            return False
+        if self._ht_fault_mode() is not None:
+            logger.warning(
+                "host-tier auto-load of adapter %r skipped (injected "
+                "host_tier.copy fault)", name,
+            )
+            return False
+        got = self.host_tier.fetch(key, requester=self._tier_client_id)
+        if got is None:
+            return False
+        arrays, meta, origin = got
+        targets = meta.get("targets") or []
+        if sorted(self._adapter_pool) != list(targets) or len(arrays) != (
+            2 * len(targets)
+        ):
+            return False
+        stacks = {
+            t: (arrays[2 * i], arrays[2 * i + 1])
+            for i, t in enumerate(targets)
+        }
+        for t, (a, b) in stacks.items():
+            la, lb = self._adapter_pool[t]
+            want = (
+                (la.shape[0], *la.shape[2:]), (lb.shape[0], *lb.shape[2:]),
+            )
+            if (tuple(a.shape), tuple(b.shape)) != want:
+                return False  # a peer with different pool geometry
+        try:
+            idx, evicted = self.adapter_registry.assign(
+                name, generation=meta.get("generation")
+            )
+        except AdapterPoolFull:
+            return "deferred"
+        if evicted is not None:
+            self._spill_adapter_row(evicted, idx)
+            self._adapter_evictions.inc()
+        self._adapter_generations[name] = (
+            self.adapter_registry.generation_of(name)
+        )
+        self._adapter_pool = self._jit_pool_write(
+            self._adapter_pool, stacks, jnp.int32(idx)
+        )
+        # the host copy stays: it is bitwise-identical to the rows just
+        # installed, and peer replicas in the share group warm from it
+        self._adapter_loads.inc()
+        self._ht_promotions.inc()
+        if origin is not None and origin != self._tier_client_id:
+            self._ht_peer_fetches.inc()
+        self._adapter_occupancy.set(self.adapter_registry.used_slots)
+        log_dist(
+            f"auto-loaded adapter {name!r} from the host tier into pool "
+            f"row {idx} (generation "
+            f"{self.adapter_registry.generation_of(name)} restored)",
+            ranks=[0],
+        )
+        return "loaded"
+
     def resolve_adapter(self, name):
         """Submit-time validation + per-adapter accounting: returns the
-        adapter's CURRENT pool row. Raises
+        adapter's CURRENT pool row. A known-but-not-resident name (its
+        rows parked in the host tier) auto-loads here — or, when every
+        pool slot is pinned, is accepted anyway (returns None) and the
+        slot join retries the auto-load, deferring exactly like a KV
+        page shortfall. Raises
         :class:`~deepspeed_tpu.adapters.AdapterUnavailable` (a
-        ValueError) for an unloaded name — THIS engine can never serve
-        it, but the typed subclass lets a fleet router fall through to a
-        replica that holds the adapter."""
+        ValueError) for a genuinely unknown name — THIS engine can never
+        serve it, but the typed subclass lets a fleet router fall
+        through to a replica that holds the adapter."""
         self._require_multi_lora()
         try:
             idx = self.adapter_registry.index_of(name)
         except KeyError:
-            raise AdapterUnavailable(
-                f"adapter {name!r} is not loaded (loaded: "
-                f"{self.adapter_registry.loaded}); call "
-                "engine.load_adapter() first"
-            ) from None
+            state = self._auto_load_adapter_from_tier(name)
+            if state == "loaded":
+                idx = self.adapter_registry.index_of(name)
+            elif state == "deferred":
+                self._adapter_requests.inc()
+                self.metrics.counter(f"adapters/requests/{name}").inc()
+                return None
+            else:
+                raise AdapterUnavailable(
+                    f"adapter {name!r} is not loaded (loaded: "
+                    f"{self.adapter_registry.loaded}); call "
+                    "engine.load_adapter() first"
+                ) from None
         self.adapter_registry.count_request(name)
         self._adapter_requests.inc()
         self.metrics.counter(f"adapters/requests/{name}").inc()
@@ -1108,7 +1568,12 @@ class InferenceEngine:
         lifetime and point the slot's adapter id at its pool row. Returns
         False when the adapter was evicted between submit and join — the
         scheduler fail-finishes that request instead of serving it the
-        identity (or another tenant's) weights."""
+        identity (or another tenant's) weights. With the host tier, an
+        evicted-but-parked adapter auto-loads here instead; a tier hit
+        that cannot land because every pool slot is pinned raises
+        :class:`~deepspeed_tpu.adapters.AdapterPoolFull`, which the
+        scheduler turns into a deferral (retry at the next step
+        boundary) exactly like a KV page shortfall."""
         if not self.multi_lora:
             return True
         if name is None:
@@ -1120,7 +1585,13 @@ class InferenceEngine:
         try:
             idx = self.adapter_registry.acquire(name)
         except KeyError:
-            return False
+            state = self._auto_load_adapter_from_tier(name)
+            if state == "loaded":
+                idx = self.adapter_registry.acquire(name)
+            elif state == "deferred":
+                raise AdapterPoolFull(self.adapter_pool_slots) from None
+            else:
+                return False
         self._slot_adapters[slot] = idx
         self._slot_adapter_names[slot] = name
         return True
@@ -1333,7 +1804,11 @@ class InferenceEngine:
             # the pool's pages (and any cached prefixes) died with the
             # cache contents: fresh allocator, nulled tables
             self.block_pool = BlockPool(
-                self.kv_pool_blocks, self.kv_block_size
+                self.kv_pool_blocks, self.kv_block_size,
+                spill_fn=(
+                    self._spill_kv_page if self.host_tier is not None
+                    else None
+                ),
             )
             self._reclaimed_synced = 0
             self._block_tables[:] = NULL_BLOCK
@@ -1551,6 +2026,11 @@ class InferenceEngine:
 
     def close(self):
         self.scheduler.shutdown()
+        if self.host_tier is not None:
+            # drop this engine's share-group reference; the LAST engine
+            # out closes the tier's stager thread and retires the group
+            self.host_tier.release()
+            self.host_tier = None
         if self.telemetry.enabled:
             self.telemetry.export()
             self.telemetry.close()

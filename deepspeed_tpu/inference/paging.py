@@ -25,11 +25,15 @@ syncs:
                  :class:`PoolExhausted` surface, which the scheduler
                  turns into the typed ``REJECT_CAPACITY`` rejection.
 
-Decode-time appends never touch this class mid-flight: the scheduler
-reserves a request's worst case (``blocks_for(prompt + max_new)``) at
-slot-join, so a running request can never hit pool exhaustion between
-tokens — admission is the only gate (docs/inference.md discusses the
-trade against lazy per-token growth).
+By default decode-time appends never touch this class mid-flight: the
+scheduler reserves a request's worst case (``blocks_for(prompt +
+max_new)``) at slot-join, so a running request can never hit pool
+exhaustion between tokens — admission is the only gate. With the host
+tier's ``lazy_alloc`` mode the engine instead grows a slot's pages one
+at a time between decode steps and the scheduler preempts under
+pressure — a preempted request's registered pages park here (and spill
+to host RAM on eviction via ``spill_fn``) so it resumes suffix-only
+(docs/inference.md "Host-memory spill tier").
 
 No jax imports — unit-testable refcount exactness (test_paged_kv.py).
 """
@@ -90,7 +94,7 @@ class BlockPool:
     driver thread is the only caller (same contract as the slot table).
     """
 
-    def __init__(self, num_blocks, block_size):
+    def __init__(self, num_blocks, block_size, spill_fn=None):
         if int(num_blocks) < 1:
             raise ValueError(
                 f"BlockPool needs >= 1 usable page, got {num_blocks}"
@@ -104,6 +108,14 @@ class BlockPool:
         # refcount-0 registered pages, insertion order = LRU order
         self._cached = collections.OrderedDict()
         self.reclaimed = 0  # cached pages evicted to satisfy allocations
+        # host-tier seam: called as spill_fn(block_id, chain_hash) while
+        # the page's device content is still intact — BEFORE the id
+        # returns to the free list. The callback owns its own error
+        # handling (the engine's absorbs host_tier.copy faults); a leak
+        # through it must not corrupt the pool mid-allocation, so it is
+        # contained here and counted.
+        self._spill_fn = spill_fn
+        self.spill_errors = 0
 
     # -- introspection --------------------------------------------------
     @property
@@ -148,8 +160,15 @@ class BlockPool:
         return out
 
     def _evict_one(self):
-        block_id, _ = self._cached.popitem(last=False)
-        h = self._hash_of.pop(block_id)
+        block_id = next(iter(self._cached))
+        h = self._hash_of[block_id]
+        if self._spill_fn is not None:
+            try:
+                self._spill_fn(block_id, h)
+            except Exception:
+                self.spill_errors += 1
+        del self._cached[block_id]
+        del self._hash_of[block_id]
         del self._registry[h]
         self._free.append(block_id)
         self.reclaimed += 1

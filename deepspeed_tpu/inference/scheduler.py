@@ -42,6 +42,7 @@ import threading
 import time
 import uuid
 
+from ..adapters.pool import AdapterPoolFull
 from ..telemetry.registry import DEFAULT_TIME_BUCKETS_MS, histogram_quantile
 from ..telemetry.tracing import NOOP_TRACER, TraceContext
 from ..utils.logging import logger
@@ -239,6 +240,11 @@ class ContinuousBatchingScheduler:
         # re-enter admission FIRST at the next step boundary, once a
         # finishing request has released pages
         self._deferred = collections.deque()
+        # admission-order stamp per slot: preemption (lazy page growth,
+        # engine.ensure_decode_capacity) victims the MOST recently
+        # admitted request — it has the least sunk prefill/decode work
+        self._slot_admit_seq = [0] * self.num_slots
+        self._admit_seq = 0
         self._registry = registry
         self._telemetry = telemetry
         self._export_interval = max(1, int(export_interval))
@@ -508,18 +514,30 @@ class ContinuousBatchingScheduler:
             needed = self._engine.kv_blocks_needed(n, int(max_new_tokens))
             total = self._engine.kv_pool_total_blocks()
             if needed > total:
+                # worst case stays the feasibility bound even under lazy
+                # growth: a request that can NEVER fit whole would only
+                # thrash the preemption path without ever completing
                 raise ValueError(
                     f"request needs {needed} KV pages (prompt {n} + "
                     f"max_new_tokens {max_new_tokens}) but the pool holds "
                     f"only {total}; raise inference.kv_pool_blocks or "
                     f"lower the generation budget"
                 )
+            # under lazy allocation (host_tier.lazy_alloc) admission only
+            # reserves the PROMPT's pages; decode-time growth is backed by
+            # preemption, so the shed gate sizes against that smaller
+            # footprint instead of the worst case
+            needed_now_fn = getattr(self._engine, "kv_blocks_needed_now", None)
+            gate_needed = (
+                needed_now_fn(n, int(max_new_tokens))
+                if needed_now_fn is not None else needed
+            )
             available = self._engine.kv_blocks_available()
-            if needed > available:
+            if gate_needed > available:
                 self._rejected.inc()
                 self._reject_event(REJECT_CAPACITY)
                 raise RequestRejected(
-                    f"KV page pool exhausted: request needs {needed} "
+                    f"KV page pool exhausted: request needs {gate_needed} "
                     f"pages, {available} free or evictable (of {total})",
                     reason=REJECT_CAPACITY,
                 )
@@ -585,11 +603,93 @@ class ContinuousBatchingScheduler:
     def _free_slot(self, slot):
         """Vacate ``slot`` and hand its KV pages back to a paged engine
         (shared prefix pages decref, private ones free; the block-table
-        row nulls so the dead slot's ride-along writes stay harmless)."""
+        row nulls so the dead slot's ride-along writes stay harmless).
+        The request's final token sequence rides along so the engine can
+        register the slot's FULL decode blocks as shareable prefix pages
+        (docs/inference.md: decode-page chain hashing) before they
+        release — engines without that signature get the bare call."""
+        req = self._slots[slot]
         self._slots[slot] = None
         release = getattr(self._engine, "release_slot", None)
-        if release is not None:
-            release(slot)
+        if release is None:
+            return
+        if req is not None:
+            try:
+                release(
+                    slot,
+                    final_tokens=list(req.prompt_tokens) + list(req.tokens),
+                )
+                return
+            except TypeError:
+                pass  # duck-typed engine with the old 1-arg signature
+        release(slot)
+
+    def _ensure_decode_capacity(self):
+        """Lazy KV page growth (host_tier.lazy_alloc): before the decode
+        step, ask the engine to top up every active slot's block list for
+        the tokens this step can commit. A shortfall PREEMPTS the most
+        recently admitted request — its slot frees (parking its full
+        blocks in the reclaimable prefix cache, spillable to the host
+        tier), it re-enters the deferred line, and it later resumes
+        suffix-only with zero lost tokens — then the top-up retries. A
+        lone active request always succeeds: admission's worst-case
+        ``> total`` bound guarantees the whole pool can hold it."""
+        ensure = getattr(self._engine, "ensure_decode_capacity", None)
+        if ensure is None:
+            return
+        count_preempt = getattr(self._engine, "count_preemption", None)
+        prefill_len = getattr(self._engine, "prefill_len", None)
+        while True:
+            active = self.active_slots
+            if not active:
+                return
+            try:
+                ensure(active)
+                return
+            except PoolExhausted:
+                pass
+            # victim the most recently admitted request that can still
+            # RESUME (its prompt + committed tokens must re-prefill in
+            # one window); anything grown past the prefill window is
+            # unresumable and only fail-finished as a last resort
+            def _resumable(s):
+                req = self._slots[s]
+                return prefill_len is None or (
+                    len(req.prompt_tokens) + len(req.tokens)
+                ) <= prefill_len
+            order = sorted(
+                active, key=lambda s: self._slot_admit_seq[s], reverse=True
+            )
+            victim = next((s for s in order if _resumable(s)), None)
+            if victim is None:
+                slot = order[0]
+                req = self._slots[slot]
+                self._free_slot(slot)
+                req._finish(_FINISH_ERROR)
+                logger.warning(
+                    "lazy KV growth: no resumable preemption victim; "
+                    "fail-finished request %s to free pages",
+                    req.request_id,
+                )
+                continue
+            req = self._slots[victim]
+            if count_preempt is not None:
+                count_preempt()
+            self._free_slot(victim)
+            self._deferred.appendleft(req)
+            if self._tracer.enabled:
+                self._tracer.event(
+                    "sched.preempt", ctx=req.trace_ctx,
+                    attrs={
+                        "request_id": req.request_id,
+                        "committed_tokens": len(req.tokens),
+                    },
+                )
+            logger.info(
+                "preempted request %s (%d committed tokens) for KV page "
+                "pressure; it will resume suffix-only",
+                req.request_id, len(req.tokens),
+            )
 
     def _prefill_estimate_secs(self):
         """Observed mean prefill wall time — the admission-time lower
@@ -696,20 +796,48 @@ class ContinuousBatchingScheduler:
             # sweeps reach it — popped-but-unplaced requests would hang
             # their result() waiters forever
             self._slots[slot] = req
+            self._slot_admit_seq[slot] = self._admit_seq
+            self._admit_seq += 1
+            # a PREEMPTED request re-enters here with committed tokens in
+            # req.tokens: it resumes suffix-only — the effective prompt is
+            # everything already served (original prompt + committed
+            # tokens, whose full KV blocks were registered at park time,
+            # so the re-prefill mostly hits the prefix cache / host tier)
+            # and only the remaining generation budget is re-reserved
+            eff_prompt = list(req.prompt_tokens) + list(req.tokens)
+            eff_budget = max(1, int(req.max_new_tokens) - len(req.tokens))
             assign = getattr(self._engine, "assign_slot_adapter", None)
-            if assign is not None and not assign(
-                slot, getattr(req, "adapter", None)
-            ):
-                # the adapter was evicted between submit and slot join:
-                # fail the request loudly rather than decode it against
-                # the identity (or another tenant's) weights; the slot
-                # refills at the next step boundary
-                self._free_slot(slot)
-                req._finish(_FINISH_ERROR)
-                continue
+            if assign is not None:
+                try:
+                    joined = assign(slot, getattr(req, "adapter", None))
+                except AdapterPoolFull:
+                    # the adapter is parked in the host tier but every
+                    # pool row is pinned by live requests: defer exactly
+                    # like a KV page shortfall — a finishing request
+                    # unpins a row and the auto-load lands next step
+                    self._free_slot(slot)
+                    self._deferred.appendleft(req)
+                    if self._tracer.enabled:
+                        self._tracer.event(
+                            "sched.defer", ctx=req.trace_ctx,
+                            attrs={
+                                "request_id": req.request_id,
+                                "reason": "adapter_pool",
+                            },
+                        )
+                    break
+                if not joined:
+                    # the adapter was evicted between submit and slot
+                    # join (and is not recoverable from the host tier):
+                    # fail the request loudly rather than decode it
+                    # against the identity (or another tenant's) weights;
+                    # the slot refills at the next step boundary
+                    self._free_slot(slot)
+                    req._finish(_FINISH_ERROR)
+                    continue
             if reserve is not None:
                 try:
-                    reserve(slot, req.prompt_tokens, req.max_new_tokens)
+                    reserve(slot, eff_prompt, eff_budget)
                 except PoolExhausted:
                     # no pages right now: park the request at the head of
                     # the deferred line and stop admitting this step —
@@ -732,7 +860,7 @@ class ContinuousBatchingScheduler:
                 (t0 - req.submitted_at) * 1e3, trace_id=self._trace_id(req)
             )
             first = self._engine.prefill_request(
-                slot, req.prompt_tokens, req.temperature
+                slot, eff_prompt, req.temperature
             )
             now = time.monotonic()
             if self._tracer.enabled:
@@ -790,6 +918,7 @@ class ContinuousBatchingScheduler:
         # admittable in this same step
         self._expire_deadlines()
         self._admit()
+        self._ensure_decode_capacity()
         active = self.active_slots
         if not active:
             self._flush_rate()  # settle the window's tail tokens

@@ -127,6 +127,16 @@ KNOWN_FAULT_SITES = {
         "the receiver counts fleet/net_frames_corrupt and drops it; "
         "idempotent-RPC retry re-asks"
     ),
+    # -- host-memory spill tier (inference/host_tier.py,
+    # docs/inference.md "Host-memory spill tier") -----------------------
+    "host_tier.copy": (
+        "fault on the spill tier's D2H/H2D copy seam (args.mode: "
+        "oserror | garble). oserror raises OSError at the seam — a "
+        "spill is skipped or a promotion reads as a cold miss; garble "
+        "flips bytes in the parked host copy so the promotion-time "
+        "checksum drops the entry. Either way the engine re-prefills "
+        "from tokens: corrupt pages are never served"
+    ),
     # -- durable control plane (serving/journal.py, docs/serving.md
     # "Control-plane durability") ---------------------------------------
     "router.crash": (
@@ -151,7 +161,11 @@ _RAISES = {
     "replica.flap": RuntimeError,
     "router.place": RuntimeError,
     "conn.reset": ConnectionResetError,
+    "host_tier.copy": OSError,
 }
+
+# args.mode values the host_tier.copy site accepts (docs/resilience.md)
+HOST_TIER_FAULT_MODES = ("oserror", "garble")
 
 STALL_DURATION_MS_DEFAULT = 250.0
 

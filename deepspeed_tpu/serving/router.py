@@ -1897,6 +1897,26 @@ class FleetRouter:
                     snap.get("prefix_hits", 0)
                     + snap.get("prefix_misses", 0)
                 )
+            if "host_tier_occupancy_bytes" in snap:
+                # host-tier replicas mirror their spill-tier counters so
+                # the fleet view shows WHERE warm pages live (and whether
+                # peer promotion is actually saving prefill compute on
+                # the co-hosted replicas) without scraping each door
+                reg.gauge(f"{prefix}/host_tier_occupancy_bytes").set(
+                    snap.get("host_tier_occupancy_bytes", 0)
+                )
+                reg.gauge(f"{prefix}/host_tier_spills").set(
+                    snap.get("host_tier_spills", 0)
+                )
+                reg.gauge(f"{prefix}/host_tier_promotions").set(
+                    snap.get("host_tier_promotions", 0)
+                )
+                reg.gauge(f"{prefix}/host_tier_peer_fetches").set(
+                    snap.get("host_tier_peer_fetches", 0)
+                )
+                reg.gauge(f"{prefix}/host_tier_preemptions").set(
+                    snap.get("host_tier_preemptions", 0)
+                )
             if "adapters_loaded" in snap:
                 # multi-LoRA replicas report their resident adapters
                 # — the per-replica gauge adapter-affinity placement

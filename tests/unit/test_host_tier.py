@@ -1,0 +1,561 @@
+"""Host-memory spill tier (docs/inference.md "Host-memory spill tier"):
+HostTier unit behavior (bitwise roundtrip, byte-budget LRU, checksum
+drops, share-group refcounts), the BlockPool/AdapterPool spill seams
+under threaded eviction-vs-acquire stress, and the engine-level pins —
+D2H→H2D page promotion bitwise parity, peer warming across co-hosted
+engines, preempt-park-resume exactness under lazy page growth, adapter
+auto-load with generation restore, and ``host_tier.copy`` chaos
+absorption (corrupt promotion re-prefills, never serves wrong pages)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.adapters import init_lora_params
+from deepspeed_tpu.adapters.pool import AdapterPool, AdapterPoolFull
+from deepspeed_tpu.inference import BlockPool, HostTier
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+VOCAB = 97
+
+
+def _small_model(seed=0, **kw):
+    cfg = GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        dropout=0.0, use_flash=False, **kw,
+    )
+    model = GPT2LMHeadModel(cfg)
+    ids0 = jnp.asarray(
+        np.random.default_rng(seed).integers(0, VOCAB, (1, 8)), jnp.int32
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(seed),
+         "dropout": jax.random.PRNGKey(seed + 1)},
+        ids0, ids0,
+    )["params"]
+    return cfg, model, params
+
+
+def _prompt(n=8, seed=1):
+    return [int(t) for t in np.random.default_rng(seed).integers(0, VOCAB, n)]
+
+
+def _engine(model, params, inference=None, adapters=None, resilience=None):
+    block = {"max_batch_slots": 4, "max_seq_len": 48, "prefill_len": 32,
+             "kv_block_size": 8, "sampling": {"greedy": True}}
+    block.update(inference or {})
+    if block.get("kv_block_size") == 0:
+        block.pop("kv_block_size")
+    config = {"inference": block}
+    if adapters is not None:
+        ad = {"enabled": True, "rank": 2, "pool_slots": 4}
+        ad.update(adapters)
+        config["adapters"] = ad
+    if resilience is not None:
+        config["resilience"] = resilience
+    return deepspeed_tpu.init_inference(
+        model=model, model_parameters=params, config=config,
+    )
+
+
+def _tier_block(group, **kw):
+    ht = {"enabled": True, "share_group": group}
+    ht.update(kw)
+    return ht
+
+
+def _synth_adapter(params, seed, rank=2, scale=0.2):
+    ada = init_lora_params(
+        jax.tree_util.tree_map(np.asarray, params), rank,
+        rng=jax.random.PRNGKey(seed),
+    )
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(
+            jax.random.normal(
+                jax.random.fold_in(jax.random.PRNGKey(seed), a.size),
+                a.shape,
+            ) * scale,
+            np.float32,
+        ),
+        ada,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HostTier: the tier itself (jax-free)
+# ---------------------------------------------------------------------------
+def test_tier_roundtrip_bitwise_with_meta_and_origin():
+    tier = HostTier(max_bytes=1 << 20)
+    k = np.random.default_rng(0).random((2, 8, 4, 8), np.float32)
+    v = np.random.default_rng(1).random((2, 8, 4, 8), np.float32)
+    assert tier.put("h1", (k, v), meta={"kind": "kv"}, origin="engine-a")
+    assert tier.contains("h1") and tier.entries == 1
+    assert tier.occupancy_bytes == k.nbytes + v.nbytes
+    placed, meta, origin = tier.fetch("h1", requester="engine-b")
+    np.testing.assert_array_equal(placed[0], k)
+    np.testing.assert_array_equal(placed[1], v)
+    assert meta == {"kind": "kv"} and origin == "engine-a"
+    assert tier.promotions == 1 and tier.peer_fetches == 1
+    # same-origin fetch is NOT a peer fetch
+    tier.fetch("h1", requester="engine-a")
+    assert tier.peer_fetches == 1
+    tier.close()
+
+
+def test_tier_byte_budget_evicts_lru_first_injectable_clock():
+    clock = [0.0]
+    tier = HostTier(max_bytes=3 * 1024, clock=lambda: clock[0])
+    page = np.zeros(256, np.float32)  # 1 KiB each
+    for i, key in enumerate(("a", "b", "c")):
+        clock[0] = float(i)
+        assert tier.put(key, (page,))
+    clock[0] = 10.0
+    tier.fetch("a")  # refresh a's recency: b is now the LRU victim
+    clock[0] = 11.0
+    assert tier.put("d", (page,))
+    assert tier.entries == 3 and tier.evictions == 1
+    assert not tier.contains("b")
+    assert tier.contains("a") and tier.contains("c") and tier.contains("d")
+    tier.close()
+
+
+def test_tier_pinned_entry_survives_budget_pressure():
+    tier = HostTier(max_bytes=1024)
+    page = np.zeros(256, np.float32)
+    assert tier.put("pinned", (page,))
+    handle = tier.fetch_async("pinned")  # pin without consuming
+    assert tier.put("next", (page,))  # over budget, but "pinned" is pinned
+    assert tier.contains("pinned")
+    handle.result()  # placement done: unpinned
+    assert tier.put("more", (page,))
+    assert not tier.contains("pinned")  # now evictable, and evicted
+    tier.close()
+
+
+def test_tier_oversize_entry_rejected_outright():
+    tier = HostTier(max_bytes=64)
+    assert not tier.put("big", (np.zeros(1024, np.float32),))
+    assert tier.entries == 0 and tier.spills == 0
+    tier.close()
+
+
+def test_tier_corrupt_entry_drops_at_fetch_as_cold_miss():
+    """The chaos-garble (and real bit-rot) contract: the digest is
+    computed over the CLEAN payload, the stored copy is mangled, and the
+    promotion-time verify drops the entry — a corrupt page can only ever
+    read as a miss, never be served."""
+    tier = HostTier(max_bytes=1 << 20)
+    page = np.arange(64, dtype=np.float32)
+    assert tier.put("bad", (page,), corrupt=True)
+    assert tier.contains("bad")
+    assert tier.fetch_async("bad") is None
+    assert tier.checksum_drops == 1 and not tier.contains("bad")
+    assert tier.fetch("bad") is None  # stays a miss
+    tier.close()
+
+
+def test_tier_shared_group_identity_and_refcount_retirement():
+    a = HostTier.shared("t-group-x", max_bytes=1 << 16).retain()
+    b = HostTier.shared("t-group-x").retain()
+    assert a is b
+    assert HostTier.shared("t-group-y") is not a
+    a.put("k", (np.zeros(8, np.float32),))
+    a.release()
+    assert b.contains("k")  # one ref left: still open
+    b.release()
+    # last release retired the group: a NEW tier, no leaked entries
+    fresh = HostTier.shared("t-group-x").retain()
+    try:
+        assert fresh is not a and not fresh.contains("k")
+    finally:
+        fresh.release()
+
+
+def test_tier_snapshot_counts():
+    tier = HostTier(max_bytes=1 << 20)
+    tier.put("a", (np.zeros(16, np.float32),), origin="e1")
+    tier.fetch("a", requester="e2")
+    snap = tier.snapshot()
+    assert snap["entries"] == 1 and snap["spills"] == 1
+    assert snap["promotions"] == 1 and snap["peer_fetches"] == 1
+    assert snap["occupancy_bytes"] == 64
+    tier.close()
+
+
+# ---------------------------------------------------------------------------
+# BlockPool spill seam
+# ---------------------------------------------------------------------------
+def test_block_pool_spill_fn_fires_on_eviction_with_hash():
+    spilled = []
+    pool = BlockPool(4, block_size=4, spill_fn=lambda b, h: spilled.append((b, h)))
+    prompt = list(range(9))  # 2 full pages + tail
+    blocks = pool.alloc(3)
+    pool.register_prefix(prompt, blocks)
+    pool.release(blocks)
+    assert pool.cached_blocks == 2 and not spilled  # parked, not evicted
+    pool.alloc(4)  # pressure: both cached pages evict -> spill first
+    assert [b for b, _ in spilled] == blocks[:2]
+    assert all(isinstance(h, str) and h for _, h in spilled)
+    assert pool.reclaimed == 2 and pool.spill_errors == 0
+
+
+def test_block_pool_spill_fn_failure_never_blocks_eviction():
+    def boom(b, h):
+        raise OSError("D2H copy failed")
+    pool = BlockPool(2, block_size=4, spill_fn=boom)
+    blocks = pool.alloc(2)
+    pool.register_prefix(list(range(9)), blocks)
+    pool.release(blocks)
+    got = pool.alloc(2)  # eviction proceeds despite the failing spill
+    assert len(got) == 2 and pool.spill_errors == 2
+
+
+def test_threaded_eviction_vs_acquire_stress():
+    """The PR's concurrency pin: BlockPool eviction (with a spill
+    callback writing into a shared HostTier) racing prefix acquires on
+    other threads, and AdapterPool assign/acquire/release churn against
+    the same tier — refcount exactness and tier-internal locking must
+    hold with no exceptions and no lost pages."""
+    clock = [0.0]
+    tier = HostTier(max_bytes=1 << 22, clock=lambda: clock[0])
+    pool = BlockPool(
+        16, block_size=4,
+        spill_fn=lambda b, h: tier.put(h, (np.full(8, b, np.float32),)),
+    )
+    apool = AdapterPool(3)
+    pool_lock = threading.Lock()  # BlockPool is single-driver by contract
+    errors = []
+
+    def kv_churn(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(150):
+                prompt = [int(t) for t in rng.integers(0, 50, 9)]
+                with pool_lock:
+                    try:
+                        blocks = pool.alloc(3)
+                    except Exception:
+                        continue  # transient exhaustion: racing churn
+                    _plen, shared = pool.match_prefix(prompt)
+                    pool.register_prefix(prompt, blocks)
+                    pool.release(blocks)
+                    if shared:
+                        pool.release(shared)
+        except Exception as exc:  # pragma: no cover - the assertion
+            errors.append(exc)
+
+    def adapter_churn(seed):
+        rng = np.random.default_rng(seed)
+        names = [f"t{j}" for j in range(5)]
+        try:
+            for i in range(200):
+                name = names[int(rng.integers(0, len(names)))]
+                op = int(rng.integers(0, 3))
+                if op == 0:
+                    try:
+                        idx, evicted = apool.assign(name)
+                        if evicted is not None:
+                            tier.put(
+                                f"adapter/{evicted}",
+                                (np.zeros(16, np.float32),),
+                            )
+                    except AdapterPoolFull:
+                        pass
+                elif op == 1:
+                    try:
+                        apool.acquire(name)
+                        apool.release(name)
+                    except KeyError:
+                        pass
+                else:
+                    tier.fetch(f"adapter/{name}", timeout=5.0)
+        except Exception as exc:  # pragma: no cover - the assertion
+            errors.append(exc)
+
+    threads = (
+        [threading.Thread(target=kv_churn, args=(s,)) for s in range(2)]
+        + [threading.Thread(target=adapter_churn, args=(s,)) for s in range(2)]
+    )
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    assert pool.used_blocks == 0  # every alloc was released
+    for name in apool.loaded:
+        assert apool.active_count(name) == 0
+    assert tier.occupancy_bytes <= tier.max_bytes
+    tier.close()
+
+
+# ---------------------------------------------------------------------------
+# engine-level pins
+# ---------------------------------------------------------------------------
+def test_kv_spill_promote_bitwise_roundtrip():
+    """The tentpole's correctness pin: evicted prefix pages park D2H,
+    a chain-hash hit promotes them H2D into fresh private pages, and
+    decode over promoted pages is BITWISE identical to the first
+    (cold-prefilled) serve."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {
+        "kv_pool_blocks": 6, "host_tier": _tier_block("rt-g"),
+    })
+    try:
+        shared = _prompt(16, 7)
+        out1 = engine.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        assert engine.block_pool.cached_blocks == 2
+        rs = [engine.submit(_prompt(8, 20 + i), max_new_tokens=8)
+              for i in range(3)]
+        engine.scheduler.run_until_idle()
+        assert all(len(r.result(0)) == 8 for r in rs)
+        snap = engine.kv_snapshot()
+        assert snap["host_tier_spills"] >= 2
+        assert engine.host_tier.entries >= 2
+        out2 = engine.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        snap2 = engine.kv_snapshot()
+        assert snap2["host_tier_promotions"] >= 1
+        assert out2 == out1
+        # tier metrics surfaced through the router-facing load snapshot
+        load = engine.load_snapshot()
+        assert load["host_tier_occupancy_bytes"] > 0
+    finally:
+        engine.close()
+
+
+def test_peer_promotion_warms_cohosted_engine():
+    """Peer sharing: two engines in one share group (the node agent's
+    in-process replicas); replica A's evicted template pages serve
+    replica B's FIRST templated request as a peer-promoted hit, bitwise
+    equal to A's output."""
+    cfg, model, params = _small_model()
+    a = _engine(model, params, {
+        "kv_pool_blocks": 6, "host_tier": _tier_block("peer-g"),
+    })
+    b = _engine(model, params, {
+        "kv_pool_blocks": 6, "host_tier": _tier_block("peer-g"),
+    })
+    try:
+        assert a.host_tier is b.host_tier
+        shared = _prompt(16, 7)
+        out_a = a.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        rs = [a.submit(_prompt(8, 40 + i), max_new_tokens=8)
+              for i in range(3)]
+        a.scheduler.run_until_idle()
+        assert all(len(r.result(0)) == 8 for r in rs)
+        assert a.kv_snapshot()["host_tier_spills"] >= 2
+        out_b = b.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        sb = b.kv_snapshot()
+        assert sb["host_tier_peer_fetches"] >= 1
+        assert sb["prefix_hits"] >= 1  # promoted pages count as a HIT
+        assert out_b == out_a
+    finally:
+        a.close()
+        b.close()
+    # the last close retired the share group
+    fresh = HostTier.shared("peer-g").retain()
+    try:
+        assert fresh.entries == 0
+    finally:
+        fresh.release()
+
+
+def test_preempt_park_resume_bitwise_exactness():
+    """Lazy page growth: admission reserves only the prompt's pages;
+    decode-time growth preempts the most recently admitted request when
+    the pool runs dry. The preempted request's pages park (spillable to
+    host), it re-enters the deferred line, resumes suffix-only, and
+    EVERY request completes bitwise-identical to an unpressured run."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {
+        "kv_pool_blocks": 4, "max_batch_slots": 2,
+        "host_tier": _tier_block("lazy-g", lazy_alloc=True),
+    })
+    ref = _engine(model, params, {
+        "kv_pool_blocks": 12, "max_batch_slots": 2,
+    })
+    try:
+        prompts = [_prompt(8, 60), _prompt(8, 61)]
+        # worst case is 3 pages each (6 > 4): the old reservation could
+        # never co-admit these; lazy admission runs them concurrently
+        # and preempts when growth exhausts the pool
+        rs = [engine.submit(p, max_new_tokens=16) for p in prompts]
+        engine.scheduler.run_until_idle()
+        outs = [r.result(0) for r in rs]
+        assert all(len(o) == 16 for o in outs)  # zero requests lost
+        snap = engine.kv_snapshot()
+        assert snap["host_tier_preemptions"] >= 1
+        cold = [ref.generate([p], max_new_tokens=16)[0] for p in prompts]
+        assert outs == cold
+    finally:
+        engine.close()
+        ref.close()
+
+
+def test_adapter_spill_and_auto_load_with_generation_restore():
+    """S-LoRA host paging: an adapter evicted by pool pressure parks its
+    rows in the tier; a later submit for the known-but-not-resident name
+    auto-loads it (same weights, ORIGINAL generation — its salted prefix
+    pages stay valid) and serves bitwise vs an always-resident engine."""
+    cfg, model, params = _small_model()
+    sa, sb, sc = (_synth_adapter(params, s) for s in (1, 2, 3))
+    engine = _engine(
+        model, params,
+        {"prefill_len": 16, "host_tier": _tier_block("ad-g")},
+        adapters={"pool_slots": 2},
+    )
+    ref = _engine(model, params, {"prefill_len": 16},
+                  adapters={"pool_slots": 2})
+    try:
+        engine.load_adapter("a", adapter_state=sa)
+        engine.load_adapter("b", adapter_state=sb)
+        gen_b = engine.adapter_registry.generation_of("b")
+        # serve one request against "a": it becomes the most recently
+        # used, so loading "c" under pool pressure evicts idle "b"
+        engine.generate([_prompt(6, 4)], max_new_tokens=2, adapter="a")
+        engine.load_adapter("c", adapter_state=sc)
+        assert "b" not in engine.adapter_registry.loaded
+        assert engine.host_tier.contains("adapter/b")
+        out = engine.generate([_prompt(6, 5)], max_new_tokens=6,
+                              adapter="b")[0]
+        assert "b" in engine.adapter_registry.loaded
+        assert engine.adapter_registry.generation_of("b") == gen_b
+        assert engine.kv_snapshot()["host_tier_promotions"] >= 1
+        ref.load_adapter("b", adapter_state=sb)
+        assert out == ref.generate([_prompt(6, 5)], max_new_tokens=6,
+                                   adapter="b")[0]
+        # explicit unload is intentional removal: the tier copy drops
+        # too, so the name cannot silently resurrect
+        engine.unload_adapter("c")
+        assert not engine.host_tier.contains("adapter/c")
+        with pytest.raises(ValueError, match="not loaded"):
+            engine.generate([_prompt(6, 5)], max_new_tokens=2, adapter="c")
+    finally:
+        engine.close()
+        ref.close()
+
+
+def test_host_tier_copy_fault_oserror_drops_spill_cold_path_serves():
+    """Chaos site ``host_tier.copy`` (oserror mode): the D2H spill is
+    skipped — the page simply drops as without the tier — and serving
+    continues correct; the fault is counted."""
+    cfg, model, params = _small_model()
+    engine = _engine(
+        model, params,
+        {"kv_pool_blocks": 6, "host_tier": _tier_block("f1-g")},
+        resilience={"fault_injection": {
+            "enabled": True,
+            "faults": [{"site": "host_tier.copy", "times": 2,
+                        "args": {"mode": "oserror"}}],
+        }},
+    )
+    try:
+        shared = _prompt(16, 7)
+        out1 = engine.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        rs = [engine.submit(_prompt(8, 20 + i), max_new_tokens=8)
+              for i in range(3)]
+        engine.scheduler.run_until_idle()
+        [r.result(0) for r in rs]
+        snap = engine.kv_snapshot()
+        assert snap["host_tier_copy_faults"] == 2
+        assert snap["host_tier_spills"] == 0  # both spills skipped
+        assert engine.host_tier.entries == 0
+        # the template re-serves correct via the cold path
+        out2 = engine.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        assert out2 == out1
+    finally:
+        engine.close()
+
+
+def test_host_tier_copy_fault_garble_checksum_drop_reprefills():
+    """Chaos site ``host_tier.copy`` (garble mode): the spilled payload
+    is corrupted AFTER the digest — the promotion-time checksum drops
+    the entry, the request re-prefills cold, and output stays bitwise
+    correct. Corrupt pages are never served."""
+    cfg, model, params = _small_model()
+    engine = _engine(
+        model, params,
+        {"kv_pool_blocks": 6, "host_tier": _tier_block("f2-g")},
+        resilience={"fault_injection": {
+            "enabled": True,
+            "faults": [{"site": "host_tier.copy", "times": 2,
+                        "args": {"mode": "garble"}}],
+        }},
+    )
+    try:
+        shared = _prompt(16, 7)
+        out1 = engine.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        rs = [engine.submit(_prompt(8, 20 + i), max_new_tokens=8)
+              for i in range(3)]
+        engine.scheduler.run_until_idle()
+        [r.result(0) for r in rs]
+        snap = engine.kv_snapshot()
+        assert snap["host_tier_copy_faults"] == 2
+        assert snap["host_tier_spills"] == 2  # stored, but garbled
+        out2 = engine.generate([shared + _prompt(4, 8)], max_new_tokens=4)[0]
+        assert out2 == out1  # re-prefilled, never served the garble
+        assert engine.host_tier.checksum_drops >= 1
+        assert engine.host_tier.entries <= 1  # corrupt entries dropped
+    finally:
+        engine.close()
+
+
+def test_decode_pages_register_as_shareable_prefixes():
+    """Decode-page chain hashing: full blocks COMPLETED DURING DECODE
+    register at release, so a generated continuation is shareable — a
+    re-submit of prompt+continuation prefix-hits instead of recomputing
+    it."""
+    cfg, model, params = _small_model()
+    engine = _engine(model, params, {"kv_pool_blocks": 8})
+    try:
+        prompt = _prompt(8, 3)  # 1 full page
+        out = engine.generate([prompt], max_new_tokens=10)[0]
+        # prompt (8) + committed-kv tokens: full blocks beyond the
+        # prompt's single page came from DECODE
+        assert engine.block_pool.cached_blocks >= 2
+        snap0 = engine.metrics.snapshot()
+        follow = (prompt + out)[:16] + _prompt(4, 44)
+        engine.generate([follow], max_new_tokens=2)
+        snap1 = engine.metrics.snapshot()
+        assert snap1["infer/prefix_hits"] == snap0["infer/prefix_hits"] + 1
+    finally:
+        engine.close()
+
+
+def test_config_validation_matrix():
+    from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+
+    def build(ht, adapters=None, kv_block_size=8):
+        inf = {"max_batch_slots": 2, "max_seq_len": 32, "prefill_len": 16,
+               "host_tier": ht}
+        if kv_block_size:
+            inf["kv_block_size"] = kv_block_size
+        cfg = {"train_micro_batch_size_per_gpu": 1, "inference": inf}
+        if adapters:
+            cfg["adapters"] = adapters
+        return DeepSpeedConfig(None, param_dict=cfg)
+
+    cfg = build({"enabled": True, "max_bytes": 1024, "lazy_alloc": True})
+    assert cfg.inference_host_tier_enabled
+    assert cfg.inference_host_tier_max_bytes == 1024
+    assert cfg.inference_host_tier_lazy_alloc
+    assert cfg.inference_host_tier_share_group == "node"
+    with pytest.raises(DeepSpeedConfigError, match="unknown"):
+        build({"enabled": True, "max_byte": 1024})
+    with pytest.raises(DeepSpeedConfigError, match="max_bytes"):
+        build({"enabled": True, "max_bytes": 0})
+    with pytest.raises(DeepSpeedConfigError, match="share_group"):
+        build({"enabled": True, "share_group": ""})
+    with pytest.raises(DeepSpeedConfigError, match="nothing to spill"):
+        build({"enabled": True}, kv_block_size=0)
+    # adapters alone are a valid reason for the tier (contiguous cache)
+    assert build(
+        {"enabled": True}, kv_block_size=0,
+        adapters={"enabled": True, "rank": 2},
+    ).inference_host_tier_enabled
+    with pytest.raises(DeepSpeedConfigError, match="lazy_alloc"):
+        build({"enabled": False, "lazy_alloc": True})
