@@ -2632,6 +2632,197 @@ def smoke_chaos_net():
     }))
 
 
+def smoke_node_failover():
+    """CI fast path (``python bench.py --smoke-node-failover``): the
+    whole-node failure domain end to end (docs/serving.md "Node failure
+    domain"). One real-TCP fleet, three acts:
+
+      A. Node failover under mixed-tenant traffic: two provisioner-
+         launched stub nodes; one SIGKILLed with requests in flight.
+         Every request completes exactly once (re-routed, never
+         duplicated, never lost) and the dead node's replica is evicted.
+      B. Capacity restoration: the autoscaler's REPROVISION escalates to
+         the node tier — the provisioner re-launches the dead node under
+         its own name and a replacement replica rejoins; traffic flows
+         across the restored fleet.
+      C. Stale-router drill: a deliberately "restarted" stale router
+         incarnation (epoch - 1) is rejected by BOTH live nodes with the
+         typed FencedOut — control dial and data-plane session alike —
+         while the live router keeps serving, undisturbed.
+
+    Prints one JSON line and exits non-zero on any failed check."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from deepspeed_tpu.serving import (
+        Autoscaler,
+        FencedOut,
+        FleetRouter,
+        LocalSubprocessProvisioner,
+        SocketNodeProvider,
+        SocketReplica,
+    )
+    from deepspeed_tpu.serving.transport import NodeControlClient
+    from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+    extras = {}
+    epoch = 3
+    template = {
+        "replicas": {"r0": {"stub": {"delay_secs": 0.5}}},
+        "lease_secs": 10.0,
+        "resume_grace_secs": 10.0,
+    }
+    reg = MetricsRegistry()
+    prov = LocalSubprocessProvisioner(
+        template, launch_timeout=60.0, epoch=epoch, registry=reg,
+    )
+    router = None
+    try:
+        h0 = prov.launch_node("n0")
+        h1 = prov.launch_node("n1")
+        nodes = {
+            "n0": {"address": h0.address, "replicas": ["r0"]},
+            "n1": {"address": h1.address, "replicas": ["r0"]},
+        }
+        provider = SocketNodeProvider(
+            nodes, rpc_timeout=1.0, reconnect_attempts=2,
+            reconnect_backoff_secs=0.05, registry=reg, epoch=epoch,
+            provisioner=prov, max_replicas_per_node=1, max_nodes=2,
+            node_retry_secs=5.0, spawn_timeout=60.0,
+        )
+        scaler = Autoscaler(
+            provider, min_replicas=2, max_replicas=2, cooldown_secs=0.05,
+            hysteresis_secs=0.0, flap_budget=100, interval_secs=0.05,
+            drain_timeout_secs=5.0,
+        )
+        r0 = SocketReplica(
+            "n0:r0", h0.address, remote_name="r0", rpc_timeout=1.0,
+            reconnect_attempts=2, reconnect_backoff_secs=0.05,
+            registry=reg, epoch=epoch,
+        )
+        r1 = SocketReplica(
+            "n1:r0", h1.address, remote_name="r0", rpc_timeout=1.0,
+            registry=reg, epoch=epoch,
+        )
+        router = FleetRouter(
+            [r0, r1], registry=reg, placement="round_robin",
+            monitor_interval=0.02, telemetry_refresh_secs=3600.0,
+            breaker_failure_threshold=1, breaker_backoff_secs=0.2,
+            autoscaler=scaler,
+        ).start()
+
+        # ---- act A: SIGKILL one node mid-traffic ----------------------
+        t0 = time.monotonic()
+        # round-robin: even requests land on n0, odd on n1; the stub's
+        # completion delay keeps n0's share IN FLIGHT when it dies
+        reqs = [
+            router.submit([40 + i], tenant=f"tenant-{i % 3}",
+                          max_new_tokens=3)
+            for i in range(8)
+        ]
+        h0.proc.kill()
+        outs = [r.result(120.0) for r in reqs]
+        failover = time.monotonic() - t0
+        for i, out in enumerate(outs):
+            base = 40 + i
+            assert out == [(base + j + 1) % 1000 for j in range(3)], (
+                i, out,
+            )
+        assert all(r.finish_reason == "max_new_tokens" for r in reqs)
+        snap = reg.snapshot()
+        assert snap["fleet/requests_completed"] == 8, snap
+        assert any(r.reroutes >= 1 for r in reqs), (
+            "the killed node's in-flight requests never re-routed"
+        )
+        assert "n0:r0" in router.evicted_ids, (
+            "the dead node's replica was never evicted"
+        )
+        extras["failover_secs"] = round(failover, 2)
+        extras["failover_reroutes"] = int(snap["fleet/requests_rerouted"])
+
+        # ---- act B: the provisioner restores whole-node capacity ------
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            if len(router.live_replica_ids()) >= 2:
+                break
+            time.sleep(0.05)
+        live = router.live_replica_ids()
+        assert len(live) >= 2, f"capacity never restored: {live}"
+        assert any(str(rid).startswith("n0:") for rid in live), (
+            "the replacement replica did not rejoin on the "
+            f"re-provisioned node: {live}"
+        )
+        assert "n0" in prov.list_nodes() and prov.list_nodes()["n0"].alive
+        snap = reg.snapshot()
+        assert snap["fleet/nodes_provisioned"] >= 3, snap  # n0, n1, n0'
+        reqs2 = [
+            router.submit([80 + i], tenant=f"tenant-{i % 3}",
+                          max_new_tokens=2)
+            for i in range(4)
+        ]
+        outs2 = [r.result(60.0) for r in reqs2]
+        for i, out in enumerate(outs2):
+            base = 80 + i
+            assert out == [(base + j + 1) % 1000 for j in range(2)], (
+                i, out,
+            )
+        extras["nodes_provisioned"] = int(snap["fleet/nodes_provisioned"])
+
+        # ---- act C: the stale-router drill ----------------------------
+        # a "restarted" stale incarnation presents epoch - 1 to both
+        # live nodes: control dial and data-plane hello alike must be
+        # rejected with the typed FencedOut, and neither may retry
+        live_addresses = {
+            name: handle.address
+            for name, handle in prov.list_nodes().items()
+        }
+        assert sorted(live_addresses) == ["n0", "n1"], live_addresses
+        fenced_ctl = 0
+        for name in sorted(live_addresses):
+            try:
+                NodeControlClient(
+                    live_addresses[name], connect_timeout=5.0,
+                    op_timeout=5.0, epoch=epoch - 1,
+                ).node_info()
+            except FencedOut as e:
+                assert e.high_water >= epoch, (name, e.high_water)
+                fenced_ctl += 1
+        assert fenced_ctl == 2, (
+            f"only {fenced_ctl}/2 nodes fenced the stale control dial"
+        )
+        stale = SocketReplica(
+            "stale:r0", live_addresses["n1"], remote_name="r0",
+            rpc_timeout=1.0, registry=MetricsRegistry(), epoch=epoch - 1,
+        )
+        try:
+            stale.start()
+            fenced_data = False
+        except FencedOut:
+            fenced_data = True
+        finally:
+            stale.shutdown()
+        assert fenced_data, (
+            "the stale data-plane session was admitted, not fenced"
+        )
+        # the live router rode through the drill undisturbed
+        assert not router.fenced
+        req = router.submit([200], max_new_tokens=2)
+        assert req.result(60.0) == [201, 202]
+        snap = reg.snapshot()
+        assert snap["fleet/requests_completed"] == 13, snap
+        extras["fenced_nodes"] = fenced_ctl
+    finally:
+        if router is not None:
+            router.shutdown()
+        prov.close()
+
+    print(json.dumps({
+        "metric": "smoke_node_failover",
+        "value": 1.0,
+        "unit": "ok",
+        "vs_baseline": 1.0,
+        "extras": extras,
+    }))
+
+
 def _router_failover_child():
     """Hidden child entry for ``--smoke-router-failover``: build the
     journal-armed socket fleet through the REAL production path
@@ -3883,6 +4074,9 @@ def main():
         return
     if "--smoke-chaos-net" in sys.argv:
         smoke_chaos_net()
+        return
+    if "--smoke-node-failover" in sys.argv:
+        smoke_node_failover()
         return
     if "--smoke-autoscale" in sys.argv:
         smoke_autoscale()

@@ -868,6 +868,31 @@ class DeepSpeedConfig:
             jrn_dict, C.SERVING_JOURNAL_MAX_INFLIGHT,
             C.SERVING_JOURNAL_MAX_INFLIGHT_DEFAULT,
         )
+        prov_dict = get_dict_param(srv_dict, C.SERVING_PROVISIONER)
+        self.serving_provisioner_enabled = get_scalar_param(
+            prov_dict, C.SERVING_PROVISIONER_ENABLED,
+            C.SERVING_PROVISIONER_ENABLED_DEFAULT,
+        )
+        self.serving_provisioner_node_spec = get_scalar_param(
+            prov_dict, C.SERVING_PROVISIONER_NODE_SPEC,
+            C.SERVING_PROVISIONER_NODE_SPEC_DEFAULT,
+        )
+        self.serving_provisioner_max_nodes = get_scalar_param(
+            prov_dict, C.SERVING_PROVISIONER_MAX_NODES,
+            C.SERVING_PROVISIONER_MAX_NODES_DEFAULT,
+        )
+        self.serving_provisioner_max_replicas_per_node = get_scalar_param(
+            prov_dict, C.SERVING_PROVISIONER_MAX_REPLICAS_PER_NODE,
+            C.SERVING_PROVISIONER_MAX_REPLICAS_PER_NODE_DEFAULT,
+        )
+        self.serving_provisioner_launch_timeout_secs = get_scalar_param(
+            prov_dict, C.SERVING_PROVISIONER_LAUNCH_TIMEOUT_SECS,
+            C.SERVING_PROVISIONER_LAUNCH_TIMEOUT_SECS_DEFAULT,
+        )
+        self.serving_provisioner_terminate_grace_secs = get_scalar_param(
+            prov_dict, C.SERVING_PROVISIONER_TERMINATE_GRACE_SECS,
+            C.SERVING_PROVISIONER_TERMINATE_GRACE_SECS_DEFAULT,
+        )
 
         # mesh block (TPU-native)
         mesh_dict = get_dict_param(pd, C.MESH)
@@ -2470,6 +2495,62 @@ class DeepSpeedConfig:
             ):
                 raise DeepSpeedConfigError(
                     f"{jr}.{key} must be an integer >= 1, got {value!r}"
+                )
+        pr = f"{C.SERVING}.{C.SERVING_PROVISIONER}"
+        prov_dict = get_dict_param(
+            get_dict_param(self._param_dict, C.SERVING),
+            C.SERVING_PROVISIONER,
+        )
+        valid_prov = {
+            C.SERVING_PROVISIONER_ENABLED,
+            C.SERVING_PROVISIONER_NODE_SPEC,
+            C.SERVING_PROVISIONER_MAX_NODES,
+            C.SERVING_PROVISIONER_MAX_REPLICAS_PER_NODE,
+            C.SERVING_PROVISIONER_LAUNCH_TIMEOUT_SECS,
+            C.SERVING_PROVISIONER_TERMINATE_GRACE_SECS,
+        }
+        unknown = set(prov_dict) - valid_prov
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"{pr}: unknown keys {sorted(unknown)}; valid: "
+                f"{sorted(valid_prov)}"
+            )
+        if not isinstance(self.serving_provisioner_enabled, bool):
+            raise DeepSpeedConfigError(
+                f"{pr}.{C.SERVING_PROVISIONER_ENABLED} must be a "
+                f"boolean, got {self.serving_provisioner_enabled!r}"
+            )
+        spec = self.serving_provisioner_node_spec
+        if spec is not None and not isinstance(spec, dict):
+            raise DeepSpeedConfigError(
+                f"{pr}.{C.SERVING_PROVISIONER_NODE_SPEC} must be a "
+                f"node.py spec object (or null), got {spec!r}"
+            )
+        for key, value in (
+            (C.SERVING_PROVISIONER_MAX_NODES,
+             self.serving_provisioner_max_nodes),
+            (C.SERVING_PROVISIONER_MAX_REPLICAS_PER_NODE,
+             self.serving_provisioner_max_replicas_per_node),
+        ):
+            if (
+                not isinstance(value, int) or isinstance(value, bool)
+                or value < 1
+            ):
+                raise DeepSpeedConfigError(
+                    f"{pr}.{key} must be an integer >= 1, got {value!r}"
+                )
+        for key, value in (
+            (C.SERVING_PROVISIONER_LAUNCH_TIMEOUT_SECS,
+             self.serving_provisioner_launch_timeout_secs),
+            (C.SERVING_PROVISIONER_TERMINATE_GRACE_SECS,
+             self.serving_provisioner_terminate_grace_secs),
+        ):
+            if (
+                not isinstance(value, (int, float))
+                or isinstance(value, bool) or value <= 0
+            ):
+                raise DeepSpeedConfigError(
+                    f"{pr}.{key} must be a positive number, got {value!r}"
                 )
 
     def _do_warning_check(self):

@@ -941,6 +941,30 @@ SERVING_JOURNAL_KEEP_SEGMENTS_DEFAULT = 3
 SERVING_JOURNAL_MAX_INFLIGHT = "max_inflight"
 SERVING_JOURNAL_MAX_INFLIGHT_DEFAULT = 256
 
+# "provisioner": the whole-node lifecycle tier (serving/provisioner.py,
+# docs/serving.md "Node failure domain"). Enabled gives the autoscaler's
+# socket backend a node tier: a replica target past every live node's
+# ceiling launches a NEW node agent (local subprocess), a dead node is
+# re-provisioned under the same name, and a provisioner-owned node left
+# empty by scale-down is terminated whole. Disabled (the default) =
+# today's behavior: the nodes map IS the fleet; zero placeable capacity
+# raises a typed refusal instead.
+SERVING_PROVISIONER = "provisioner"
+SERVING_PROVISIONER_ENABLED = "enabled"
+SERVING_PROVISIONER_ENABLED_DEFAULT = False
+# node.py spec template each launch instantiates (node_id is forced to
+# the requested name; engines/replicas come from this template)
+SERVING_PROVISIONER_NODE_SPEC = "node_spec"
+SERVING_PROVISIONER_NODE_SPEC_DEFAULT = None
+SERVING_PROVISIONER_MAX_NODES = "max_nodes"
+SERVING_PROVISIONER_MAX_NODES_DEFAULT = 4
+SERVING_PROVISIONER_MAX_REPLICAS_PER_NODE = "max_replicas_per_node"
+SERVING_PROVISIONER_MAX_REPLICAS_PER_NODE_DEFAULT = 4
+SERVING_PROVISIONER_LAUNCH_TIMEOUT_SECS = "launch_timeout_secs"
+SERVING_PROVISIONER_LAUNCH_TIMEOUT_SECS_DEFAULT = 120.0
+SERVING_PROVISIONER_TERMINATE_GRACE_SECS = "terminate_grace_secs"
+SERVING_PROVISIONER_TERMINATE_GRACE_SECS_DEFAULT = 5.0
+
 #############################################
 # TPU mesh / parallelism (TPU-native additions; absent from the reference,
 # which delegated model parallelism to an external mpu object)

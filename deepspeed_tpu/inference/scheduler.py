@@ -57,9 +57,10 @@ REJECT_DEADLINE = "deadline"      # deadline unmeetable at an admission gate
 REJECT_RATE_LIMIT = "rate_limit"  # per-tenant token bucket empty
 REJECT_DRAINING = "draining"      # draining or shut-down front door
 REJECT_CAPACITY = "capacity"      # KV page pool exhausted (paged cache)
+REJECT_FENCED = "fenced_out"      # stale router incarnation standing down
 REJECT_REASONS = (
     REJECT_OVERLOAD, REJECT_DEADLINE, REJECT_RATE_LIMIT, REJECT_DRAINING,
-    REJECT_CAPACITY,
+    REJECT_CAPACITY, REJECT_FENCED,
 )
 
 
@@ -648,9 +649,13 @@ class ContinuousBatchingScheduler:
                 return
             except PoolExhausted:
                 pass
-            # victim the most recently admitted request that can still
-            # RESUME (its prompt + committed tokens must re-prefill in
-            # one window); anything grown past the prefill window is
+            # victim selection is priority-classed: the lowest class
+            # (highest numeric ``priority`` — 0 is the most protected)
+            # parks first, and WITHIN a class the most recently admitted
+            # request goes — so a burst of sheddable traffic can never
+            # evict a protected tenant's generation. Only resumable
+            # victims (prompt + committed tokens re-prefill in one
+            # window); anything grown past the prefill window is
             # unresumable and only fail-finished as a last resort
             def _resumable(s):
                 req = self._slots[s]
@@ -658,7 +663,11 @@ class ContinuousBatchingScheduler:
                     len(req.prompt_tokens) + len(req.tokens)
                 ) <= prefill_len
             order = sorted(
-                active, key=lambda s: self._slot_admit_seq[s], reverse=True
+                active,
+                key=lambda s: (
+                    self._slots[s].priority, self._slot_admit_seq[s]
+                ),
+                reverse=True,
             )
             victim = next((s for s in order if _resumable(s)), None)
             if victim is None:

@@ -150,6 +150,21 @@ KNOWN_FAULT_SITES = {
         "must classify it CORRUPT and fall back to the previous valid "
         "snapshot, never half-adopt"
     ),
+    # -- whole-node failure domain (serving/node.py + provisioner.py,
+    # docs/serving.md "Node failure domain") ----------------------------
+    "node.crash": (
+        "SIGKILLs the node-agent process at the op-dispatch seam — the "
+        "whole-host-death failure mode; every hosted replica's sessions "
+        "orphan into the re-route budget and the provisioner restores "
+        "the lost capacity"
+    ),
+    "node.partition": (
+        "silently drops one outbound frame at the NODE's send seam (the "
+        "node-side mirror of net.partition: the token/finished/reply "
+        "event never leaves the host) — only the client's reply timeout "
+        "or lease expiry notices, then reconnect-with-resume replays "
+        "from the outbox"
+    ),
 }
 
 _RAISES = {

@@ -28,6 +28,10 @@ scheduler in deepspeed_tpu/inference/. Four layers:
                  model predicts SLO-unmeetable load and changes replica
                  capacity BEFORE the brownout/shed cliff (scale-up,
                  drain-then-retire scale-down, chaos re-provisioning).
+  provisioner.py— the whole-node lifecycle seam (NodeProvisioner /
+                 LocalSubprocessProvisioner): the autoscaler's node
+                 tier — launch, re-provision, and terminate entire
+                 node agents with a health-confirmed join.
 
 ``init_fleet`` is the config-driven front door, the fleet analog of
 ``deepspeed_tpu.init_inference``.
@@ -56,10 +60,19 @@ from .autoscaler import (
     Autoscaler,
     AutoscalerPolicy,
     InProcessReplicaProvider,
+    NoPlaceableCapacity,
     PhaseCostModel,
     SLOTargets,
     SocketNodeProvider,
     SubprocessReplicaProvider,
+)
+from .provisioner import (
+    LocalSubprocessProvisioner,
+    NodeHandle,
+    NodeProvisioner,
+    ProvisionFailed,
+    StaticProvisioner,
+    wait_for_node,
 )
 from .http import HTTPDoor, serve_http
 from .journal import (
@@ -70,6 +83,7 @@ from .journal import (
 )
 from .replica import (
     RPC_PROTOCOL_VERSION,
+    FencedOut,
     InProcessReplica,
     RemoteRequest,
     ReplicaProtocolError,
@@ -224,6 +238,7 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
         reconnect_attempts=cfg.serving_socket_reconnect_attempts,
         reconnect_backoff_secs=cfg.serving_socket_reconnect_backoff_secs,
     )
+    epoch = None
     if cfg.serving_journal_enabled:
         from .journal import (
             FleetJournal,
@@ -234,6 +249,17 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
         state, _recovery_info = load_journal_state(
             cfg.serving_journal_dir, registry=registry
         )
+        # epoch fencing (docs/serving.md "Epoch fencing"): this life's
+        # incarnation — the number FleetJournal adopts below (old + 1 on
+        # recovery, 1 cold) — rides every node hello via socket_kwargs,
+        # so node agents fence out any incarnation this one supersedes.
+        # Computed BEFORE plan_adoption: the adoption dials are exactly
+        # where each node's high-water mark must advance.
+        epoch = (
+            int(state.get("incarnation", 1)) + 1 if state is not None
+            else 1
+        )
+        socket_kwargs["epoch"] = epoch
         if state is not None:
             recovered = plan_adoption(
                 state, registry=registry, fault_injector=faults,
@@ -271,6 +297,25 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
                 fault_injector=faults,
             )
         else:
+            # node tier (provisioner.py, docs/serving.md "Node failure
+            # domain"): when the block arms it, the provider can launch
+            # whole node agents — scale-up past every live node's
+            # ceiling mints a new node, a dead node re-provisions under
+            # its own name, an emptied provisioner-owned node terminates
+            provisioner = None
+            if cfg.serving_provisioner_enabled:
+                from .provisioner import LocalSubprocessProvisioner
+
+                provisioner = LocalSubprocessProvisioner(
+                    cfg.serving_provisioner_node_spec,
+                    launch_timeout=(
+                        cfg.serving_provisioner_launch_timeout_secs
+                    ),
+                    terminate_grace=(
+                        cfg.serving_provisioner_terminate_grace_secs
+                    ),
+                    epoch=epoch, registry=registry,
+                )
             provider = SocketNodeProvider(
                 nodes,
                 rpc_timeout=cfg.serving_rpc_timeout_secs,
@@ -285,6 +330,16 @@ def init_fleet(engine_factory=None, worker_spec=None, nodes=None,
                 ),
                 registry=registry,
                 fault_injector=faults,
+                epoch=epoch,
+                provisioner=provisioner,
+                max_replicas_per_node=(
+                    cfg.serving_provisioner_max_replicas_per_node
+                    if cfg.serving_provisioner_enabled else None
+                ),
+                max_nodes=(
+                    cfg.serving_provisioner_max_nodes
+                    if cfg.serving_provisioner_enabled else None
+                ),
             )
         autoscaler = Autoscaler(
             provider,
@@ -443,6 +498,7 @@ __all__ = [
     "BREAKER_HALF_OPEN",
     "BREAKER_OPEN",
     "CircuitBreaker",
+    "FencedOut",
     "FleetJournal",
     "FleetOverloaded",
     "FleetRequest",
@@ -451,8 +507,13 @@ __all__ = [
     "InProcessReplica",
     "InProcessReplicaProvider",
     "LeastLoaded",
+    "LocalSubprocessProvisioner",
+    "NoPlaceableCapacity",
+    "NodeHandle",
+    "NodeProvisioner",
     "PLACEMENT_POLICIES",
     "PhaseCostModel",
+    "ProvisionFailed",
     "PrefixAffinity",
     "RPC_PROTOCOL_VERSION",
     "RateLimited",
@@ -463,6 +524,7 @@ __all__ = [
     "SLOTargets",
     "SocketNodeProvider",
     "SocketReplica",
+    "StaticProvisioner",
     "SubprocessReplica",
     "SubprocessReplicaProvider",
     "TelemetryHub",
@@ -471,4 +533,5 @@ __all__ = [
     "load_journal_state",
     "plan_adoption",
     "serve_http",
+    "wait_for_node",
 ]

@@ -68,6 +68,28 @@ AUTOSCALE_UP = "scale_up"
 AUTOSCALE_DOWN = "scale_down"
 AUTOSCALE_REPROVISION = "reprovision"
 
+# Decision.refused_code / the reason label on the per-reason refusal
+# counters (``fleet/autoscale_refusals/<code>``)
+REFUSE_MAX_REPLICAS = "max_replicas"
+REFUSE_MIN_REPLICAS = "min_replicas"
+REFUSE_COOLDOWN = "cooldown"
+REFUSE_FLAP_BUDGET = "flap_budget"
+REFUSE_NO_VICTIM = "no_victim"
+REFUSE_NO_CAPACITY = "no_placeable_capacity"
+
+
+class NoPlaceableCapacity(RuntimeError):
+    """A spawn found ZERO placeable capacity: every node is dead (inside
+    its failure backoff) or at its per-node replica ceiling, and no
+    provisioner can mint more. Typed so the executor surfaces it as a
+    counted, flight-recorded REFUSAL (``fleet/autoscale_refusals`` with
+    the ``no_placeable_capacity`` reason) instead of a generic op
+    failure re-decided silently every tick."""
+
+    def __init__(self, message, *, reason=REFUSE_NO_CAPACITY):
+        super().__init__(message)
+        self.reason = str(reason)
+
 # scale up when queue fill reaches this fraction of the brownout
 # threshold: degradation is the mechanism of last resort, so elastic
 # capacity must engage with headroom to spare, not at the band's edge
@@ -244,18 +266,22 @@ class ErrorBudget:
         return 1.0 - violations / len(self._samples)
 
 
-Decision = namedtuple("Decision", "action reason replica_id refused")
+Decision = namedtuple(
+    "Decision", "action reason replica_id refused refused_code"
+)
+Decision.__new__.__defaults__ = (None,)
 Decision.__doc__ = (
     "One autoscale verdict: ``action`` (hold/scale_up/scale_down/"
     "reprovision), a human-readable ``reason``, the ``replica_id`` a "
-    "scale-down would retire, and ``refused`` — the action a clamp "
+    "scale-down would retire, ``refused`` — the action a clamp "
     "(cooldown, flap budget, min/max) blocked this tick (None when "
-    "nothing was blocked)."
+    "nothing was blocked) — and ``refused_code``, the machine-readable "
+    "REFUSE_* label the per-reason refusal counter carries."
 )
 
 
-def _hold(reason, refused=None):
-    return Decision(AUTOSCALE_HOLD, reason, None, refused)
+def _hold(reason, refused=None, code=None):
+    return Decision(AUTOSCALE_HOLD, reason, None, refused, code)
 
 
 class AutoscaleState:
@@ -406,6 +432,7 @@ class AutoscalerPolicy:
                 return _hold(
                     f"overloaded ({why}) but at max_replicas "
                     f"{self.max_replicas}", refused=AUTOSCALE_UP,
+                    code=REFUSE_MAX_REPLICAS,
                 )
             if (
                 state.last_scale_at is not None
@@ -414,14 +441,14 @@ class AutoscalerPolicy:
                 return _hold(
                     f"overloaded ({why}) but inside the "
                     f"{self.cooldown_secs:.1f}s cooldown",
-                    refused=AUTOSCALE_UP,
+                    refused=AUTOSCALE_UP, code=REFUSE_COOLDOWN,
                 )
             if self._flap_refused(state, now, "up"):
                 return _hold(
                     f"overloaded ({why}) but the flap budget "
                     f"({self.flap_budget} reversals per "
                     f"{self.flap_window_secs:.0f}s) is spent",
-                    refused=AUTOSCALE_UP,
+                    refused=AUTOSCALE_UP, code=REFUSE_FLAP_BUDGET,
                 )
             return Decision(AUTOSCALE_UP, why, None, None)
         if (
@@ -432,6 +459,7 @@ class AutoscalerPolicy:
                 return _hold(
                     f"sustained headroom but at min_replicas "
                     f"{self.min_replicas}", refused=AUTOSCALE_DOWN,
+                    code=REFUSE_MIN_REPLICAS,
                 )
             if (
                 state.last_scale_at is not None
@@ -439,17 +467,20 @@ class AutoscalerPolicy:
             ):
                 return _hold(
                     "sustained headroom but inside the cooldown",
-                    refused=AUTOSCALE_DOWN,
+                    refused=AUTOSCALE_DOWN, code=REFUSE_COOLDOWN,
                 )
             if self._flap_refused(state, now, "down"):
                 return _hold(
                     "sustained headroom but the flap budget is spent",
-                    refused=AUTOSCALE_DOWN,
+                    refused=AUTOSCALE_DOWN, code=REFUSE_FLAP_BUDGET,
                 )
             victim = self._scale_down_victim(candidates)
             if victim is None:
-                return _hold("sustained headroom but no routable "
-                             "replica to retire")
+                return _hold(
+                    "sustained headroom but no routable replica to "
+                    "retire", refused=AUTOSCALE_DOWN,
+                    code=REFUSE_NO_VICTIM,
+                )
             return Decision(
                 AUTOSCALE_DOWN,
                 f"headroom sustained {now - state.headroom_since:.1f}s "
@@ -553,7 +584,20 @@ class SocketNodeProvider:
     live replicas, ties to the lexicographically first name. A node
     whose control op failed (connect refused — SIGKILLed host) is
     skipped for ``node_retry_secs`` so re-provisioning converges on the
-    survivors instead of re-dialing the corpse every tick."""
+    survivors instead of re-dialing the corpse every tick.
+
+    The NODE tier (docs/serving.md "Node failure domain"): with a
+    ``provisioner`` (serving/provisioner.py) attached, a spawn that
+    finds zero placeable capacity escalates from replicas to nodes —
+    a node inside its failure backoff is RE-PROVISIONED under the same
+    name (fresh process, new address; its replacement replicas rejoin
+    behind the breaker's half-open probation like any spawn), and a
+    replica target past every node's ``max_replicas_per_node`` ceiling
+    mints a brand-new node (``pn0``, ``pn1``, ... up to ``max_nodes``).
+    A retire that empties a provisioner-owned node terminates the node
+    whole. Without a provisioner, zero placeable capacity raises the
+    typed :class:`NoPlaceableCapacity` the executor records as a
+    refusal."""
 
     name = "socket"
 
@@ -562,15 +606,21 @@ class SocketNodeProvider:
                  connect_timeout=10.0, connect_retries=3, lease_secs=10.0,
                  reconnect_attempts=3, reconnect_backoff_secs=0.1,
                  registry=None, fault_injector=None, spawn_timeout=180.0,
-                 node_retry_secs=30.0, clock=time.monotonic):
+                 node_retry_secs=30.0, clock=time.monotonic, epoch=None,
+                 provisioner=None, max_replicas_per_node=None,
+                 max_nodes=None):
         self._addresses = {
             str(name): block["address"] for name, block in nodes.items()
         }
-        if not self._addresses:
-            raise ValueError("SocketNodeProvider needs at least one node")
+        if not self._addresses and provisioner is None:
+            raise ValueError(
+                "SocketNodeProvider needs at least one node (or a "
+                "provisioner that can mint one)"
+            )
         self._engine_spec = (
             dict(engine_spec) if engine_spec is not None else None
         )
+        self.epoch = None if epoch is None else int(epoch)
         self._replica_kw = dict(
             rpc_timeout=rpc_timeout, rpc_retries=rpc_retries,
             rpc_backoff_secs=rpc_backoff_secs,
@@ -578,6 +628,7 @@ class SocketNodeProvider:
             connect_retries=connect_retries, lease_secs=lease_secs,
             reconnect_attempts=reconnect_attempts,
             reconnect_backoff_secs=reconnect_backoff_secs,
+            epoch=self.epoch,
         )
         self._registry = registry
         self._faults = fault_injector
@@ -586,32 +637,107 @@ class SocketNodeProvider:
         self._clock = clock
         self._node_failed_at = {}
         self._seq = itertools.count()
+        self.provisioner = provisioner
+        self.max_replicas_per_node = (
+            None if max_replicas_per_node is None
+            else int(max_replicas_per_node)
+        )
+        self.max_nodes = None if max_nodes is None else int(max_nodes)
+        self._node_seq = itertools.count()
+        self._live_ids = None
 
-    def _pick_node(self, existing_ids):
-        now = self._clock()
+    def note_live_ids(self, live_ids):
+        """The router's live (non-evicted) replica view, refreshed by
+        the autoscaler ahead of each spawn. Capacity counting must not
+        charge a node for replicas the router already evicted — a
+        SIGKILLed node would look forever full and re-provisioning
+        could never target it — while id-minting still avoids every id
+        the router has ever seen (the ``existing_ids`` spawn arg)."""
+        self._live_ids = {str(rid) for rid in live_ids}
+
+    def _replica_counts(self, existing_ids):
+        ids = self._live_ids if self._live_ids is not None else existing_ids
         counts = {name: 0 for name in self._addresses}
-        for rid in existing_ids:
+        for rid in ids:
             node, _, _rest = str(rid).partition(":")
             if node in counts:
                 counts[node] += 1
+        return counts
+
+    def _pick_node(self, existing_ids):
+        now = self._clock()
+        counts = self._replica_counts(existing_ids)
         reachable = [
             name for name in sorted(self._addresses)
             if now - self._node_failed_at.get(name, -1e18)
             >= self.node_retry_secs
+            and (
+                self.max_replicas_per_node is None
+                or counts[name] < self.max_replicas_per_node
+            )
         ]
         if not reachable:
             return None
         return min(reachable, key=lambda n: (counts[n], n))
+
+    def _provision_node(self, existing_ids):
+        """Zero placeable replica capacity: escalate to the node tier.
+        Deterministic order — re-provision the lexicographically first
+        dead (backed-off) node under its own name; with no corpse to
+        replace, mint a new node name if ``max_nodes`` allows; else
+        raise the typed refusal."""
+        if self.provisioner is None:
+            raise NoPlaceableCapacity(
+                "no placeable node to spawn on (every node dead inside "
+                f"its {self.node_retry_secs:.0f}s failure backoff or at "
+                f"its {self.max_replicas_per_node} replicas-per-node "
+                "ceiling) and no provisioner is configured"
+            )
+        now = self._clock()
+        dead = sorted(
+            name for name in self._addresses
+            if now - self._node_failed_at.get(name, -1e18)
+            < self.node_retry_secs
+        )
+        if dead:
+            node = dead[0]
+            logger.warning(
+                "fleet autoscaler: re-provisioning dead node %s through "
+                "the provisioner", node,
+            )
+        else:
+            if (
+                self.max_nodes is not None
+                and len(self._addresses) >= self.max_nodes
+            ):
+                raise NoPlaceableCapacity(
+                    f"every live node is at its replica ceiling and the "
+                    f"fleet is at max_nodes={self.max_nodes}"
+                )
+            node = _mint_replica_id(
+                self._node_seq, set(self._addresses), prefix="pn"
+            )
+            logger.warning(
+                "fleet autoscaler: replica target exceeds live-node "
+                "capacity — provisioning new node %s", node,
+            )
+        try:
+            handle = self.provisioner.launch_node(node)
+        except Exception as e:
+            self._node_failed_at[node] = self._clock()
+            raise NoPlaceableCapacity(
+                f"provisioning node {node!r} failed: {e}"
+            ) from e
+        self._addresses[node] = handle.address
+        self._node_failed_at.pop(node, None)
+        return node
 
     def spawn(self, existing_ids):
         from .transport import NodeControlClient, SocketReplica
 
         node = self._pick_node(existing_ids)
         if node is None:
-            raise RuntimeError(
-                "no reachable node to spawn on (all inside their "
-                f"{self.node_retry_secs:.0f}s failure backoff)"
-            )
+            node = self._provision_node(existing_ids)
         address = self._addresses[node]
         name = _mint_replica_id(self._seq, {
             str(rid).partition(":")[2] for rid in existing_ids
@@ -620,6 +746,7 @@ class SocketNodeProvider:
         try:
             NodeControlClient(
                 address, op_timeout=self._spawn_timeout,
+                epoch=self.epoch,
             ).spawn_replica(name, spec=self._engine_spec)
         except (OSError, ConnectionError, TimeoutError, RuntimeError):
             self._node_failed_at[node] = self._clock()
@@ -639,14 +766,42 @@ class SocketNodeProvider:
         address = self._addresses.get(node)
         if address is None:
             return
+        remaining = None
         try:
-            NodeControlClient(address).retire_replica(
-                getattr(replica, "remote_name", name)
-            )
+            reply = NodeControlClient(
+                address, epoch=self.epoch,
+            ).retire_replica(getattr(replica, "remote_name", name))
+            remaining = reply.get("replicas")
         except Exception as e:
             # the node may be dead — the transport shutdown already
             # freed the router side; never fail a scale-down on it
             count_suppressed("serving.autoscale_node_retire", e)
+        if (
+            remaining == []
+            and self.provisioner is not None
+            and node in self.provisioner.list_nodes()
+        ):
+            # drain-then-terminate: the retire above was the node's last
+            # replica, and the provisioner owns the process — release
+            # the whole host instead of idling an empty agent forever
+            try:
+                self.provisioner.terminate_node(node)
+            except Exception as e:
+                count_suppressed("serving.autoscale_node_terminate", e)
+            else:
+                # back off the address until a future escalation
+                # re-provisions it — _pick_node must not dial the corpse
+                self._node_failed_at[node] = self._clock()
+                logger.warning(
+                    "fleet autoscaler: scale_down emptied node %s — "
+                    "terminated it through the provisioner", node,
+                )
+
+    def close(self):
+        """Shutdown sweep: release every provisioner-owned node (their
+        processes belong to this router's life)."""
+        if self.provisioner is not None:
+            self.provisioner.close()
 
 
 # ---------------------------------------------------------------------------
@@ -719,6 +874,7 @@ class Autoscaler:
         self._c_reprovisions = reg.counter("fleet/autoscale_reprovisions")
         self._c_refusals = reg.counter("fleet/autoscale_refusals")
         self._c_failures = reg.counter("fleet/autoscale_failures")
+        self._registry = reg
         if self.policy.brownout_queue_ratio is None:
             self.policy.brownout_queue_ratio = router.brownout_queue_ratio
         live = len(router.live_replica_ids())
@@ -835,18 +991,37 @@ class Autoscaler:
         )
         self._g_target.set(self.state.target)
         if decision.refused is not None:
-            self._c_refusals.inc()
-            if decision.reason != self._last_refused:
-                self._last_refused = decision.reason
-                logger.warning(
-                    "fleet autoscaler: refusing %s — %s",
-                    decision.refused, decision.reason,
-                )
-        else:
+            self._record_refusal(
+                decision.refused_code, decision.refused, decision.reason,
+            )
+        elif decision.action == AUTOSCALE_HOLD:
+            # a healthy hold ends any refusal streak; a launched op's
+            # outcome (success resets, NoPlaceableCapacity extends)
+            # settles on the op thread
             self._last_refused = None
         if decision.action != AUTOSCALE_HOLD:
             self._launch(decision)
         return decision
+
+    def _record_refusal(self, code, refused_action, reason):
+        """One refused transition: the aggregate counter, the per-reason
+        labeled counter, and — on the transition INTO this refusal
+        state, not on every spinning tick — a warning plus a
+        flight-recorder instant so postmortems see exactly when the
+        fleet started wanting capacity it could not get."""
+        self._c_refusals.inc()
+        if code:
+            self._registry.counter(
+                f"fleet/autoscale_refusals/{code}",
+                help="autoscale refusals, labeled by reason",
+            ).inc()
+        if reason != self._last_refused:
+            self._last_refused = reason
+            logger.warning(
+                "fleet autoscaler: refusing %s — %s",
+                refused_action, reason,
+            )
+            self._event("refused", reason, replica=None)
 
     def _update_arrival(self, router, now):
         hub = getattr(router, "hub", None)
@@ -940,6 +1115,12 @@ class Autoscaler:
         try:
             if decision.action in (AUTOSCALE_UP, AUTOSCALE_REPROVISION):
                 existing = set(router.replica_ids) | router.evicted_ids
+                note = getattr(self.provider, "note_live_ids", None)
+                if note is not None:
+                    # node-tier providers count capacity from the LIVE
+                    # view (evicted replicas hold no slots) while still
+                    # minting ids clear of everything ever registered
+                    note(router.live_replica_ids())
                 replica = self.provider.spawn(existing)
                 try:
                     router.add_replica(replica, probation=True)
@@ -985,6 +1166,14 @@ class Autoscaler:
                 )
                 self._event(AUTOSCALE_DOWN, decision.reason,
                             replica=decision.replica_id)
+            self._last_refused = None
+        except NoPlaceableCapacity as e:
+            # not a failure — a typed refusal: the fleet WANTS capacity
+            # and structurally cannot place it; counted with its reason
+            # label and flight-recorded on the transition instead of
+            # spinning silently through _c_failures every tick
+            self._record_refusal(e.reason, decision.action, str(e))
+            count_suppressed("serving.autoscale_no_capacity", e)
         except Exception as e:
             self._c_failures.inc()
             logger.warning(
@@ -1003,9 +1192,16 @@ class Autoscaler:
 
     def close(self, timeout=30.0):
         """Stop evaluating and wait out any in-flight scale operation
-        (the router calls this from shutdown())."""
+        (the router calls this from shutdown()); then release whatever
+        the provider owns (provisioned node processes)."""
         self._closed = True
         t = self._op_thread
         if t is not None and t.is_alive():
             t.join(timeout)
         self._op_thread = None
+        provider_close = getattr(self.provider, "close", None)
+        if provider_close is not None:
+            try:
+                provider_close()
+            except Exception as e:
+                count_suppressed("serving.autoscale_provider_close", e)

@@ -85,6 +85,7 @@ from ..inference.scheduler import (
     REJECT_CAPACITY,
     REJECT_DEADLINE,
     REJECT_DRAINING,
+    REJECT_FENCED,
     REJECT_OVERLOAD,
     REJECT_RATE_LIMIT,
     RequestRejected,
@@ -98,6 +99,7 @@ STATUS_BY_REASON = {
     REJECT_DRAINING: 503,
     REJECT_CAPACITY: 503,
     REJECT_DEADLINE: 504,
+    REJECT_FENCED: 503,
 }
 # statuses a client should back off and retry on
 _RETRYABLE = (429, 503)
@@ -376,9 +378,19 @@ class HTTPDoor:
                 # event loop (and every open stream) out of them
                 ready, reasons = await asyncio.get_event_loop(
                 ).run_in_executor(None, self.router.readiness)
+                body = {"ready": bool(ready), "reasons": list(reasons)}
+                if not ready and "no_routable_replicas" in reasons:
+                    # the 503 alone tells the LB to back off; the CAUSE
+                    # buckets tell the operator what to fix (all
+                    # evicted vs breakers open vs fenced out)
+                    cause = getattr(
+                        self.router, "no_capacity_cause", None
+                    )
+                    if cause is not None:
+                        body["cause"] = await asyncio.get_event_loop(
+                        ).run_in_executor(None, cause)
                 await self._respond_json(
-                    writer, 200 if ready else 503,
-                    {"ready": bool(ready), "reasons": list(reasons)},
+                    writer, 200 if ready else 503, body,
                 )
             elif method == "POST" and target == "/v1/generate":
                 await self._generate(reader, writer, headers, body)

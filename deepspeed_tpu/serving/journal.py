@@ -549,10 +549,16 @@ def plan_adoption(state, *, registry=None, fault_injector=None,
         address = (str(address[0]), int(address[1]))
         if node not in rosters:
             try:
+                # the confirm dial carries the NEW incarnation's fencing
+                # epoch (socket_kwargs["epoch"], transport.py): adoption
+                # is exactly the moment each node's high-water mark must
+                # advance, so the incarnation we just superseded is
+                # fenced out of every node we re-adopt
                 info = ctl_cls(
                     addresses.get(node, address),
                     connect_timeout=control_timeout,
                     op_timeout=control_timeout,
+                    epoch=(socket_kwargs or {}).get("epoch"),
                 ).node_info()
                 rosters[node] = set(info.get("replicas") or ())
             except (OSError, RuntimeError, ValueError) as e:

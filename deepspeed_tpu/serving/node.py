@@ -59,6 +59,8 @@ caps hosted engines. The router-side autoscaler drives these through
 import argparse
 import collections
 import json
+import os
+import signal
 import socket
 import struct
 import sys
@@ -66,7 +68,7 @@ import threading
 import time
 
 from ..inference.scheduler import RequestRejected
-from ..resilience.faults import build_fault_injector_from_dict
+from ..resilience.faults import NULL_INJECTOR, build_fault_injector_from_dict
 from ..telemetry.registry import count_suppressed, wire_snapshot
 from ..telemetry.tracing import NOOP_TRACER, SpanTracer
 from ..utils.logging import logger
@@ -92,9 +94,9 @@ class _Session:
     table plus the event outbox that survives reconnects."""
 
     __slots__ = ("client", "replica_name", "engine", "tracked", "outbox",
-                 "conn", "last_seen", "lock", "dead")
+                 "conn", "last_seen", "lock", "dead", "faults")
 
-    def __init__(self, client, replica_name, engine):
+    def __init__(self, client, replica_name, engine, faults=NULL_INJECTOR):
         self.client = client
         self.replica_name = replica_name
         self.engine = engine
@@ -104,6 +106,7 @@ class _Session:
         self.last_seen = time.monotonic()
         self.lock = threading.Lock()
         self.dead = False
+        self.faults = faults
 
     def emit(self, msg):
         """Queue one event and flush what the live connection will take.
@@ -122,6 +125,19 @@ class _Session:
         if conn is None:
             return
         while self.outbox:
+            # fault site node.partition: the node-side mirror of the
+            # client's net.partition — the network black-holes one
+            # outbound event frame AFTER the node considers it sent. The
+            # client's reply timeout / token-index gap / lease expiry
+            # notices; the finished event's authoritative token list (or
+            # an idempotent-RPC retry) repairs the loss.
+            if (
+                self.faults.enabled
+                and self.faults.fire("node.partition") is not None
+            ):
+                count_suppressed("serving.node_partition_drop")
+                self.outbox.popleft()
+                continue
             data = encode_frame(self.outbox[0])
             try:
                 conn.sendall(data)
@@ -191,6 +207,15 @@ class NodeServer:
         # serializes spawn/retire against each other (engine builds are
         # slow; two concurrent spawns of one name must not both win)
         self._elastic_lock = threading.Lock()
+        # epoch fencing (docs/serving.md "Epoch fencing"): the highest
+        # router-incarnation epoch any hello has presented. A hello
+        # below it is a STALE router (an old journal's incarnation
+        # restarted after a newer one adopted this node) — rejected
+        # with a typed fenced_out error so it stands down instead of
+        # double-driving sessions the live router owns. Epoch-less
+        # hellos (tests, pre-epoch clients) fence nothing.
+        self._epoch_lock = threading.Lock()
+        self._epoch_high_water = 0
         self._host = str(host)
         self._port = int(port)
         self._build = engine_builder or build_engine_from_spec
@@ -391,6 +416,11 @@ class NodeServer:
             return None
         name = str(hello.get("replica"))
         client = str(hello.get("client"))
+        epoch = hello.get("epoch")
+        if epoch is not None and not self._admit_epoch(
+            int(epoch), client, name, conn
+        ):
+            return None
         if name == NODE_CONTROL_NAME:
             # control-plane session (transport.py NodeControlClient):
             # binds to NO engine — only the lifecycle ops are valid on it
@@ -408,7 +438,8 @@ class NodeServer:
         with self._sessions_lock:
             session = self._sessions.get(key)
             if session is None or session.dead:
-                session = _Session(client, name, engine)
+                session = _Session(client, name, engine,
+                                   faults=self._faults)
                 self._sessions[key] = session
         with session.lock:
             if hello.get("replay"):
@@ -456,9 +487,56 @@ class NodeServer:
             )
         return session
 
+    def _admit_epoch(self, epoch, client, name, conn):
+        """The split-brain gate: admit a hello at-or-above the node's
+        high-water epoch (raising it), reject one below it with a typed
+        ``fenced_out`` error frame. Returns True when admitted."""
+        with self._epoch_lock:
+            high_water = self._epoch_high_water
+            if epoch >= high_water:
+                self._epoch_high_water = epoch
+                return True
+        logger.warning(
+            "node %s: FENCED OUT client %s (session %r): presented "
+            "epoch %d is below this node's high-water epoch %d — a "
+            "newer router incarnation owns this fleet",
+            self.node_id, client, name, epoch, high_water,
+        )
+        count_suppressed("serving.node_fenced_out")
+        if self.tracer.enabled:
+            self.tracer.event(
+                "node.fenced_out",
+                attrs={"node": self.node_id, "client": client,
+                       "epoch": epoch, "high_water": high_water},
+            )
+        try:
+            conn.sendall(encode_frame({
+                "event": "error", "code": "fenced_out",
+                "error": f"node {self.node_id}: epoch {epoch} is fenced "
+                         f"out (high-water epoch {high_water})",
+                "epoch": epoch, "high_water": high_water,
+            }))
+        except OSError:
+            pass
+        return False
+
     # -- ops -------------------------------------------------------------
     def _handle_op(self, session, msg):
         op = msg.get("op")
+        # fault site node.crash: SIGKILL the whole agent at the
+        # op-dispatch seam — the host-death failure mode. Every hosted
+        # replica's sessions orphan at once; the router's eviction /
+        # re-route machinery and the provisioner's re-provision path
+        # (serving/provisioner.py) must absorb it end to end.
+        if (
+            self._faults.enabled
+            and self._faults.fire("node.crash") is not None
+        ):
+            logger.warning(
+                "node %s: injected node.crash — SIGKILLing the agent",
+                self.node_id,
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
         # fault site replica.hang (the worker op loop's site, node form):
         # every RPC on this connection waits out the stall while the
         # process stays alive — the unresponsive-replica failure mode
@@ -475,6 +553,7 @@ class NodeServer:
                     "event": "reply", "id": msg.get("id"),
                     "node": self.node_id,
                     "replicas": sorted(self.engines),
+                    "epoch_high_water": self._epoch_high_water,
                 })
             elif op == "metrics_snapshot":
                 self._op_metrics_snapshot(session, msg)
@@ -906,8 +985,6 @@ def main(argv=None):
     # Same fd discipline as worker.main: dup a private handle for the
     # announcement, then point fd 1 at stderr so loggers, stray prints,
     # and jax warnings cannot corrupt the launcher's readline.
-    import os
-
     announce = os.fdopen(os.dup(sys.stdout.fileno()), "w", buffering=1)
     os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
     node = NodeServer(spec, host=args.host, port=args.port)

@@ -81,6 +81,19 @@ class ReplicaProtocolError(ReplicaRPCError):
     undecodable frame at a time."""
 
 
+class FencedOut(ReplicaRPCError):
+    """The node rejected this session's incarnation epoch: a NEWER
+    router has since presented a higher epoch, so this side is a stale
+    incarnation that must stand down instead of double-driving sessions
+    a live router already owns (docs/serving.md "Epoch fencing").
+    Terminal — the transport never retries or reconnects through it."""
+
+    def __init__(self, message, *, epoch=None, high_water=None):
+        super().__init__(message)
+        self.epoch = epoch
+        self.high_water = high_water
+
+
 class ReplicaBase:
     """Shared lifecycle helpers; subclasses implement the transport.
 

@@ -45,6 +45,7 @@ from ..adapters.pool import AdapterUnavailable
 from ..inference.scheduler import (
     REJECT_DEADLINE,
     REJECT_DRAINING,
+    REJECT_FENCED,
     RequestRejected,
 )
 from ..resilience.faults import NULL_INJECTOR
@@ -430,6 +431,12 @@ class FleetRouter:
         # zombie budget spent): swept by _sweep_failed_replicas exactly
         # like a dead decode driver
         self._force_failed = set()
+        # epoch fencing (docs/serving.md "Epoch fencing"): latched when
+        # any node rejects this router's incarnation epoch — a NEWER
+        # incarnation owns the fleet, and this one stands down loudly
+        # (readiness "fenced_out", submit refusals) instead of
+        # double-executing requests the live router is also running
+        self._fenced = False
         # brownout degradation state (docs/serving.md "Brownout"):
         # None = feature off; active state flips on the fleet queue fill
         self.brownout_queue_ratio = (
@@ -1062,6 +1069,17 @@ class FleetRouter:
         burning a replica queue slot on a guaranteed miss). ``kwargs``
         pass through to the replica scheduler's submit (max_new_tokens,
         temperature, deadline_secs, ...)."""
+        if self._fenced:
+            # stand-down is absolute: a stale incarnation that kept
+            # serving would double-execute requests the live router is
+            # also running (docs/serving.md "Epoch fencing")
+            self._rejected.inc()
+            self._trace_reject(REJECT_FENCED, tenant)
+            raise RequestRejected(
+                "router incarnation fenced out: a newer incarnation "
+                "owns this fleet; this router is standing down",
+                reason=REJECT_FENCED,
+            )
         if self._stop.is_set() or self._draining:
             self._rejected.inc()
             self._trace_reject(REJECT_DRAINING, tenant)
@@ -1675,6 +1693,23 @@ class FleetRouter:
             replica = self._replicas.get(rid)
             if replica is None:
                 continue  # removed (scale-down) mid-sweep
+            if getattr(replica, "fenced", False) and not self._fenced:
+                # the node rejected this router's incarnation epoch: a
+                # newer incarnation owns the fleet. Latch the stand-down
+                # BEFORE the eviction below so the operator sees WHY the
+                # fleet is emptying — and so submit/readiness refuse from
+                # this tick on, not after the last replica is gone
+                self._fenced = True
+                logger.critical(
+                    "fleet: replica %s FENCED OUT — this router's "
+                    "incarnation epoch is stale (a newer router owns the "
+                    "fleet); standing down: refusing new submissions and "
+                    "reporting not-ready", rid,
+                )
+                self.tracer.event(
+                    "router.fenced_out", attrs={"replica": rid},
+                )
+                self.tracer.dump_flight("router_fenced_out")
             if replica.failed or rid in force_failed:
                 logger.warning(
                     "fleet: evicting replica %s (decode driver dead past "
@@ -1975,6 +2010,11 @@ class FleetRouter:
         health — an LB should stop routing here BEFORE requests shed.
         Liveness is ``/healthz``'s job; this is about taking traffic."""
         reasons = []
+        if self._fenced:
+            # a newer router incarnation owns the fleet (a node refused
+            # this one's epoch): NO traffic belongs here, ever again —
+            # split-brain safety beats availability
+            reasons.append("fenced_out")
         if self._recovering:
             # crash-recovery adoption in progress (or not yet refreshed):
             # the adopted fleet's load picture is stale — an LB should
@@ -1990,6 +2030,48 @@ class FleetRouter:
         elif all(s.get("health", 0) > 0 for _rid, s in candidates):
             reasons.append("degraded")
         return (not reasons, reasons)
+
+    def no_capacity_cause(self):
+        """Why zero replicas are routable RIGHT NOW — the ``cause``
+        object the door folds into a 503 ``/readyz`` body when the
+        reason is ``no_routable_replicas`` (docs/serving.md). Bucket
+        counts an operator can act on without grepping logs: a fleet
+        that is all ``evicted`` needs reprovisioning, all
+        ``breaker_open`` needs the failing dependency fixed, and
+        ``fenced`` means this router must be retired, not healed."""
+        with self._lock:
+            order = tuple(self._order)
+            routable = set(self._routable)
+            evicted = set(self._evicted)
+        breaker_open = 0
+        dead = 0
+        for rid in order:
+            if rid in evicted or rid not in routable:
+                continue
+            breaker = self._breakers.get(rid)
+            if breaker is not None and not breaker.routable():
+                breaker_open += 1
+                continue
+            replica = self._replicas.get(rid)
+            if replica is None:
+                continue
+            snap = replica.load_snapshot()
+            if snap.get("failed") or not snap.get("alive"):
+                dead += 1
+        return {
+            "replicas_total": len(order),
+            "evicted": len(evicted),
+            # restarting or replica-level draining: registered but
+            # pulled out of the routable set
+            "not_routable": sum(
+                1 for rid in order
+                if rid not in routable and rid not in evicted
+            ),
+            "breaker_open": breaker_open,
+            "dead": dead,
+            "fenced": self._fenced,
+            "draining": self._stop.is_set() or self._draining,
+        }
 
     @property
     def autoscaler(self):
@@ -2008,6 +2090,14 @@ class FleetRouter:
         """True from adoption start until the first full telemetry
         refresh after it — mirrored as readiness() reason "recovering"."""
         return self._recovering
+
+    @property
+    def fenced(self):
+        """True once any node rejected this router's incarnation epoch
+        (a newer incarnation owns the fleet) — latched permanently;
+        mirrored as readiness() reason "fenced_out" and a submit-path
+        refusal with reason ``fenced_out``."""
+        return self._fenced
 
     @property
     def replica_ids(self):

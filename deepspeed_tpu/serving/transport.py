@@ -63,6 +63,7 @@ from ..telemetry.registry import MetricsRegistry, count_suppressed
 from ..utils.logging import logger
 from .replica import (
     RPC_PROTOCOL_VERSION,
+    FencedOut,
     RemoteRequest,
     ReplicaRPCError,
     RpcReplicaBase,
@@ -181,7 +182,7 @@ class SocketReplica(RpcReplicaBase):
                  connect_timeout=10.0, connect_retries=3,
                  lease_secs=10.0, reconnect_attempts=3,
                  reconnect_backoff_secs=0.1, registry=None,
-                 fault_injector=None):
+                 fault_injector=None, epoch=None):
         super().__init__(
             replica_id, rpc_timeout=rpc_timeout, rpc_retries=rpc_retries,
             rpc_backoff_secs=rpc_backoff_secs,
@@ -196,6 +197,12 @@ class SocketReplica(RpcReplicaBase):
         )
         self._connect_timeout = float(connect_timeout)
         self._connect_retries = int(connect_retries)
+        # this router incarnation's fencing epoch (the fleet journal's
+        # incarnation number): the hello presents it, the node compares
+        # it against its high-water mark, and a lower epoch is rejected
+        # with a typed fenced_out error — the split-brain guard. None
+        # (the default, and every pre-epoch client) fences nothing.
+        self.epoch = None if epoch is None else int(epoch)
         self.lease_secs = float(lease_secs)
         self._reconnect_attempts = int(reconnect_attempts)
         self._reconnect_backoff = float(reconnect_backoff_secs)
@@ -223,6 +230,10 @@ class SocketReplica(RpcReplicaBase):
         # "this connection will not heal" state — the ONLY state where
         # the replica reads failed
         self._gone = False
+        # the node fenced this incarnation's epoch out: terminal like
+        # _gone, but diagnosable — the router stands the whole fleet
+        # down instead of treating it as one more dead replica
+        self._fenced = False
         self._last_pong = 0.0
         self._client = None
         self.node_id = None
@@ -272,6 +283,7 @@ class SocketReplica(RpcReplicaBase):
         self.faults.maybe_raise("replica.flap")
         self._shutdown_requested = False
         self._gone = False
+        self._fenced = False
         self._reset_rpc_state()
         adopted, self._adopted = self._adopted, None
         self._adopted_handles = {}
@@ -340,6 +352,8 @@ class SocketReplica(RpcReplicaBase):
                     "client": self._client, "replica": self.remote_name,
                     "resume": bool(resume),
                 }
+                if self.epoch is not None:
+                    hello["epoch"] = self.epoch
                 if self._replay_on_connect:
                     # adoption resume: ask the node to re-emit every
                     # tracked request's tokens from index 0 — this
@@ -363,6 +377,27 @@ class SocketReplica(RpcReplicaBase):
                     except FrameError as e:
                         self._count_corrupt(e)
                         continue
+                    if (
+                        msg.get("event") == "error"
+                        and msg.get("code") == "fenced_out"
+                    ):
+                        # the node knows a newer incarnation: this
+                        # router must stand down, not retry its way in
+                        self._fenced = True
+                        self._gone = True
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        raise FencedOut(
+                            f"replica {self.replica_id}: node "
+                            f"{self.address[0]}:{self.address[1]} fenced "
+                            f"out epoch {self.epoch} (node high-water "
+                            f"epoch {msg.get('high_water')}) — a newer "
+                            "router incarnation owns this fleet",
+                            epoch=self.epoch,
+                            high_water=msg.get("high_water"),
+                        )
                     self._dispatch(msg)
                     if msg.get("event") == "ready":
                         got_ready = True
@@ -485,6 +520,14 @@ class SocketReplica(RpcReplicaBase):
             time.sleep(self._reconnect_backoff * (2.0 ** attempt))
             try:
                 self._connect(resume=True)
+            except FencedOut as e:
+                # terminal by design: retrying a fence-out would be the
+                # exact split-brain the epoch exists to prevent
+                logger.error(
+                    "replica %s: %s — standing down", self.replica_id, e
+                )
+                count_suppressed("serving.net_fenced_out", e)
+                return False
             except (ReplicaRPCError, OSError) as e:
                 count_suppressed("serving.net_reconnect_attempt", e)
                 continue
@@ -663,6 +706,14 @@ class SocketReplica(RpcReplicaBase):
     def failed(self):
         return self._gone and not self._shutdown_requested
 
+    @property
+    def fenced(self):
+        """True once the node rejected this incarnation's epoch: the
+        router checks this on its failed-replica sweep and stands the
+        whole incarnation down (a fenced replica is evidence of a newer
+        router, not of a dead node)."""
+        return self._fenced
+
 
 class NodeControlClient:
     """Short-lived synchronous control-plane client for a node agent
@@ -677,13 +728,16 @@ class NodeControlClient:
     before replying."""
 
     def __init__(self, address, *, connect_timeout=10.0,
-                 op_timeout=180.0):
+                 op_timeout=180.0, epoch=None):
         if isinstance(address, str):
             host, _, port = address.rpartition(":")
             address = (host or "127.0.0.1", int(port))
         self.address = (str(address[0]), int(address[1]))
         self._connect_timeout = float(connect_timeout)
         self._op_timeout = float(op_timeout)
+        # control ops fence exactly like data sessions: a stale router's
+        # spawn/retire would mutate a fleet a newer incarnation owns
+        self.epoch = None if epoch is None else int(epoch)
 
     def spawn_replica(self, name, spec=None, node_prefix_ids=True):
         """Ask the node to build + serve a new replica ``name`` (engine
@@ -733,11 +787,14 @@ class NodeControlClient:
         )
         try:
             sock.settimeout(self._op_timeout)
-            sock.sendall(encode_frame({
+            hello = {
                 "op": "hello", "proto": RPC_PROTOCOL_VERSION,
                 "client": f"ctl-{os.getpid():x}-{uuid.uuid4().hex[:8]}",
                 "replica": NODE_CONTROL_NAME,
-            }))
+            }
+            if self.epoch is not None:
+                hello["epoch"] = self.epoch
+            sock.sendall(encode_frame(hello))
             rfile = sock.makefile("rb")
             self._await_event(rfile, "ready")
             sock.sendall(encode_frame(dict(op, id=1)))
@@ -772,6 +829,14 @@ class NodeControlClient:
             except FrameError:
                 continue
             if msg.get("event") == "error":
+                if msg.get("code") == "fenced_out":
+                    raise FencedOut(
+                        f"node {self.address[0]}:{self.address[1]} fenced "
+                        f"out control epoch {self.epoch} (node high-water "
+                        f"epoch {msg.get('high_water')})",
+                        epoch=self.epoch,
+                        high_water=msg.get("high_water"),
+                    )
                 raise RuntimeError(str(msg.get("error")))
             if msg.get("event") == event:
                 return msg

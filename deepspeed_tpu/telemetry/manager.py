@@ -186,8 +186,10 @@ SERVING_METRICS = (
     ("counter", "fleet/autoscale_ups", "scale-up transitions executed (a new replica spawned and registered behind its half-open probe)"),
     ("counter", "fleet/autoscale_downs", "scale-down transitions executed (a replica drained, retired, and its gauges removed)"),
     ("counter", "fleet/autoscale_reprovisions", "replicas re-provisioned after chaos took capacity away (eviction, node death) — live count restored to the target"),
-    ("counter", "fleet/autoscale_refusals", "autoscale decisions refused by a clamp: cooldown, flap budget, or the min/max replica bounds"),
+    ("counter", "fleet/autoscale_refusals", "autoscale decisions refused by a clamp or a typed capacity refusal: cooldown, flap budget, the min/max replica bounds, or zero placeable capacity (per-reason fleet/autoscale_refusals/<code> counters register dynamically)"),
     ("counter", "fleet/autoscale_failures", "scale operations that failed mid-execution (spawn raised, node unreachable, retire refused)"),
+    ("counter", "fleet/nodes_provisioned", "node agents launched by the provisioner seam (fresh mints and re-provisions of a dead node under its own name alike)"),
+    ("counter", "fleet/nodes_terminated", "provisioner-owned node agents terminated whole after scale-down drained their last replica"),
     ("counter", "door/requests", "HTTP requests accepted by the front door"),
     ("gauge", "door/open_streams", "SSE token streams currently open on the door"),
     ("histogram", "door/stream_ttft_ms", "door-observed time to first streamed token event (request receipt to the first SSE token flush)"),

@@ -30,7 +30,12 @@ from deepspeed_tpu.serving import (
     SLOTargets,
     SocketNodeProvider,
 )
-from deepspeed_tpu.serving.autoscaler import AutoscaleState, ErrorBudget
+from deepspeed_tpu.serving.autoscaler import (
+    AutoscaleState,
+    Decision,
+    ErrorBudget,
+    NoPlaceableCapacity,
+)
 from deepspeed_tpu.serving.node import NodeServer
 from deepspeed_tpu.serving.replica import ReplicaBase
 from deepspeed_tpu.serving.transport import (
@@ -38,6 +43,7 @@ from deepspeed_tpu.serving.transport import (
     SocketReplica,
 )
 from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.tracing import SpanTracer
 
 
 # ---------------------------------------------------------------------------
@@ -798,5 +804,52 @@ def test_init_fleet_builds_autoscaler_only_when_enabled():
         assert router.autoscaler.policy.slo.ttft_p99_ms == 500.0
         assert router.autoscaler.policy.max_replicas == 2
         assert router.autoscaler.state.target == 1
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# typed refusals: counted per reason, flight-recorded on the transition
+# ---------------------------------------------------------------------------
+class _RefusingProvider:
+    name = "stub"
+
+    def spawn(self, existing_ids):
+        raise NoPlaceableCapacity(
+            "every node dead or at ceiling and no provisioner configured"
+        )
+
+    def retire(self, replica):
+        pass
+
+
+def test_refused_spawn_counts_per_reason_but_flight_records_once():
+    """A structurally unplaceable scale_up is a REFUSAL, not a failure:
+    both counters move on every refused tick, but the flight-recorder
+    instant fires only on the transition into the refusal state."""
+    scaler = Autoscaler(_RefusingProvider(), min_replicas=1, max_replicas=4)
+    tracer = SpanTracer(ring_events=64)
+    router = FleetRouter(
+        [_StubReplica("0")], monitor_interval=0.002,
+        tracer=tracer, autoscaler=scaler,
+    ).start()
+    try:
+        for _ in range(2):
+            scaler._execute(
+                Decision(AUTOSCALE_UP, "surge", None, None, None)
+            )
+        metrics = router.metrics
+        assert metrics.counter("fleet/autoscale_refusals").value == 2
+        assert metrics.counter(
+            "fleet/autoscale_refusals/no_placeable_capacity"
+        ).value == 2
+        refused = [
+            e for e in tracer.flight_snapshot()
+            if e["name"] == "router.autoscale"
+            and e["attrs"]["action"] == "refused"
+        ]
+        assert len(refused) == 1  # deduped while the reason is unchanged
+        # target never moved: a refusal is not a transition
+        assert scaler.state.target == 1
     finally:
         router.shutdown()

@@ -829,6 +829,63 @@ def test_serving_rejects(block):
 
 
 # ---------------------------------------------------------------------------
+# serving.provisioner block: the whole-node lifecycle tier
+# (docs/serving.md "Node failure domain")
+# ---------------------------------------------------------------------------
+def test_serving_provisioner_defaults_off():
+    cfg = make({"train_batch_size": 8})
+    assert cfg.serving_provisioner_enabled is False
+    assert cfg.serving_provisioner_node_spec is None
+    assert cfg.serving_provisioner_max_nodes == 4
+    assert cfg.serving_provisioner_max_replicas_per_node == 4
+    assert cfg.serving_provisioner_launch_timeout_secs == 120.0
+    assert cfg.serving_provisioner_terminate_grace_secs == 5.0
+
+
+def test_serving_provisioner_block_parses():
+    spec = {"replicas": {}, "spawn_spec": {"stub": {"delay_secs": 0.01}}}
+    cfg = _srv({"provisioner": {
+        "enabled": True,
+        "node_spec": spec,
+        "max_nodes": 2,
+        "max_replicas_per_node": 8,
+        "launch_timeout_secs": 30.0,
+        "terminate_grace_secs": 1.5,
+    }})
+    assert cfg.serving_provisioner_enabled is True
+    assert cfg.serving_provisioner_node_spec == spec
+    assert cfg.serving_provisioner_max_nodes == 2
+    assert cfg.serving_provisioner_max_replicas_per_node == 8
+    assert cfg.serving_provisioner_launch_timeout_secs == 30.0
+    assert cfg.serving_provisioner_terminate_grace_secs == 1.5
+
+
+@pytest.mark.parametrize("block", [
+    {"provisioner": {"enable": True}},          # typo'd key != enabled
+    {"provisioner": {"enabled": "yes"}},
+    {"provisioner": {"enabled": 1}},
+    {"provisioner": {"node_spec": "node.json"}},  # path != spec object
+    {"provisioner": {"node_spec": ["r0"]}},
+    {"provisioner": {"max_nodes": 0}},
+    {"provisioner": {"max_nodes": -1}},
+    {"provisioner": {"max_nodes": 2.5}},
+    {"provisioner": {"max_nodes": True}},
+    {"provisioner": {"max_replicas_per_node": 0}},
+    {"provisioner": {"max_replicas_per_node": True}},
+    {"provisioner": {"launch_timeout_secs": 0}},
+    {"provisioner": {"launch_timeout_secs": "fast"}},
+    {"provisioner": {"launch_timeout_secs": True}},
+    {"provisioner": {"terminate_grace_secs": 0}},
+    {"provisioner": {"terminate_grace_secs": -1}},
+])
+def test_serving_provisioner_rejects(block):
+    from deepspeed_tpu.config.config import DeepSpeedConfigError
+
+    with pytest.raises(DeepSpeedConfigError):
+        _srv(block)
+
+
+# ---------------------------------------------------------------------------
 # telemetry.tracing keys (docs/observability.md "Request tracing &
 # flight recorder")
 # ---------------------------------------------------------------------------

@@ -556,6 +556,28 @@ def test_readyz_503_under_brownout():
         router.shutdown()
 
 
+def test_readyz_names_cause_when_zero_routable():
+    """A 503 for zero routable capacity carries the no_capacity_cause
+    buckets in its body — probes (and humans) see WHY the fleet cannot
+    take traffic, not just that it can't."""
+    router, _engines = _fleet(step_secs=0.0)
+    door = HTTPDoor(router)
+    host, port = door.start()
+    try:
+        router.drain("0")  # the only replica: zero routable capacity
+        resp, out = _http_json(host, port, "GET", "/readyz")
+        assert resp.status == 503
+        assert "no_routable_replicas" in out["reasons"]
+        cause = out["cause"]
+        assert cause["replicas_total"] == 1
+        assert cause["not_routable"] == 1
+        assert cause["fenced"] is False
+        assert cause["evicted"] == 0
+    finally:
+        door.shutdown()
+        router.shutdown()
+
+
 def test_429_retry_after_tracks_bucket_refill_rate():
     # 1-token burst refilling at 0.5/s: the second request's Retry-After
     # must say ~2s (ceil of the bucket's real refill time), not 1

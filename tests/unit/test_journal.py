@@ -523,6 +523,73 @@ def test_adoption_prefers_journaled_node_address(tmp_path):
     assert plan.adopted_ids == ["n0:r0"]
 
 
+def test_adoption_node_dying_mid_plan_loses_only_its_replicas(tmp_path):
+    """A node that accepts the confirm dial but dies DURING node_info
+    (connection reset mid-handshake) is a dead node: its replicas are
+    lost, every other node's adoption is unaffected, and the lost
+    replicas' inflight descriptors stay in the plan for re-routing."""
+    dials = []
+
+    class _Ctl:
+        def __init__(self, address, **_kw):
+            self.address = tuple(address)
+            dials.append(self.address)
+
+        def node_info(self):
+            if self.address == ("127.0.0.1", 7001):
+                raise ConnectionResetError("peer died mid-handshake")
+            return {"replicas": ["r0", "r1"]}
+
+    state = _journal_state(
+        nodes={"n0": ["127.0.0.1", 7000], "n1": ["127.0.0.1", 7001]},
+        replicas={
+            "n0:r0": _membership(),
+            "n0:r1": _membership(remote="r1"),
+            "n1:r0": _membership(node="n1", port=7001),
+        },
+        inflight={"7": {
+            "prompt": [5], "tenant": "default",
+            "kwargs": {"max_new_tokens": 4}, "replica": "n1:r0",
+            "rpc_id": 3, "idem": "mid-key", "deadline_unix": None,
+            "reroutes": 0,
+        }},
+    )
+    plan = plan_adoption(
+        state, node_control_client=_Ctl, socket_replica=_FakeReplica,
+    )
+    assert sorted(dials) == [("127.0.0.1", 7000), ("127.0.0.1", 7001)]
+    assert sorted(plan.adopted_ids) == ["n0:r0", "n0:r1"]
+    assert plan.lost_replicas == [("n1:r0", "node n1 dead")]
+    # the dead node's request rides along for orphan re-placement
+    assert plan.inflight == {7: state["inflight"]["7"]}
+
+
+def test_inflight_on_node_dead_mid_plan_re_routes(tmp_path):
+    """End-to-end: the mid-plan death's orphaned request re-places
+    through the ordinary re-route budget on the recovered fleet."""
+
+    class _Ctl:
+        def __init__(self, address, **_kw):
+            pass
+
+        def node_info(self):
+            raise ConnectionResetError("peer died mid-handshake")
+
+    plan = plan_adoption(
+        _orphan_state(), node_control_client=_Ctl,
+        socket_replica=_FakeReplica,
+    )
+    assert plan.lost_replicas == [("gone", "node nX dead")]
+    router = _fleet(max_reroutes=2, recovered=plan)
+    try:
+        req = router.find_inflight("orph-key")
+        assert req is not None and req.request_id == 7
+        assert req.result(20.0) == _expected_answer([5], 4)
+        assert req.reroutes == 1
+    finally:
+        router.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # adoption over REAL loopback node sessions
 # ---------------------------------------------------------------------------

@@ -1,0 +1,699 @@
+"""Whole-node failure domain tests (deepspeed_tpu/serving/provisioner.py
++ node.py epoch fencing + autoscaler.py node tier, docs/serving.md "Node
+failure domain" / "Epoch fencing"): the fencing handshake on both the
+control and data planes (reject below high-water, raise on >=, epoch-less
+back-compat, terminal no-reconnect-through-the-fence), the router's loud
+stand-down when one of its replicas is fenced, incarnation-epoch
+monotonicity across journal recoveries, the node.crash / node.partition
+chaos sites, the provisioner seam (StaticProvisioner against in-process
+agents, LocalSubprocessProvisioner against one real forked agent), and
+the SocketNodeProvider's node-tier escalation: typed NoPlaceableCapacity
+refusals, re-provision-under-the-same-name, mint-new-node, and
+drain-then-terminate on the last retire.
+
+Everything except the one LocalSubprocessProvisioner test is jax-free
+and fork-free: node agents are in-process NodeServers hosting worker.py's
+StubWorkerEngine (answers are a pure function of the prompt)."""
+
+import os
+import signal
+import socket
+import time
+
+import pytest
+
+from deepspeed_tpu.inference.paging import PoolExhausted
+from deepspeed_tpu.inference.scheduler import (
+    ContinuousBatchingScheduler,
+    RequestRejected,
+)
+from deepspeed_tpu.resilience.faults import FaultInjector, FaultSpec
+from deepspeed_tpu.serving import (
+    FleetRouter,
+    LocalSubprocessProvisioner,
+    NodeHandle,
+    NodeProvisioner,
+    NoPlaceableCapacity,
+    ProvisionFailed,
+    SocketNodeProvider,
+    StaticProvisioner,
+)
+from deepspeed_tpu.serving.journal import FleetJournal, load_journal_state
+from deepspeed_tpu.serving.node import NodeServer
+from deepspeed_tpu.serving.replica import FencedOut, ReplicaBase
+from deepspeed_tpu.serving.transport import NodeControlClient, SocketReplica
+from deepspeed_tpu.telemetry.registry import (
+    MetricsRegistry,
+    suppressed_errors_snapshot,
+)
+from deepspeed_tpu.telemetry.tracing import SpanTracer
+
+
+def _expected_answer(prompt, max_new):
+    base = prompt[-1] if prompt else 0
+    return [(base + i + 1) % 1000 for i in range(max_new)]
+
+
+def _node(replicas=("r0",), *, delay=0.02, config=None, node_id="n0",
+          spawn_spec=None):
+    spec = {
+        "node_id": node_id,
+        "replicas": {
+            name: {"stub": {"delay_secs": delay}} for name in replicas
+        },
+        "lease_secs": 5.0,
+        "resume_grace_secs": 5.0,
+    }
+    if spawn_spec is not None:
+        spec["spawn_spec"] = spawn_spec
+    if config is not None:
+        spec["config"] = config
+    return NodeServer(spec)
+
+
+def _replica(node, name="r0", *, rid=None, faults=None, epoch=None,
+             rpc_timeout=2.0, rpc_retries=1, **kw):
+    host, port = node.address
+    return SocketReplica(
+        rid or f"{node.node_id}:{name}", (host, port), remote_name=name,
+        rpc_timeout=rpc_timeout, rpc_retries=rpc_retries,
+        rpc_backoff_secs=0.01, reconnect_backoff_secs=0.02,
+        reconnect_attempts=3, fault_injector=faults, epoch=epoch, **kw,
+    )
+
+
+def _ctl(node_or_addr, *, epoch=None, timeout=5.0):
+    address = (
+        node_or_addr.address
+        if isinstance(node_or_addr, NodeServer) else node_or_addr
+    )
+    return NodeControlClient(
+        address, connect_timeout=timeout, op_timeout=timeout, epoch=epoch,
+    )
+
+
+def _dead_address():
+    """A loopback port with nothing behind it (bound then freed)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = s.getsockname()
+    s.close()
+    return (addr[0], addr[1])
+
+
+def _wait(predicate, timeout=30.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing: the control plane
+# ---------------------------------------------------------------------------
+def test_control_dial_below_high_water_is_fenced_out():
+    node = _node()
+    node.start()
+    try:
+        # first epoch-ed dial sets the mark
+        info = _ctl(node, epoch=5).node_info()
+        assert info["node"] == "n0"
+        assert info["epoch_high_water"] == 5
+        # a STALE incarnation is rejected with the typed error naming
+        # both epochs — exactly what a stood-down router logs
+        with pytest.raises(FencedOut) as exc:
+            _ctl(node, epoch=3).node_info()
+        assert exc.value.epoch == 3
+        assert exc.value.high_water == 5
+        # equal epoch is the same incarnation reconnecting: admitted
+        assert _ctl(node, epoch=5).node_info()["epoch_high_water"] == 5
+        # a newer incarnation raises the mark (monotonic, never lowers)
+        assert _ctl(node, epoch=7).node_info()["epoch_high_water"] == 7
+        with pytest.raises(FencedOut):
+            _ctl(node, epoch=5).node_info()
+    finally:
+        node.shutdown()
+
+
+def test_epochless_hello_never_fenced():
+    """Back-compat: pre-epoch clients (and tests) fence nothing and are
+    never fenced, even after the high-water mark has risen."""
+    node = _node()
+    node.start()
+    replica = _replica(node)  # no epoch
+    try:
+        _ctl(node, epoch=9).node_info()
+        info = _ctl(node).node_info()  # epoch-less control dial
+        assert info["epoch_high_water"] == 9
+        replica.start()  # epoch-less data session
+        req = replica.submit([7], max_new_tokens=2)
+        assert req.result(30.0) == _expected_answer([7], 2)
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing: the data plane
+# ---------------------------------------------------------------------------
+def test_stale_data_session_fenced_on_start():
+    node = _node()
+    node.start()
+    try:
+        _ctl(node, epoch=5).node_info()
+        replica = _replica(node, epoch=3)
+        with pytest.raises(FencedOut) as exc:
+            replica.start()
+        assert exc.value.high_water == 5
+        assert replica.fenced is True
+        replica.shutdown()
+    finally:
+        node.shutdown()
+
+
+def test_fenced_replica_never_reconnects_through_the_fence():
+    """A replica whose epoch was superseded MID-LIFE (a newer router
+    adopted the node) discovers the fence at its next reconnect and
+    fails TERMINALLY: no retry loop hammers the node, in-flight requests
+    fail for re-route, and the fenced flag (the router's stand-down
+    signal) latches."""
+    # frames: (1) the post-start snapshot, (2) submit — the armed RST
+    # then fires on the session's next frame, mid-generation, and the
+    # reconnect walks into the already-raised fence
+    faults = FaultInjector(
+        [FaultSpec("conn.reset", after=2, times=1, seed=0)], seed=0
+    )
+    node = _node(delay=0.5)
+    node.start()
+    replica = _replica(node, faults=faults, epoch=3)
+    try:
+        before = suppressed_errors_snapshot().get(
+            "internal/suppressed_errors/serving.net_fenced_out", 0
+        )
+        replica.start()
+        assert replica.load_snapshot()["alive"]
+        # a newer incarnation takes the node over BEFORE the drop, so
+        # the reconnect outcome is deterministic: fenced, not resumed
+        _ctl(node, epoch=9).node_info()
+        req = replica.submit([7], max_new_tokens=4)
+        replica.load_snapshot()  # hits the armed RST, drops the socket
+        assert faults.injected["conn.reset"] == 1
+        assert _wait(lambda: replica.fenced and replica.failed, 15.0)
+        assert replica.alive is False
+        assert _wait(lambda: req.done, 15.0)
+        assert req.finish_reason == "error"
+        assert suppressed_errors_snapshot().get(
+            "internal/suppressed_errors/serving.net_fenced_out", 0
+        ) > before
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the router stands down loudly when fenced
+# ---------------------------------------------------------------------------
+class _FencedStub(ReplicaBase):
+    """The router-facing contract of a replica the node fenced out."""
+
+    def __init__(self, replica_id):
+        super().__init__(replica_id)
+        self.failed = False
+        self.fenced = False
+
+    def start(self):
+        return self
+
+    def submit(self, prompt_tokens, **kwargs):
+        raise RuntimeError("stub never takes traffic")
+
+    def _snapshot_now(self):
+        return {
+            "alive": not self.failed, "failed": self.failed,
+            "queue_depth": 0, "queue_capacity": 8, "active_slots": 0,
+            "free_slots": 2, "num_slots": 2, "health": 0,
+            "mean_prefill_ms": 0.0, "mean_decode_ms": 0.0,
+            "mean_queue_wait_ms": 0.0, "requests_shed": 0.0,
+            "restarts_used": 0, "requests_completed": 0,
+            "tokens_generated": 0, "driving": True, "stopped": False,
+            "driver_failed": False,
+        }
+
+    def drain(self):
+        pass
+
+    def restart(self):
+        return self
+
+    def shutdown(self):
+        pass
+
+
+def test_router_stands_down_when_any_replica_is_fenced():
+    healthy = _FencedStub("0")
+    doomed = _FencedStub("1")
+    router = FleetRouter(
+        [healthy, doomed], monitor_interval=0.002,
+    ).start()
+    try:
+        assert router.fenced is False
+        # the node rejects this router's epoch: the transport latches
+        # fenced AND failed (terminal), the sweep notices
+        doomed.fenced = True
+        doomed.failed = True
+        assert _wait(lambda: router.fenced, 15.0)
+        assert "1" in router.evicted_ids
+        # split-brain safety beats availability: a healthy replica
+        # remains, but NO traffic belongs on a stale incarnation
+        ready, reasons = router.readiness()
+        assert not ready and "fenced_out" in reasons
+        with pytest.raises(RequestRejected) as exc:
+            router.submit([1], max_new_tokens=1)
+        assert exc.value.reason == "fenced_out"
+        assert router.no_capacity_cause()["fenced"] is True
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# incarnation epochs are monotonic across journal recoveries
+# ---------------------------------------------------------------------------
+def test_incarnation_monotonic_across_recoveries(tmp_path):
+    j1 = FleetJournal(tmp_path, fsync=False)
+    assert j1.incarnation == 1
+    j1.set_brownout(False)  # force a commit so recovery has a segment
+    j1.close()
+    state, info = load_journal_state(str(tmp_path))
+    assert info["status"] in ("ok", "recovered")
+    j2 = FleetJournal(tmp_path, fsync=False, state=state)
+    assert j2.incarnation == 2  # adopted: strictly above the old life
+    j2.set_brownout(False)
+    j2.close()
+    state2, _ = load_journal_state(str(tmp_path))
+    assert state2["incarnation"] == 2
+    j3 = FleetJournal(tmp_path, fsync=False, state=state2)
+    assert j3.incarnation == 3  # and again: 1 -> 2 -> 3, never back
+    j3.close()
+
+
+def test_explicit_incarnation_override(tmp_path):
+    j = FleetJournal(tmp_path, fsync=False, incarnation=41)
+    assert j.incarnation == 41
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos sites at the node-agent seam
+# ---------------------------------------------------------------------------
+class _OSProxy:
+    """``os`` with ``kill`` recorded instead of delivered — the
+    node.crash site would SIGKILL the pytest process otherwise."""
+
+    def __init__(self, real):
+        self._real = real
+        self.kills = []
+
+    def kill(self, pid, sig):
+        self.kills.append((pid, sig))
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+def test_node_crash_site_sigkills_the_whole_agent(monkeypatch):
+    import deepspeed_tpu.serving.node as node_mod
+
+    proxy = _OSProxy(os)
+    monkeypatch.setattr(node_mod, "os", proxy)
+    node = _node(config={"resilience": {"fault_injection": {
+        "enabled": True, "seed": 0,
+        "faults": [{"site": "node.crash", "after": 1, "times": 1}],
+    }}})
+    node.start()
+    try:
+        assert _ctl(node).node_info()["node"] == "n0"  # op 1: survives
+        _ctl(node).node_info()  # op 2: the injected host death
+        assert proxy.kills == [(os.getpid(), signal.SIGKILL)]
+    finally:
+        node.shutdown()
+
+
+def test_node_partition_drop_absorbed_by_idempotent_retry():
+    """node.partition black-holes ONE node->client event frame after the
+    node considers it sent; the client's rpc timeout notices and the
+    idempotent retry repairs the loss — bitwise-identical answers, one
+    accounted drop."""
+    node = _node(config={"resilience": {"fault_injection": {
+        "enabled": True, "seed": 0,
+        "faults": [{"site": "node.partition", "times": 1}],
+    }}})
+    node.start()
+    replica = _replica(node, rpc_timeout=0.5, rpc_retries=3)
+    before = suppressed_errors_snapshot().get(
+        "internal/suppressed_errors/serving.node_partition_drop", 0
+    )
+    try:
+        # the session's FIRST emitted frame is the one black-holed —
+        # whichever reply that turns out to be, the client's retry
+        # machinery absorbs it invisibly
+        replica.start()
+        snap = replica.load_snapshot()
+        assert snap["alive"] and not snap["failed"]
+        assert suppressed_errors_snapshot().get(
+            "internal/suppressed_errors/serving.node_partition_drop", 0
+        ) == before + 1
+        req = replica.submit([3], max_new_tokens=3)
+        assert req.result(30.0) == _expected_answer([3], 3)
+        assert replica.failed is False
+    finally:
+        replica.shutdown()
+        node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# StaticProvisioner: the fork-free seam
+# ---------------------------------------------------------------------------
+def test_static_provisioner_confirms_and_forgets():
+    node = _node(node_id="ext0")
+    node.start()
+    try:
+        prov = StaticProvisioner({"ext0": node.address}, epoch=4)
+        handle = prov.launch_node("ext0")
+        assert handle.name == "ext0"
+        assert handle.address == node.address
+        assert handle.alive  # no proc: externally owned, assumed alive
+        assert list(prov.list_nodes()) == ["ext0"]
+        # the confirm dial carried epoch 4: the node is now fenced
+        # against anything older
+        with pytest.raises(FencedOut):
+            _ctl(node, epoch=3).node_info()
+        # terminate only forgets — the process belongs to someone else
+        prov.terminate_node("ext0")
+        assert prov.list_nodes() == {}
+        assert _ctl(node).node_info()["node"] == "ext0"
+        with pytest.raises(KeyError):
+            prov.terminate_node("ext0")
+    finally:
+        node.shutdown()
+
+
+def test_static_provisioner_unknown_name_and_dead_address():
+    prov = StaticProvisioner(confirm_timeout=0.5)
+    with pytest.raises(ProvisionFailed, match="knows no address"):
+        prov.launch_node("ghost")
+    prov.register("deadbeat", _dead_address())
+    with pytest.raises(ProvisionFailed, match="health"):
+        prov.launch_node("deadbeat")
+    assert prov.list_nodes() == {}  # a failed launch owns nothing
+
+
+# ---------------------------------------------------------------------------
+# LocalSubprocessProvisioner: one real forked agent, end to end
+# ---------------------------------------------------------------------------
+def test_local_subprocess_provisioner_launch_fence_terminate():
+    reg = MetricsRegistry()
+    prov = LocalSubprocessProvisioner(
+        {"replicas": {"r0": {"stub": {"delay_secs": 0.01}}},
+         "lease_secs": 5.0, "resume_grace_secs": 5.0},
+        launch_timeout=60.0, terminate_grace=5.0, epoch=7, registry=reg,
+    )
+    try:
+        handle = prov.launch_node("pnA")
+        assert handle.alive and handle.name == "pnA"
+        assert list(prov.list_nodes()) == ["pnA"]
+        info = _ctl(handle.address, timeout=30.0).node_info()
+        assert info["node"] == "pnA" and info["replicas"] == ["r0"]
+        # the health-confirm dial stamped the launching router's epoch
+        assert info["epoch_high_water"] == 7
+        with pytest.raises(FencedOut):
+            _ctl(handle.address, epoch=5, timeout=30.0).node_info()
+        # a second launch under a live name is refused, not doubled
+        with pytest.raises(ProvisionFailed, match="already owns"):
+            prov.launch_node("pnA")
+        assert reg.counter("fleet/nodes_provisioned").value == 1
+        prov.terminate_node("pnA")
+        assert handle.proc.poll() is not None  # really dead
+        assert prov.list_nodes() == {}
+        assert reg.counter("fleet/nodes_terminated").value == 1
+        with pytest.raises(KeyError):
+            prov.terminate_node("pnA")
+    finally:
+        prov.close()
+
+
+def test_local_subprocess_launch_failure_leaks_no_process():
+    prov = LocalSubprocessProvisioner(launch_timeout=60.0)
+    # an empty replicas map with no spawn_spec is rejected by the agent
+    # before it announces: the launch must fail typed AND clean up
+    with pytest.raises(ProvisionFailed, match="exited before announcing"):
+        prov.launch_node("broken", spec={"replicas": {}})
+    assert prov.list_nodes() == {}
+    prov.close()
+
+
+# ---------------------------------------------------------------------------
+# SocketNodeProvider: the node tier
+# ---------------------------------------------------------------------------
+class _ServerProvisioner(NodeProvisioner):
+    """Real in-process NodeServers behind the provisioner seam — the
+    node tier's behavior without fork cost. Launched nodes start EMPTY
+    (spawn_spec only) so a retire can empty them."""
+
+    def __init__(self):
+        self.servers = {}
+        self.owned = {}
+        self.launches = []
+        self.terminated = []
+
+    def launch_node(self, name, spec=None):
+        server = NodeServer({
+            "node_id": name, "replicas": {},
+            "spawn_spec": {"stub": {"delay_secs": 0.01}},
+            "lease_secs": 5.0, "resume_grace_secs": 5.0,
+        })
+        server.start()
+        self.servers[name] = server
+        handle = NodeHandle(name, server.address)
+        self.owned[name] = handle
+        self.launches.append(name)
+        return handle
+
+    def terminate_node(self, name):
+        handle = self.owned.pop(str(name))
+        server = self.servers.pop(str(name), None)
+        if server is not None:
+            server.shutdown()
+        self.terminated.append(str(name))
+        return handle
+
+    def list_nodes(self):
+        return dict(self.owned)
+
+
+def _provider(nodes, **kw):
+    kw.setdefault("rpc_timeout", 2.0)
+    kw.setdefault("connect_timeout", 2.0)
+    kw.setdefault("spawn_timeout", 30.0)
+    kw.setdefault("node_retry_secs", 30.0)
+    return SocketNodeProvider(nodes, **kw)
+
+
+def test_spawn_without_provisioner_raises_typed_refusal():
+    node = _node()
+    node.start()
+    try:
+        provider = _provider(
+            {"n0": {"address": node.address}}, max_replicas_per_node=1,
+        )
+        with pytest.raises(NoPlaceableCapacity) as exc:
+            provider.spawn({"n0:r0"})  # n0 already at its ceiling
+        assert exc.value.reason == "no_placeable_capacity"
+        assert "no provisioner" in str(exc.value)
+    finally:
+        node.shutdown()
+
+
+def test_full_fleet_at_max_nodes_refuses_typed():
+    node = _node()
+    node.start()
+    try:
+        provider = _provider(
+            {"n0": {"address": node.address}},
+            provisioner=_ServerProvisioner(),
+            max_replicas_per_node=1, max_nodes=1,
+        )
+        with pytest.raises(NoPlaceableCapacity, match="max_nodes"):
+            provider.spawn({"n0:r0"})
+    finally:
+        node.shutdown()
+
+
+def test_capacity_past_every_ceiling_mints_a_new_node():
+    node = _node()
+    node.start()
+    prov = _ServerProvisioner()
+    provider = _provider(
+        {"n0": {"address": node.address}}, provisioner=prov,
+        max_replicas_per_node=1, max_nodes=2,
+    )
+    replica = None
+    try:
+        replica = provider.spawn({"n0:r0"})
+        assert replica.replica_id == "pn0:as0"
+        assert prov.launches == ["pn0"]
+        assert "pn0" in provider._addresses
+        req = replica.submit([9], max_new_tokens=2)
+        assert req.result(30.0) == _expected_answer([9], 2)
+    finally:
+        if replica is not None:
+            replica.shutdown()
+        provider.close()
+        node.shutdown()
+
+
+def test_dead_node_reprovisions_under_the_same_name():
+    prov = _ServerProvisioner()
+    provider = _provider(
+        {"n0": {"address": _dead_address()}}, provisioner=prov,
+        max_replicas_per_node=2, max_nodes=1,
+    )
+    replica = None
+    try:
+        # first spawn dials the corpse: the failure backs the node off
+        with pytest.raises(OSError):
+            provider.spawn(set())
+        # next spawn escalates to the node tier: the backed-off node is
+        # re-provisioned under ITS OWN name at a fresh address
+        replica = provider.spawn(set())
+        # as1, not as0: the failed first spawn consumed a name before
+        # its dial refused — minted ids are never reused, even wasted
+        assert replica.replica_id == "n0:as1"
+        assert prov.launches == ["n0"]
+        assert provider._addresses["n0"] == prov.servers["n0"].address
+        req = replica.submit([4], max_new_tokens=2)
+        assert req.result(30.0) == _expected_answer([4], 2)
+    finally:
+        if replica is not None:
+            replica.shutdown()
+        provider.close()
+
+
+def test_retire_emptying_provisioned_node_terminates_it():
+    node = _node()
+    node.start()
+    prov = _ServerProvisioner()
+    provider = _provider(
+        {"n0": {"address": node.address}}, provisioner=prov,
+        max_replicas_per_node=1, max_nodes=2,
+    )
+    try:
+        replica = provider.spawn({"n0:r0"})
+        assert replica.replica_id == "pn0:as0"
+        provider.retire(replica)
+        # drain-then-terminate: the retire emptied a provisioner-owned
+        # node, so the whole host is released and its address backed
+        # off — the next pick must not dial the corpse
+        assert prov.terminated == ["pn0"]
+        assert "pn0" in provider._node_failed_at
+        assert provider._pick_node(set()) == "n0"
+    finally:
+        provider.close()
+        node.shutdown()
+
+
+def test_note_live_ids_counts_capacity_from_live_view():
+    """Eviction must free a node's capacity accounting: ids the router
+    evicted still block name-minting (never reuse an id) but no longer
+    hold replica slots."""
+    node = _node()
+    node.start()
+    replica = None
+    try:
+        provider = _provider(
+            {"n0": {"address": node.address}}, max_replicas_per_node=1,
+        )
+        everything = {"n0:r0"}  # journaled/evicted history
+        provider.note_live_ids([])  # but nothing is LIVE on n0
+        replica = provider.spawn(everything)
+        assert replica.replica_id == "n0:as0"  # minted clear of r0
+        req = replica.submit([2], max_new_tokens=2)
+        assert req.result(30.0) == _expected_answer([2], 2)
+    finally:
+        if replica is not None:
+            replica.shutdown()
+        node.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# host-tier preemption is priority-classed
+# ---------------------------------------------------------------------------
+class _PreemptEngine:
+    """Scheduler-facing fake whose KV pool 'fits one': the first
+    capacity check that sees two active slots raises PoolExhausted
+    once, forcing exactly one preemption — so the victim CHOICE is the
+    whole observable."""
+
+    prefill_len = 16
+
+    def __init__(self):
+        self.raised = False
+
+    def prefill_request(self, slot, prompt_tokens, temperature):
+        del prompt_tokens, temperature
+        return 100 + slot
+
+    def decode_tokens(self, active):
+        return [7 for _ in active]
+
+    def ensure_decode_capacity(self, active):
+        if len(active) >= 2 and not self.raised:
+            self.raised = True
+            raise PoolExhausted(1, 0)
+
+
+def _preempt_scheduler():
+    tracer = SpanTracer(ring_events=64)
+    sched = ContinuousBatchingScheduler(
+        _PreemptEngine(), num_slots=2, max_seq_len=32, queue_depth=8,
+        queue_timeout=0.1, eos_token_id=None, temperature=0.0,
+        registry=MetricsRegistry(), tracer=tracer,
+    )
+    return sched, tracer
+
+
+def _preempted_ids(tracer):
+    return [
+        e["attrs"]["request_id"] for e in tracer.flight_snapshot()
+        if e["name"] == "sched.preempt"
+    ]
+
+
+def test_preemption_parks_lowest_priority_class_first():
+    """KV page pressure must never evict a protected tenant's
+    generation for a sheddable one: the OLDER low-priority request
+    parks (under admission-order-only victim choice the newest — the
+    priority-0 request — would have gone)."""
+    sched, tracer = _preempt_scheduler()
+    low = sched.submit([1, 2], max_new_tokens=3, priority=1)
+    high = sched.submit([3, 4], max_new_tokens=3, priority=0)
+    sched.run_until_idle()
+    assert len(low.result(10.0)) == 3
+    assert len(high.result(10.0)) == 3
+    assert low.finish_reason == "max_new_tokens"
+    assert high.finish_reason == "max_new_tokens"
+    # the sheddable request was the victim — and it still completed,
+    # resumed suffix-only after the parked interval
+    assert _preempted_ids(tracer) == [low.request_id]
+
+
+def test_preemption_within_class_parks_newest_first():
+    sched, tracer = _preempt_scheduler()
+    older = sched.submit([1, 2], max_new_tokens=3, priority=1)
+    newer = sched.submit([3, 4], max_new_tokens=3, priority=1)
+    sched.run_until_idle()
+    assert len(older.result(10.0)) == 3
+    assert len(newer.result(10.0)) == 3
+    # equal classes keep the old policy: most recently admitted goes
+    assert _preempted_ids(tracer) == [newer.request_id]
